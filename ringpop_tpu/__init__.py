@@ -1,0 +1,53 @@
+"""ringpop_tpu — a TPU-native application-layer sharding framework.
+
+A ground-up rebuild of the capabilities of ringpop-go (reference:
+/root/reference) designed TPU-first:
+
+* **Host plane** — a real coordination library: SWIM gossip membership,
+  consistent hash ring, request forwarding, routing and replication over an
+  asyncio JSON-over-TCP transport.  Mirrors the reference public API surface
+  (``ringpop.Interface``, reference ``ringpop.go:48-63``).
+
+* **Sim plane** — the entire simulated cluster as one pytree of dense JAX
+  arrays; a single jitted/vmapped ``protocol_step`` advances every node at
+  once, sharded across a TPU mesh with ``shard_map``.  This replaces the
+  reference's goroutine-per-node concurrency (reference ``swim/gossip.go:151``)
+  with data-parallel SPMD over the node axis.
+
+Both planes share one semantics core (``ringpop_tpu.swim.member``): the SWIM
+override/precedence rules are written once as pure functions operating on
+scalars *or* arrays, which is how host and sim stay bit-identical.
+"""
+
+from ringpop_tpu.version import __version__
+
+_FACADE_EXPORTS = {
+    "Ringpop": "ringpop_tpu.ringpop",
+    "Interface": "ringpop_tpu.ringpop",
+    "Options": "ringpop_tpu.options",
+    "RingpopError": "ringpop_tpu.errors",
+    "NotBootstrappedError": "ringpop_tpu.errors",
+    "EphemeralIdentityError": "ringpop_tpu.errors",
+    "InvalidStateError": "ringpop_tpu.errors",
+}
+
+
+def __getattr__(name):
+    # lazy so substrate submodules import without pulling the full facade
+    mod = _FACADE_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+__all__ = [
+    "__version__",
+    "Ringpop",
+    "Interface",
+    "Options",
+    "RingpopError",
+    "NotBootstrappedError",
+    "EphemeralIdentityError",
+    "InvalidStateError",
+]

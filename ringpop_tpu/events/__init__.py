@@ -1,0 +1,98 @@
+"""Typed event bus (parity: reference ``events/events.go:26-69``).
+
+Every layer emits dataclass events into listener buses; the facade subscribes
+to node/ring/forwarder buses and translates events to stats — the reference's
+composition mechanism (``ringpop.go:170-180``), kept here because it decouples
+the sim plane cleanly: the sim emits the same event types per step batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Protocol, Type
+
+
+class EventListener(Protocol):
+    def handle_event(self, event: Any) -> None: ...
+
+
+class EventEmitter:
+    """Listener registry + emit.  Dispatch is synchronous by default (the swim
+    node emits synchronously, ``swim/node.go:266-270``); wrap listeners with
+    :func:`async_listener` for the facade's async dispatch
+    (``ringpop.go:297-301``)."""
+
+    def __init__(self) -> None:
+        self._listeners: list[EventListener] = []
+
+    def register_listener(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def deregister_listener(self, listener: EventListener) -> None:
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def emit(self, event: Any) -> None:
+        for l in list(self._listeners):
+            l.handle_event(event)
+
+
+class _FnListener:
+    def __init__(self, event_type: Type, fn: Callable[[Any], None]):
+        self.event_type = event_type
+        self.fn = fn
+
+    def handle_event(self, event: Any) -> None:
+        if isinstance(event, self.event_type):
+            self.fn(event)
+
+
+def on(emitter: EventEmitter, event_type: Type, fn: Callable[[Any], None]) -> _FnListener:
+    """Subscribe ``fn`` to events of ``event_type`` (parity: the reference's
+    test helper ``swim/events.go:240-246``)."""
+    l = _FnListener(event_type, fn)
+    emitter.register_listener(l)
+    return l
+
+
+# ---------------------------------------------------------------------------
+# Facade-level events (parity: events/events.go:38-69)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RingChangedEvent:
+    servers_added: list = field(default_factory=list)
+    servers_updated: list = field(default_factory=list)
+    servers_removed: list = field(default_factory=list)
+
+
+@dataclass
+class RingChecksumEvent:
+    old_checksum: int = 0
+    new_checksum: int = 0
+
+
+@dataclass
+class LookupEvent:
+    key: str = ""
+    duration: float = 0.0
+
+
+@dataclass
+class LookupNEvent:
+    key: str = ""
+    n: int = 0
+    duration: float = 0.0
+
+
+@dataclass
+class Ready:
+    pass
+
+
+@dataclass
+class Destroyed:
+    pass

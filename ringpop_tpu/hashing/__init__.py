@@ -1,0 +1,3 @@
+from ringpop_tpu.hashing.farm import fingerprint32, fingerprint32_batch
+
+__all__ = ["fingerprint32", "fingerprint32_batch"]

@@ -1,0 +1,185 @@
+"""SWIM member/change semantics core — shared by host plane and sim plane.
+
+Parity: reference ``swim/member.go``.  The five states and their precedence
+(``member.go:112-128``), the override predicates (``member.go:79-110``) and
+the wire tombstone-compat shims (``member.go:150-167``) are the consistency
+heart of the whole protocol; they are implemented here ONCE as pure functions
+over plain ints so that:
+
+* the host plane calls them on scalars, and
+* the sim plane calls the *identical expressions* on jnp/numpy int arrays
+  (every function below uses only ``>``, ``&``, ``|``, ``==`` — valid for
+  Python ints, numpy arrays and traced JAX values alike).
+
+States are small ints on this side (the reference uses strings on the wire —
+the wire codec translates).  Crucially the int encoding IS the precedence
+order, so ``state_precedence`` is the identity; an override comparison is a
+lexicographic max over ``(incarnation, state)`` — a join-semilattice, which is
+what makes the sim plane's order-independent "learned change set" state
+representation exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+from ringpop_tpu import util
+
+# Member states, ordered by precedence (reference member.go:30-45,112-128).
+ALIVE = 0
+SUSPECT = 1
+FAULTY = 2
+LEAVE = 3
+TOMBSTONE = 4
+
+STATE_NAMES = ("alive", "suspect", "faulty", "leave", "tombstone")
+STATE_IDS = {name: i for i, name in enumerate(STATE_NAMES)}
+
+
+def state_name(state: int) -> str:
+    return STATE_NAMES[state]
+
+
+def state_id(name: str) -> int:
+    return STATE_IDS[name]
+
+
+def state_precedence(state):
+    """Identity by construction: the int encoding is the precedence order
+    (parity: ``member.go:112-128`` statePrecedence)."""
+    return state
+
+
+def overrides(inc_a, state_a, inc_b, state_b):
+    """True when change A=(inc_a, state_a) overrides B — strictly greater in
+    the (incarnation, precedence) lexicographic order
+    (parity: ``member.go:178-187`` Change.overrides and
+    ``member.go:79-93`` nonLocalOverride, which share this comparison)."""
+    return (inc_a > inc_b) | ((inc_a == inc_b) & (state_a > state_b))
+
+
+# alias matching reference naming: a non-local member applies a change iff the
+# change strictly overrides the current (incarnation, state)
+non_local_override = overrides
+
+
+def local_override(inc_change, state_change, inc_local):
+    """True when a change about the LOCAL node must be refuted by
+    reincarnation: any Suspect/Faulty/Tombstone claim at incarnation >= ours
+    (parity: ``member.go:98-110`` localOverride).  Works elementwise on
+    arrays."""
+    is_detraction = (state_change == SUSPECT) | (state_change == FAULTY) | (
+        state_change == TOMBSTONE
+    )
+    return is_detraction & (inc_change >= inc_local)
+
+
+def is_reachable(state):
+    """Alive or Suspect members count for the ring / are pingable
+    (parity: ``member.go:130-132`` isReachable, ``member.go:189-191``
+    isPingable)."""
+    return (state == ALIVE) | (state == SUSPECT)
+
+
+is_pingable = is_reachable
+
+
+@dataclass
+class Member:
+    """A member of the cluster as seen by one node
+    (parity: ``member.go:48-53``)."""
+
+    address: str
+    status: int = ALIVE
+    incarnation: int = 0
+
+    @property
+    def is_reachable(self) -> bool:
+        return bool(is_reachable(self.status))
+
+    @property
+    def is_pingable(self) -> bool:
+        return bool(is_pingable(self.status))
+
+    def non_local_override(self, change: "Change") -> bool:
+        return bool(non_local_override(change.incarnation, change.status, self.incarnation, self.status))
+
+    def local_override(self, local_address: str, change: "Change") -> bool:
+        if self.address != local_address:
+            return False
+        return bool(local_override(change.incarnation, change.status, self.incarnation))
+
+
+@dataclass
+class Change:
+    """A membership change to disseminate (parity: ``member.go:135-145``).
+
+    ``status`` is an int state here; the wire codec maps to the reference's
+    string states and applies the tombstone back-compat shim."""
+
+    address: str
+    incarnation: int
+    status: int
+    source: str = ""
+    source_incarnation: int = 0
+    timestamp: int = 0  # integer Unix seconds (util.Timestamp codec)
+
+    def overrides(self, other: "Change") -> bool:
+        return bool(
+            overrides(self.incarnation, self.status, other.incarnation, other.status)
+        )
+
+    @property
+    def is_pingable(self) -> bool:
+        return bool(is_pingable(self.status))
+
+    # -- wire codec (parity: member.go JSON tags + :150-167 shims) ----------
+
+    def to_wire(self) -> dict:
+        """Serialize with reference-compatible JSON keys; Tombstone is sent as
+        Faulty+tombstone flag for old peers (parity: ``member.go:159-167``
+        validateOutgoing)."""
+        status = self.status
+        d: dict[str, Any] = {
+            "source": self.source,
+            "sourceIncarnationNumber": self.source_incarnation,
+            "address": self.address,
+            "incarnationNumber": self.incarnation,
+            "timestamp": int(self.timestamp),
+        }
+        if status == TOMBSTONE:
+            d["status"] = STATE_NAMES[FAULTY]
+            d["tombstone"] = True
+        else:
+            d["status"] = STATE_NAMES[status]
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Change":
+        """Parse with the incoming tombstone shim: Faulty+flag → Tombstone
+        (parity: ``member.go:150-157`` validateIncoming)."""
+        status = state_id(d["status"])
+        if status == FAULTY and d.get("tombstone"):
+            status = TOMBSTONE
+        return cls(
+            address=d["address"],
+            incarnation=int(d["incarnationNumber"]),
+            status=status,
+            source=d.get("source", ""),
+            source_incarnation=int(d.get("sourceIncarnationNumber", 0)),
+            timestamp=int(d.get("timestamp", 0)),
+        )
+
+
+def member_to_change(m: Member, source: str, source_inc: int, ts: int = 0) -> Change:
+    """A full-membership entry sent on the wire (joins/full-syncs) is just a
+    Change (parity: ``swim/disseminator.go:107-123`` MembershipAsChanges)."""
+    return Change(
+        address=m.address,
+        incarnation=m.incarnation,
+        status=m.status,
+        source=source,
+        source_incarnation=source_inc,
+        timestamp=ts,
+    )

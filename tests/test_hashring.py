@@ -1,0 +1,128 @@
+"""HashRing tests (model: reference hashring/hashring_test.go — distribution,
+wraparound, checksum, batch add/remove — recast for the sorted-token-array
+design)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.events import RingChangedEvent, RingChecksumEvent, on
+from ringpop_tpu.hashing import fingerprint32
+from ringpop_tpu.hashring import HashRing
+
+
+def servers(n, port=3000):
+    return [f"10.0.0.{i}:{port}" for i in range(n)]
+
+
+def test_empty_ring():
+    r = HashRing()
+    assert r.lookup("key") is None
+    assert r.lookup_n("key", 3) == []
+    assert r.servers() == []
+    assert r.server_count() == 0
+
+
+def test_add_remove_and_has():
+    r = HashRing()
+    assert r.add_server("a:1")
+    assert not r.add_server("a:1")  # duplicate is a no-op
+    assert r.has_server("a:1")
+    assert r.remove_server("a:1")
+    assert not r.remove_server("a:1")
+    assert not r.has_server("a:1")
+
+
+def test_checksum_matches_reference_formula():
+    # hashring.go:102-120: farm32 of sorted addresses joined with ';'
+    r = HashRing()
+    r.add_remove_servers(["b:2", "a:1", "c:3"], [])
+    assert r.checksum() == fingerprint32("a:1;b:2;c:3")
+
+
+def test_checksum_changes_on_membership_change():
+    r = HashRing()
+    r.add_server("a:1")
+    c1 = r.checksum()
+    r.add_server("b:2")
+    assert r.checksum() != c1
+
+
+def test_lookup_deterministic_and_consistent():
+    r = HashRing()
+    r.add_remove_servers(servers(10), [])
+    owner = r.lookup("some-key")
+    assert owner in r.servers()
+    for _ in range(5):
+        assert r.lookup("some-key") == owner
+
+
+def test_lookup_n_unique_and_wraparound():
+    r = HashRing(replica_points=5)
+    r.add_remove_servers(servers(8), [])
+    got = r.lookup_n("k", 4)
+    assert len(got) == len(set(got)) == 4
+    # n >= server count returns all servers
+    assert sorted(r.lookup_n("k", 50)) == sorted(r.servers())
+
+
+def test_removal_only_remaps_owned_keys():
+    # consistent-hashing property: removing a server must not move keys owned
+    # by other servers
+    r = HashRing()
+    r.add_remove_servers(servers(10), [])
+    keys = [f"key-{i}" for i in range(500)]
+    before = {k: r.lookup(k) for k in keys}
+    victim = "10.0.0.3:3000"
+    r.remove_server(victim)
+    for k, owner in before.items():
+        if owner != victim:
+            assert r.lookup(k) == owner
+
+
+def test_distribution_across_servers():
+    # parity check vs hashring_test.go distribution test
+    r = HashRing()
+    r.add_remove_servers(servers(10), [])
+    counts = collections.Counter(r.lookup(f"key-{i}") for i in range(5000))
+    assert len(counts) == 10
+    for c in counts.values():
+        assert 150 < c < 1200  # no pathological skew at 100 vnodes
+
+
+def test_lookup_batch_matches_scalar():
+    r = HashRing()
+    r.add_remove_servers(servers(7), [])
+    keys = [f"key-{i}" for i in range(300)]
+    assert r.lookup_batch(keys) == [r.lookup(k) for k in keys]
+
+
+def test_events_emitted():
+    r = HashRing()
+    changed, checks = [], []
+    on(r.emitter, RingChangedEvent, changed.append)
+    on(r.emitter, RingChecksumEvent, checks.append)
+    r.add_remove_servers(["a:1", "b:2"], [])
+    r.add_remove_servers([], ["a:1"])
+    assert changed[0].servers_added == ["a:1", "b:2"]
+    assert changed[1].servers_removed == ["a:1"]
+    assert len(checks) == 2
+
+
+def test_batch_add_remove_atomic():
+    r = HashRing()
+    r.add_server("a:1")
+    assert r.add_remove_servers(["b:2"], ["a:1"])
+    assert r.servers() == ["b:2"]
+    # no-op when nothing changes
+    assert not r.add_remove_servers(["b:2"], ["zz:9"])
+
+
+def test_token_arrays_snapshot():
+    r = HashRing(replica_points=10)
+    r.add_remove_servers(servers(4), [])
+    toks, owners, slist = r.token_arrays()
+    assert toks.shape == owners.shape == (40,)
+    assert list(toks) == sorted(toks)
+    assert len(slist) == 4

@@ -1,0 +1,106 @@
+"""Semantics-core tests: state precedence, override rules, wire shims.
+Tables modeled on the reference's swim/member_test.go behavior."""
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.swim.member import (
+    ALIVE,
+    SUSPECT,
+    FAULTY,
+    LEAVE,
+    TOMBSTONE,
+    Change,
+    Member,
+    local_override,
+    non_local_override,
+    overrides,
+    state_id,
+    state_name,
+    is_reachable,
+)
+
+STATES = [ALIVE, SUSPECT, FAULTY, LEAVE, TOMBSTONE]
+
+
+def test_state_name_roundtrip():
+    for s in STATES:
+        assert state_id(state_name(s)) == s
+
+
+def test_precedence_order():
+    # member.go:112-128: alive < suspect < faulty < leave < tombstone
+    assert ALIVE < SUSPECT < FAULTY < LEAVE < TOMBSTONE
+
+
+@pytest.mark.parametrize("s_new", STATES)
+@pytest.mark.parametrize("s_old", STATES)
+def test_override_matrix(s_new, s_old):
+    # same incarnation: strictly higher precedence wins (member.go:79-93)
+    assert bool(overrides(5, s_new, 5, s_old)) == (s_new > s_old)
+    # newer incarnation always wins, older never does
+    assert overrides(6, s_new, 5, s_old)
+    assert not overrides(4, s_new, 5, s_old)
+
+
+def test_override_elementwise_on_arrays():
+    inc_a = np.array([6, 5, 5, 4])
+    st_a = np.array([ALIVE, FAULTY, ALIVE, TOMBSTONE])
+    inc_b = np.array([5, 5, 5, 5])
+    st_b = np.array([TOMBSTONE, SUSPECT, ALIVE, ALIVE])
+    got = overrides(inc_a, st_a, inc_b, st_b)
+    assert got.tolist() == [True, True, False, False]
+
+
+def test_local_override_only_detractions_at_geq_incarnation():
+    # member.go:98-110: suspect/faulty/tombstone at inc >= local must refute
+    assert local_override(5, SUSPECT, 5)
+    assert local_override(6, FAULTY, 5)
+    assert local_override(5, TOMBSTONE, 5)
+    assert not local_override(4, SUSPECT, 5)
+    assert not local_override(9, ALIVE, 5)
+    assert not local_override(9, LEAVE, 5)
+
+
+def test_member_local_override_requires_address_match():
+    m = Member("a:1", ALIVE, 5)
+    c = Change(address="a:1", incarnation=5, status=SUSPECT)
+    assert m.local_override("a:1", c)
+    assert not m.local_override("b:2", c)
+
+
+def test_reachability():
+    assert bool(is_reachable(ALIVE)) and bool(is_reachable(SUSPECT))
+    for s in (FAULTY, LEAVE, TOMBSTONE):
+        assert not bool(is_reachable(s))
+
+
+def test_wire_roundtrip_plain():
+    c = Change(
+        address="10.0.0.1:3000",
+        incarnation=123456,
+        status=SUSPECT,
+        source="10.0.0.2:3000",
+        source_incarnation=99,
+        timestamp=1700000000,
+    )
+    d = c.to_wire()
+    assert d["status"] == "suspect"
+    assert d["incarnationNumber"] == 123456
+    assert d["sourceIncarnationNumber"] == 99
+    assert Change.from_wire(d) == c
+
+
+def test_wire_tombstone_compat_shim():
+    # member.go:150-167: tombstone rides the wire as faulty+flag
+    c = Change(address="a:1", incarnation=1, status=TOMBSTONE)
+    d = c.to_wire()
+    assert d["status"] == "faulty" and d["tombstone"] is True
+    back = Change.from_wire(d)
+    assert back.status == TOMBSTONE
+
+
+def test_wire_faulty_without_flag_stays_faulty():
+    d = Change(address="a:1", incarnation=1, status=FAULTY).to_wire()
+    assert "tombstone" not in d
+    assert Change.from_wire(d).status == FAULTY

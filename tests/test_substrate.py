@@ -1,0 +1,145 @@
+"""Substrate tests: util helpers, mock clock deadline wheel, event bus,
+discovery providers, logging facility."""
+
+import json
+import logging as stdlog
+import random
+
+import pytest
+
+from ringpop_tpu import util
+from ringpop_tpu import logging as rlog
+from ringpop_tpu.discovery import JSONFile, StaticHosts, as_provider
+from ringpop_tpu.events import EventEmitter, on
+from ringpop_tpu.util.clock import Clock, MockClock
+
+
+class TestUtil:
+    def test_capture_host(self):
+        assert util.capture_host("10.0.0.1:3000") == "10.0.0.1"
+        assert util.capture_host("nonsense") == ""
+
+    def test_host_ports_by_host(self):
+        got = util.host_ports_by_host(["a:1", "a:2", "b:1"])
+        assert got == {"a": ["a:1", "a:2"], "b": ["b:1"]}
+
+    def test_hostname_ip_mismatch(self):
+        assert util.check_hostname_ip_mismatch("10.0.0.1:1", ["10.0.0.2:1"]) is None
+        assert util.check_hostname_ip_mismatch("10.0.0.1:1", ["host:1"]) is not None
+
+    def test_single_node_cluster(self):
+        assert util.single_node_cluster("a:1", ["a:1"])
+        assert not util.single_node_cluster("a:1", ["a:1", "b:2"])
+
+    def test_select_zero_means_default(self):
+        assert util.select_int(0, 7) == 7
+        assert util.select_int(3, 7) == 3
+        assert util.select_duration(0.0, 1.5) == 1.5
+
+    def test_take_node(self):
+        nodes = ["a", "b", "c"]
+        got = util.take_node(nodes, 1)
+        assert got == "b" and nodes == ["a", "c"]
+        rng = random.Random(0)
+        while nodes:
+            assert util.take_node(nodes, -1, rng) is not None
+        assert util.take_node(nodes) is None
+
+    def test_shuffle_is_permutation(self):
+        xs = [str(i) for i in range(20)]
+        got = util.shuffle_strings(xs, random.Random(1))
+        assert sorted(got) == sorted(xs) and got != xs
+
+
+class TestClock:
+    def test_mock_clock_fires_in_order(self):
+        c = MockClock()
+        fired = []
+        c.after(2.0, lambda: fired.append("b"))
+        c.after(1.0, lambda: fired.append("a"))
+        c.after(9.0, lambda: fired.append("z"))
+        c.advance(2.5)
+        assert fired == ["a", "b"]
+        c.advance(10)
+        assert fired == ["a", "b", "z"]
+
+    def test_cancel(self):
+        c = MockClock()
+        fired = []
+        t = c.after(1.0, lambda: fired.append(1))
+        t.stop()
+        c.advance(2.0)
+        assert fired == []
+
+    def test_timer_scheduled_by_timer_fires_same_advance(self):
+        c = MockClock()
+        fired = []
+        c.after(1.0, lambda: c.after(1.0, lambda: fired.append("inner")))
+        c.advance(3.0)
+        assert fired == ["inner"]
+
+    def test_now_ms(self):
+        c = MockClock(start=12.5)
+        assert c.now_ms() == 12500
+
+
+class TestEvents:
+    def test_emit_and_filter(self):
+        bus = EventEmitter()
+        got = []
+        on(bus, str, got.append)
+        bus.emit("hello")
+        bus.emit(42)  # filtered out
+        assert got == ["hello"]
+
+    def test_deregister(self):
+        bus = EventEmitter()
+        got = []
+        l = on(bus, str, got.append)
+        bus.deregister_listener(l)
+        bus.emit("x")
+        assert got == []
+
+
+class TestDiscovery:
+    def test_static(self):
+        p = StaticHosts("a:1", "b:2")
+        assert p.hosts() == ["a:1", "b:2"]
+
+    def test_jsonfile(self, tmp_path):
+        f = tmp_path / "hosts.json"
+        f.write_text(json.dumps(["a:1", "b:2"]))
+        assert JSONFile(str(f)).hosts() == ["a:1", "b:2"]
+
+    def test_jsonfile_rejects_non_list(self, tmp_path):
+        f = tmp_path / "bad.json"
+        f.write_text('{"not": "a list"}')
+        with pytest.raises(ValueError):
+            JSONFile(str(f)).hosts()
+
+    def test_as_provider_coercions(self, tmp_path):
+        assert as_provider(["a:1"]).hosts() == ["a:1"]
+        assert as_provider(lambda: ["b:2"]).hosts() == ["b:2"]
+        f = tmp_path / "h.json"
+        f.write_text('["c:3"]')
+        assert as_provider(str(f)).hosts() == ["c:3"]
+
+
+class TestLogging:
+    def test_named_levels(self, caplog):
+        fac = rlog.Facility(stdlog.getLogger("test-ringpop"))
+        lg = fac.logger("gossip")
+        with caplog.at_level(stdlog.DEBUG, logger="test-ringpop"):
+            lg.info("dropped")  # default min level is error
+            fac.set_level("gossip", "info")
+            lg.info("kept")
+        assert "kept" in caplog.text and "dropped" not in caplog.text
+
+    def test_with_fields(self):
+        lg = rlog.logger("x").with_field("local", "a:1").with_fields(k=2)
+        assert lg._fields == {"local": "a:1", "k": 2}
+
+    def test_parse_level(self):
+        assert rlog.parse_level("warn") == stdlog.WARNING
+        with pytest.raises(ValueError):
+            rlog.parse_level("nope")
