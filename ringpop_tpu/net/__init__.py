@@ -1,0 +1,19 @@
+from ringpop_tpu.net.channel import (
+    CallError,
+    RemoteError,
+    CallTimeoutError,
+    BaseChannel,
+    TCPChannel,
+    LocalNetwork,
+    LocalChannel,
+)
+
+__all__ = [
+    "CallError",
+    "RemoteError",
+    "CallTimeoutError",
+    "BaseChannel",
+    "TCPChannel",
+    "LocalNetwork",
+    "LocalChannel",
+]

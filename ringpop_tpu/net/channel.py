@@ -1,0 +1,348 @@
+"""Distributed communication backend — JSON-RPC over asyncio TCP.
+
+Parity: the reference's TChannel usage (``shared/interfaces.go``,
+``shared/shared.go:11-22``).  The reference multiplexes three payload formats
+over TChannel subchannels; here one framed JSON transport carries all traffic:
+
+* protocol RPCs (``/protocol/{ping,ping-req,join}`` — json bodies, same
+  schemas as ``swim/ping_sender.go:35-40`` etc.),
+* forwarded app requests (opaque body + headers, the ``tchannel/raw`` path of
+  ``forward/request_sender.go:148-204``),
+* admin endpoints.
+
+Design notes, mirroring reference decisions:
+* transport-level retries are OFF — ringpop does its own retry/backoff
+  (``shared/shared.go:11-22`` disables TChannel retries); a failed call
+  surfaces as :class:`CallError` immediately.
+* handlers are namespaced by (service, endpoint) — the subchannel equivalent
+  (isolated ``ringpop`` subchannel, ``ringpop.go:163``).
+
+Two implementations:
+* :class:`TCPChannel` — real sockets, newline-delimited JSON frames,
+  connection pool per peer, request multiplexing by id.
+* :class:`LocalChannel`/:class:`LocalNetwork` — in-process loopback with
+  first-class fault injection (drop probability, partitions, black holes) —
+  the test-harness analog of the reference's RFC-5737 black-hole addresses
+  (``swim/test_utils.go:219-227``) but deterministic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+from typing import Awaitable, Callable, Optional
+
+Handler = Callable[[dict, dict], Awaitable[dict]]
+
+
+class CallError(Exception):
+    """A call failed to complete (network error, black hole, timeout)."""
+
+
+class CallTimeoutError(CallError):
+    pass
+
+
+class RemoteError(CallError):
+    """The remote handler raised; carries the remote error message."""
+
+
+class BaseChannel:
+    """Handler registry + dispatch shared by both transports."""
+
+    def __init__(self, app: str = ""):
+        self.app = app
+        self.hostport: str = ""
+        self._handlers: dict[tuple[str, str], Handler] = {}
+
+    def register(self, service: str, endpoint: str, handler: Handler) -> None:
+        self._handlers[(service, endpoint)] = handler
+
+    def registered_endpoints(self) -> list[tuple[str, str]]:
+        return sorted(self._handlers)
+
+    async def dispatch(self, service: str, endpoint: str, body: dict, headers: dict) -> dict:
+        handler = self._handlers.get((service, endpoint))
+        if handler is None:
+            raise RemoteError(f"no handler for {service}::{endpoint}")
+        return await handler(body, headers)
+
+    async def call(
+        self,
+        peer: str,
+        service: str,
+        endpoint: str,
+        body: dict,
+        headers: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# TCP transport
+# ---------------------------------------------------------------------------
+
+
+class _PeerConn:
+    """One pooled connection to a peer, multiplexing requests by id."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.pending: dict[int, asyncio.Future] = {}
+        self.next_id = 0
+        self.reader_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    def close(self) -> None:
+        self.closed = True
+        if self.reader_task:
+            self.reader_task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        for fut in self.pending.values():
+            if not fut.done():
+                fut.set_exception(CallError("connection closed"))
+        self.pending.clear()
+
+
+class TCPChannel(BaseChannel):
+    """JSON-over-TCP channel: one listener, pooled outbound connections
+    (parity: TChannel peer pool, ``swim/ping_sender.go:83``)."""
+
+    def __init__(self, app: str = ""):
+        super().__init__(app)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: dict[str, _PeerConn] = {}
+        self._serving_tasks: set[asyncio.Task] = set()
+        self._client_writers: set[asyncio.StreamWriter] = set()
+
+    # -- server side --------------------------------------------------------
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        sock = self._server.sockets[0]
+        addr = sock.getsockname()
+        self.hostport = f"{addr[0]}:{addr[1]}"
+        return self.hostport
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # unblock handler coroutines stuck in readline so wait_closed
+            # (which awaits them since py3.12) can finish
+            for w in list(self._client_writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+        for conn in list(self._conns.values()):
+            conn.close()
+        self._conns.clear()
+        for t in list(self._serving_tasks):
+            t.cancel()
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._client_writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                task = asyncio.ensure_future(self._serve_frame(frame, writer))
+                self._serving_tasks.add(task)
+                task.add_done_callback(self._serving_tasks.discard)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._client_writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _serve_frame(self, frame: dict, writer: asyncio.StreamWriter):
+        res = {"id": frame.get("id"), "kind": "res"}
+        try:
+            body = await self.dispatch(
+                frame.get("svc", ""), frame.get("ep", ""), frame.get("body") or {}, frame.get("headers") or {}
+            )
+            res["ok"] = True
+            res["body"] = body
+        except Exception as e:  # handler error propagates as app error
+            res["ok"] = False
+            res["err"] = str(e)
+        try:
+            writer.write(json.dumps(res).encode() + b"\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+
+    # -- client side --------------------------------------------------------
+
+    async def _get_conn(self, peer: str) -> _PeerConn:
+        conn = self._conns.get(peer)
+        if conn is not None and not conn.closed:
+            return conn
+        host, port = peer.rsplit(":", 1)
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+        except OSError as e:
+            raise CallError(f"connect {peer}: {e}") from e
+        conn = _PeerConn(reader, writer)
+        conn.reader_task = asyncio.ensure_future(self._read_responses(peer, conn))
+        self._conns[peer] = conn
+        return conn
+
+    async def _read_responses(self, peer: str, conn: _PeerConn):
+        try:
+            while True:
+                line = await conn.reader.readline()
+                if not line:
+                    break
+                frame = json.loads(line)
+                fut = conn.pending.pop(frame.get("id"), None)
+                if fut is None or fut.done():
+                    continue
+                if frame.get("ok"):
+                    fut.set_result(frame.get("body") or {})
+                else:
+                    fut.set_exception(RemoteError(frame.get("err", "remote error")))
+        except (ConnectionError, json.JSONDecodeError, asyncio.CancelledError):
+            pass
+        finally:
+            if self._conns.get(peer) is conn:
+                del self._conns[peer]
+            conn.close()
+
+    async def call(self, peer, service, endpoint, body, headers=None, timeout=None) -> dict:
+        conn = await self._get_conn(peer)
+        conn.next_id += 1
+        rid = conn.next_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        conn.pending[rid] = fut
+        frame = {
+            "id": rid,
+            "kind": "req",
+            "svc": service,
+            "ep": endpoint,
+            "body": body,
+            "headers": headers or {},
+        }
+        try:
+            conn.writer.write(json.dumps(frame).encode() + b"\n")
+            await conn.writer.drain()
+        except (ConnectionError, OSError) as e:
+            conn.pending.pop(rid, None)
+            raise CallError(f"send to {peer}: {e}") from e
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            conn.pending.pop(rid, None)
+            raise CallTimeoutError(f"call {peer} {endpoint} timed out after {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# In-process transport with fault injection
+# ---------------------------------------------------------------------------
+
+
+class LocalNetwork:
+    """Registry of in-process channels + fault model.
+
+    Faults are first-class (BASELINE configs list packet-loss and partition
+    scenarios): per-pair partitions, global drop probability, and black-hole
+    addresses that swallow traffic (timeout) instead of refusing it."""
+
+    def __init__(self, seed: int = 0):
+        self.channels: dict[str, "LocalChannel"] = {}
+        self.rng = random.Random(seed)
+        self.drop_rate = 0.0
+        self._partitions: list[set[str]] = []  # node -> group via membership
+        self._black_holes: set[str] = set()
+        self.latency: float = 0.0  # injected per-call delay (seconds)
+
+    def register(self, channel: "LocalChannel") -> None:
+        self.channels[channel.hostport] = channel
+
+    def unregister(self, hostport: str) -> None:
+        self.channels.pop(hostport, None)
+
+    # -- fault injection ----------------------------------------------------
+
+    def partition(self, *groups: list[str]) -> None:
+        """Split the network: nodes in different groups cannot talk."""
+        self._partitions = [set(g) for g in groups]
+
+    def heal_partition(self) -> None:
+        self._partitions = []
+
+    def black_hole(self, *hostports: str) -> None:
+        self._black_holes.update(hostports)
+
+    def unblack_hole(self, *hostports: str) -> None:
+        self._black_holes.difference_update(hostports)
+
+    def _connected(self, a: str, b: str) -> bool:
+        if not self._partitions:
+            return True
+        ga = next((i for i, g in enumerate(self._partitions) if a in g), None)
+        gb = next((i for i, g in enumerate(self._partitions) if b in g), None)
+        # nodes not named in any group can talk to everyone
+        return ga is None or gb is None or ga == gb
+
+    async def deliver(
+        self, src: str, dst: str, service: str, endpoint: str, body: dict, headers: dict, timeout: Optional[float]
+    ) -> dict:
+        if self.latency:
+            await asyncio.sleep(self.latency)
+        if dst in self._black_holes or src in self._black_holes or not self._connected(src, dst):
+            # black hole: behave like a timeout, not a refusal
+            await asyncio.sleep(min(timeout or 0.01, 0.01))
+            raise CallTimeoutError(f"{src}->{dst} black-holed")
+        if self.drop_rate and self.rng.random() < self.drop_rate:
+            await asyncio.sleep(min(timeout or 0.01, 0.01))
+            raise CallTimeoutError(f"{src}->{dst} dropped")
+        target = self.channels.get(dst)
+        if target is None:
+            raise CallError(f"connect {dst}: connection refused")
+        try:
+            res = await target.dispatch(
+                service, endpoint, json.loads(json.dumps(body)), dict(headers)
+            )
+        except CallError:
+            raise
+        except Exception as e:  # remote handler error, as the TCP path reports it
+            raise RemoteError(str(e)) from e
+        return json.loads(json.dumps(res))
+
+
+class LocalChannel(BaseChannel):
+    """In-process channel attached to a LocalNetwork."""
+
+    def __init__(self, network: LocalNetwork, hostport: str, app: str = ""):
+        super().__init__(app)
+        self.network = network
+        self.hostport = hostport
+        network.register(self)
+
+    async def listen(self, host: str = "", port: int = 0) -> str:
+        return self.hostport
+
+    async def close(self) -> None:
+        self.network.unregister(self.hostport)
+
+    async def call(self, peer, service, endpoint, body, headers=None, timeout=None) -> dict:
+        return await self.network.deliver(
+            self.hostport, peer, service, endpoint, body, headers or {}, timeout
+        )

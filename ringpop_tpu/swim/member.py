@@ -36,13 +36,17 @@ TOMBSTONE = 4
 STATE_NAMES = ("alive", "suspect", "faulty", "leave", "tombstone")
 STATE_IDS = {name: i for i, name in enumerate(STATE_NAMES)}
 
+# unknown wire states never take precedence (parity: member.go:124-127
+# statePrecedence returns -1 for unknown states rather than failing)
+UNKNOWN = -1
+
 
 def state_name(state: int) -> str:
-    return STATE_NAMES[state]
+    return STATE_NAMES[state] if 0 <= state < len(STATE_NAMES) else "unknown"
 
 
 def state_id(name: str) -> int:
-    return STATE_IDS[name]
+    return STATE_IDS.get(name, UNKNOWN)
 
 
 def state_precedence(state):
@@ -124,6 +128,11 @@ class Change:
     source: str = ""
     source_incarnation: int = 0
     timestamp: int = 0  # integer Unix seconds (util.Timestamp codec)
+    # original wire string for states we don't recognize: the reference keeps
+    # unknown status strings verbatim (they decode to precedence -1 but
+    # re-serialize unchanged); without this, an int-encoded UNKNOWN would
+    # corrupt into a different state on re-send
+    raw_status: str = ""
 
     def overrides(self, other: "Change") -> bool:
         return bool(
@@ -151,6 +160,8 @@ class Change:
         if status == TOMBSTONE:
             d["status"] = STATE_NAMES[FAULTY]
             d["tombstone"] = True
+        elif status == UNKNOWN:
+            d["status"] = self.raw_status or "unknown"
         else:
             d["status"] = STATE_NAMES[status]
         return d
@@ -169,6 +180,7 @@ class Change:
             source=d.get("source", ""),
             source_incarnation=int(d.get("sourceIncarnationNumber", 0)),
             timestamp=int(d.get("timestamp", 0)),
+            raw_status=d["status"] if status == UNKNOWN else "",
         )
 
 

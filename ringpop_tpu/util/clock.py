@@ -79,9 +79,28 @@ class Clock:
                 if not self._timers or self._timers[0][0] > self.now():
                     break
                 _, _, t = heapq.heappop(self._timers)
-            t.fn()  # outside the lock: fn may schedule more timers
+            try:
+                t.fn()  # outside the lock: fn may schedule more timers
+            except Exception:  # one bad callback must not kill the pump
+                import logging
+
+                logging.getLogger("ringpop").exception("timer callback raised")
             fired += 1
         return fired
+
+
+async def drive_clock(clock: Clock, max_poll: float = 0.05) -> None:
+    """Asyncio pump for a real Clock: sleeps until the next deadline (capped
+    at ``max_poll`` so newly scheduled earlier timers are picked up) and
+    fires due timers.  The production counterpart of MockClock.advance."""
+    import asyncio
+
+    while True:
+        nd = clock.next_deadline()
+        now = clock.now()
+        delay = max_poll if nd is None else min(max(nd - now, 0.0), max_poll)
+        await asyncio.sleep(delay)
+        clock.fire_due()
 
 
 class MockClock(Clock):

@@ -1,0 +1,99 @@
+"""Multi-node in-process test harness (model: reference
+``swim/test_utils.go`` — real channels on loopback, mock clocks, and the
+synchronous-drive trick: tick every node's protocol period in a loop until no
+disseminator changes remain and all checksums agree,
+``test_utils.go:164-199``)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ringpop_tpu.net import LocalNetwork, LocalChannel
+from ringpop_tpu.swim.node import BootstrapOptions, Node, NodeOptions
+from ringpop_tpu.swim.state_transitions import StateTimeouts
+from ringpop_tpu.util.clock import MockClock
+
+# the reference uses RFC-5737 TEST-NET-1 for unroutable fakes
+# (test_utils.go:219-227); LocalNetwork black-holes work the same way
+FAKE_HOST = "192.0.2.{}:3000"
+
+
+def fake_hostports(n: int) -> list[str]:
+    return [FAKE_HOST.format(i) for i in range(1, n + 1)]
+
+
+def make_node(
+    network: LocalNetwork,
+    address: str,
+    app: str = "test",
+    seed: int = 0,
+    suspect_timeout: float = 5.0,
+) -> Node:
+    channel = LocalChannel(network, address, app=app)
+    clock = MockClock(start=1_000_000.0)
+    opts = NodeOptions(
+        clock=clock,
+        seed=seed,
+        state_timeouts=StateTimeouts(suspect=suspect_timeout),
+    )
+    return Node(app, address, channel, opts)
+
+
+def make_nodes(n: int, network: Optional[LocalNetwork] = None, app: str = "test") -> list[Node]:
+    network = network or LocalNetwork()
+    return [
+        make_node(network, f"127.0.0.1:{3000 + i}", app=app, seed=1000 + i) for i in range(n)
+    ]
+
+
+async def bootstrap_nodes(nodes: list[Node], stop_gossip: bool = True) -> None:
+    hosts = [n.address for n in nodes]
+
+    async def boot(node: Node):
+        await node.bootstrap(BootstrapOptions(discover_provider=hosts, join_timeout=0.5))
+        if stop_gossip:
+            # tests drive the protocol synchronously (reference trick)
+            node.gossip.stop()
+            node.healer.stop()
+
+    await asyncio.gather(*(boot(n) for n in nodes))
+
+
+async def tick_all(nodes: list[Node], advance: float = 0.001) -> None:
+    """One protocol period on every node; clocks advance slightly so
+    reincarnation bumps are strictly increasing."""
+    for node in nodes:
+        node.clock.advance(advance)
+        await node.gossip.protocol_period()
+    # drain reverse-full-sync tasks and other spawned work
+    for _ in range(3):
+        await asyncio.sleep(0)
+
+
+async def wait_for_convergence(nodes: list[Node], max_ticks: int = 200) -> int:
+    """(model: ``test_utils.go:164-199`` waitForConvergence)"""
+    for tick in range(max_ticks):
+        if converged(nodes):
+            return tick
+        await tick_all(nodes)
+    raise AssertionError(
+        f"no convergence after {max_ticks} ticks; checksums="
+        f"{[n.memberlist.checksum() for n in nodes]} "
+        f"changes={[n.disseminator.changes_count() for n in nodes]}"
+    )
+
+
+def converged(nodes: list[Node]) -> bool:
+    if any(n.disseminator.has_changes() for n in nodes):
+        return False
+    checksums = {n.memberlist.checksum() for n in nodes}
+    return len(checksums) == 1
+
+
+def member_statuses(node: Node) -> dict[str, int]:
+    return {m.address: m.status for m in node.memberlist.get_members()}
+
+
+def run(coro):
+    return asyncio.run(coro)

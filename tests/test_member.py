@@ -104,3 +104,15 @@ def test_wire_faulty_without_flag_stays_faulty():
     d = Change(address="a:1", incarnation=1, status=FAULTY).to_wire()
     assert "tombstone" not in d
     assert Change.from_wire(d).status == FAULTY
+
+
+def test_unknown_wire_status_roundtrips_verbatim():
+    # unknown states decode to precedence -1 but must re-serialize unchanged
+    # (the reference keeps the string verbatim; member.go:124-127)
+    d = {"address": "a:1", "incarnationNumber": 7, "status": "weird-future-state"}
+    c = Change.from_wire(d)
+    assert c.status == -1
+    assert not bool(is_reachable(c.status))
+    assert c.to_wire()["status"] == "weird-future-state"
+    # and it never overrides anything
+    assert not overrides(c.incarnation, c.status, 7, ALIVE)

@@ -1,0 +1,320 @@
+"""Tier-2 functional tests: multi-node in-process clusters driven
+synchronously (model: reference swim package tests + test_utils.go)."""
+
+import asyncio
+
+import pytest
+
+from ringpop_tpu.net import CallError, LocalNetwork
+from ringpop_tpu.swim.member import ALIVE, FAULTY, LEAVE, SUSPECT, TOMBSTONE
+from ringpop_tpu.swim.join import send_join_request
+
+from swim_utils import (
+    bootstrap_nodes,
+    converged,
+    make_node,
+    make_nodes,
+    member_statuses,
+    run,
+    tick_all,
+    wait_for_convergence,
+)
+
+
+def test_two_node_bootstrap_converges():
+    async def main():
+        nodes = make_nodes(2)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+        for n in nodes:
+            assert n.member_count() == 2
+            assert all(s == ALIVE for s in member_statuses(n).values())
+        assert nodes[0].memberlist.checksum() == nodes[1].memberlist.checksum()
+
+    run(main())
+
+
+def test_five_node_cluster_converges():
+    async def main():
+        nodes = make_nodes(5)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+        for n in nodes:
+            assert n.member_count() == 5
+            assert n.count_reachable_members() == 5
+
+    run(main())
+
+
+def test_single_node_cluster_shortcut():
+    async def main():
+        nodes = make_nodes(1)
+        await bootstrap_nodes(nodes)
+        assert nodes[0].ready()
+        assert nodes[0].member_count() == 1
+
+    run(main())
+
+
+def test_suspect_declaration_disseminates():
+    async def main():
+        nodes = make_nodes(4)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        victim = nodes[3]
+        declarer = nodes[0]
+        member = declarer.memberlist.member(victim.address)
+        # black-hole the victim so it cannot refute
+        nodes[0].channel.network.black_hole(victim.address)
+        declarer.memberlist.make_suspect(victim.address, member.incarnation)
+        assert member_statuses(declarer)[victim.address] == SUSPECT
+
+        others = nodes[:3]
+        for _ in range(30):
+            await tick_all(others)
+            if all(member_statuses(n)[victim.address] == SUSPECT for n in others):
+                break
+        for n in others:
+            assert member_statuses(n)[victim.address] == SUSPECT
+
+    run(main())
+
+
+def test_refutation_on_suspect():
+    async def main():
+        nodes = make_nodes(3)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        victim = nodes[2]
+        old_inc = victim.incarnation()
+        member = nodes[0].memberlist.member(victim.address)
+        nodes[0].memberlist.make_suspect(victim.address, member.incarnation)
+
+        # gossip until the victim hears the rumor and refutes
+        for _ in range(30):
+            await tick_all(nodes)
+            if victim.incarnation() > old_inc:
+                break
+        assert victim.incarnation() > old_inc
+        assert member_statuses(victim)[victim.address] == ALIVE
+
+        await wait_for_convergence(nodes)
+        for n in nodes:
+            assert member_statuses(n)[victim.address] == ALIVE
+
+    run(main())
+
+
+def test_failure_detection_black_hole_to_suspect():
+    async def main():
+        network = LocalNetwork()
+        nodes = make_nodes(4, network)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        victim = nodes[3]
+        network.black_hole(victim.address)
+        alive = nodes[:3]
+
+        # pings + ping-reqs fail -> suspect
+        for _ in range(40):
+            await tick_all(alive)
+            if all(member_statuses(n)[victim.address] == SUSPECT for n in alive):
+                break
+        for n in alive:
+            assert member_statuses(n)[victim.address] == SUSPECT
+
+        # suspect period (5s) passes -> faulty
+        for n in alive:
+            n.clock.advance(6.0)
+        for n in alive:
+            assert member_statuses(n)[victim.address] == FAULTY
+
+    run(main())
+
+
+def test_faulty_node_rejoins_and_recovers():
+    async def main():
+        network = LocalNetwork()
+        nodes = make_nodes(3, network)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        victim = nodes[2]
+        network.black_hole(victim.address)
+        alive = nodes[:2]
+        for _ in range(40):
+            await tick_all(alive)
+            if all(member_statuses(n)[victim.address] == SUSPECT for n in alive):
+                break
+        for n in alive:
+            n.clock.advance(6.0)
+        assert member_statuses(alive[0])[victim.address] == FAULTY
+
+        # network comes back; victim reasserts itself by gossiping
+        network.unblack_hole(victim.address)
+        victim.memberlist.reincarnate()
+        for _ in range(60):
+            await tick_all(nodes)
+            if converged(nodes) and all(
+                member_statuses(n)[victim.address] == ALIVE for n in nodes
+            ):
+                break
+        for n in nodes:
+            assert member_statuses(n)[victim.address] == ALIVE
+
+    run(main())
+
+
+def test_join_rejects_self_and_wrong_app():
+    async def main():
+        network = LocalNetwork()
+        a = make_node(network, "127.0.0.1:3000", app="appA")
+        b = make_node(network, "127.0.0.1:3001", app="appB")
+        await bootstrap_nodes([a], stop_gossip=True)
+        await bootstrap_nodes([b], stop_gossip=True)
+
+        with pytest.raises(CallError, match="app"):
+            await send_join_request(b, a.address, 0.5)
+
+        # self-join rejected server-side
+        with pytest.raises(CallError, match="itself"):
+            body = {
+                "app": "appA",
+                "source": a.address,
+                "incarnationNumber": 1,
+                "timeout": 0.5,
+            }
+            await a.channel.call(a.address, "ringpop", "/protocol/join", body, timeout=0.5)
+
+    run(main())
+
+
+def test_full_sync_repairs_divergence():
+    async def main():
+        nodes = make_nodes(2)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        # create divergence by fiat: apply a member only on node 0 and clear
+        # its dissemination so only a checksum mismatch remains
+        from ringpop_tpu.swim.member import Change
+
+        ghost = Change(address="127.0.0.1:9999", incarnation=1, status=ALIVE, source="fiat")
+        nodes[0].memberlist.update([ghost])
+        nodes[0].disseminator.clear_changes()
+        assert nodes[0].memberlist.checksum() != nodes[1].memberlist.checksum()
+
+        # a ping from 0 to 1 carries no changes but mismatched checksum ->
+        # node 1 answers with a full sync
+        await wait_for_convergence(nodes)
+        assert nodes[1].memberlist.member("127.0.0.1:9999") is not None
+
+    run(main())
+
+
+def test_state_transition_chain_to_eviction():
+    async def main():
+        network = LocalNetwork()
+        nodes = make_nodes(3, network)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        victim = nodes[2]
+        network.black_hole(victim.address)
+        watcher = nodes[0]
+        m = watcher.memberlist.member(victim.address)
+        watcher.memberlist.make_suspect(victim.address, m.incarnation)
+
+        watcher.clock.advance(6.0)  # suspect(5s) -> faulty
+        assert member_statuses(watcher)[victim.address] == FAULTY
+        watcher.clock.advance(24 * 3600 + 1)  # faulty(24h) -> tombstone
+        assert member_statuses(watcher)[victim.address] == TOMBSTONE
+        watcher.clock.advance(61)  # tombstone(60s) -> evicted
+        assert watcher.memberlist.member(victim.address) is None
+
+    run(main())
+
+
+def test_admin_handlers():
+    async def main():
+        nodes = make_nodes(2)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+        a, b = nodes
+
+        # /admin/tick drives one protocol period remotely
+        res = await a.channel.call(b.address, "ringpop", "/admin/tick", {}, timeout=1.0)
+        assert res["checksum"] == b.memberlist.checksum()
+
+        # /admin/member/leave declares leave; node stays in the member table
+        res = await a.channel.call(b.address, "ringpop", "/admin/member/leave", {}, timeout=1.0)
+        assert res["status"] == "ok"
+        assert member_statuses(b)[b.address] == LEAVE
+
+        # /admin/member/join reincarnates (advance time so the new wall-ms
+        # incarnation strictly exceeds the one the leave was declared at)
+        b.clock.advance(0.1)
+        res = await a.channel.call(b.address, "ringpop", "/admin/member/join", {}, timeout=1.0)
+        assert res["status"] == "rejoined"
+        assert member_statuses(b)[b.address] == ALIVE
+
+        # reap: faulty -> tombstone
+        m = b.memberlist.member(a.address)
+        b.memberlist.make_faulty(a.address, m.incarnation)
+        await a.channel.call(b.address, "ringpop", "/admin/reap", {}, timeout=1.0)
+        assert member_statuses(b)[a.address] == TOMBSTONE
+
+    run(main())
+
+
+def test_leave_rejoin_cycle_via_gossip():
+    async def main():
+        nodes = make_nodes(3)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+
+        leaver = nodes[2]
+        leaver.memberlist.make_leave(leaver.address, leaver.incarnation())
+        await wait_for_convergence(nodes)
+        for n in nodes[:2]:
+            assert member_statuses(n)[leaver.address] == LEAVE
+
+        leaver.memberlist.reincarnate()
+        await wait_for_convergence(nodes)
+        for n in nodes:
+            assert member_statuses(n)[leaver.address] == ALIVE
+
+    run(main())
+
+
+def test_packet_loss_still_converges():
+    async def main():
+        network = LocalNetwork(seed=7)
+        network.drop_rate = 0.05  # BASELINE config: 5% loss scenario
+        nodes = make_nodes(5, network)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes, max_ticks=400)
+        for n in nodes:
+            assert n.count_reachable_members() == 5
+
+    run(main())
+
+
+def test_first_seen_tombstone_is_refused():
+    # an evicted tombstone arriving via full sync must not be re-imported
+    # (parity: memberlist.go:421-426)
+    async def main():
+        nodes = make_nodes(2)
+        await bootstrap_nodes(nodes)
+        await wait_for_convergence(nodes)
+        from ringpop_tpu.swim.member import Change, TOMBSTONE as TS
+
+        ghost = Change(address="127.0.0.1:9998", incarnation=1, status=TS, source="fiat")
+        applied = nodes[0].memberlist.update([ghost])
+        assert applied == []
+        assert nodes[0].memberlist.member("127.0.0.1:9998") is None
+
+    run(main())
