@@ -1,0 +1,23 @@
+"""Sentinel errors (parity: reference ``errors.go:27-35``)."""
+
+
+class RingpopError(Exception):
+    pass
+
+
+class NotBootstrappedError(RingpopError):
+    """(parity: ErrNotBootstrapped)"""
+
+    def __str__(self) -> str:
+        return "ringpop is not bootstrapped"
+
+
+class EphemeralIdentityError(RingpopError):
+    """(parity: ErrEphemeralIdentity) — port 0 identities cannot be gossiped."""
+
+    def __str__(self) -> str:
+        return "cannot get ringpop identity from ephemeral port"
+
+
+class InvalidStateError(RingpopError):
+    pass

@@ -1,0 +1,19 @@
+from ringpop_tpu.forward.forwarder import (
+    Forwarder,
+    Options,
+    Sender,
+    FORWARDED_HEADER,
+    set_forwarded_header,
+    has_forwarded_header,
+)
+from ringpop_tpu.forward.request_sender import DestinationsDivergedError
+
+__all__ = [
+    "Forwarder",
+    "Options",
+    "Sender",
+    "FORWARDED_HEADER",
+    "set_forwarded_header",
+    "has_forwarded_header",
+    "DestinationsDivergedError",
+]

@@ -1,0 +1,59 @@
+"""Key→client routing cache (parity: reference ``router/router.go``).
+
+``get_client(key)`` looks the key up on the ring and returns either the local
+service implementation or a cached remote client for the owner; cached
+clients are evicted when the owner becomes Faulty/Leave
+(``router/router.go:70-84``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Protocol
+
+from ringpop_tpu.swim import events as swim_ev
+from ringpop_tpu.swim.member import FAULTY, LEAVE
+
+
+class ClientFactory(Protocol):
+    """(parity: ``router/router.go:47-54``)"""
+
+    def get_local_client(self) -> Any: ...
+
+    def make_remote_client(self, hostport: str) -> Any: ...
+
+
+class Router:
+    def __init__(self, ringpop, factory: ClientFactory):
+        self.ringpop = ringpop
+        self.factory = factory
+        self._cache: dict[str, Any] = {}
+        self._lock = threading.RLock()
+        ringpop.register_listener(self)
+
+    def handle_event(self, event) -> None:
+        """Evict cached clients for members that became unusable
+        (parity: ``router/router.go:70-84``)."""
+        if isinstance(event, swim_ev.MemberlistChangesAppliedEvent):
+            for change in event.changes:
+                if change.status in (FAULTY, LEAVE):
+                    self.remove_client(change.address)
+
+    def get_client(self, key: str) -> tuple[Any, bool]:
+        """(client, is_local) for the owner of ``key``
+        (parity: ``router/router.go:88-133`` GetClient)."""
+        dest = self.ringpop.lookup(key)
+        me = self.ringpop.who_am_i()
+        with self._lock:
+            client = self._cache.get(dest)
+            if client is not None:
+                return client, dest == me
+            if dest == me:
+                client = self.factory.get_local_client()
+            else:
+                client = self.factory.make_remote_client(dest)
+            self._cache[dest] = client
+            return client, dest == me
+
+    def remove_client(self, hostport: str) -> None:
+        with self._lock:
+            self._cache.pop(hostport, None)

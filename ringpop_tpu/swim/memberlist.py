@@ -238,9 +238,11 @@ class Memberlist:
 
     def make_change(self, address: str, incarnation: int, status: int) -> list[Change]:
         if self.local is None:
+            # standalone identity only — NOT inserted into the table, so the
+            # self change below flows through the first-seen path of update()
+            # and is emitted/applied like any other (parity:
+            # memberlist.go:433-446: Apply inserts and binds m.local)
             self.local = Member(self.node.address, ALIVE, util.now_ms(self.node.clock))
-            self._members.append(self.local)
-            self._by_address[self.node.address] = self.local
         return self.update(
             [
                 Change(

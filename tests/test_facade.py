@@ -1,0 +1,194 @@
+"""Facade tests (model: reference ringpop_test.go RingpopTestSuite — mocked
+components where useful, real in-process clusters elsewhere)."""
+
+import asyncio
+
+import pytest
+
+from ringpop_tpu.errors import EphemeralIdentityError, NotBootstrappedError
+from ringpop_tpu.net import LocalChannel, LocalNetwork
+from ringpop_tpu.options import InMemoryStats, Options
+from ringpop_tpu.ringpop import Ringpop, State
+from ringpop_tpu.swim.node import BootstrapOptions
+from ringpop_tpu.util.clock import MockClock
+
+from swim_utils import run, tick_all, converged
+
+
+def make_ringpop(network, hostport, app="rp-test", stats=None, seed=0):
+    ch = LocalChannel(network, hostport, app=app)
+    opts = Options(stats_reporter=stats, clock=MockClock(1e6), seed=seed)
+    return Ringpop(app, ch, opts)
+
+
+async def boot_cluster(n=3, app="rp-test", stats_for_first=None):
+    network = LocalNetwork()
+    rps = [
+        make_ringpop(
+            network,
+            f"127.0.0.1:{4000 + i}",
+            app=app,
+            stats=stats_for_first if i == 0 else None,
+            seed=i,
+        )
+        for i in range(n)
+    ]
+    hosts = [f"127.0.0.1:{4000 + i}" for i in range(n)]
+
+    async def boot(rp):
+        await rp.bootstrap(BootstrapOptions(discover_provider=hosts, join_timeout=0.5))
+        rp.node.gossip.stop()
+        rp.node.healer.stop()
+
+    await asyncio.gather(*(boot(rp) for rp in rps))
+    nodes = [rp.node for rp in rps]
+    for _ in range(60):
+        await tick_all(nodes)
+        if converged(nodes):
+            break
+    return network, rps
+
+
+def test_lifecycle_states():
+    async def main():
+        network = LocalNetwork()
+        rp = make_ringpop(network, "127.0.0.1:4000")
+        assert rp.state == State.CREATED
+        with pytest.raises(NotBootstrappedError):
+            rp.lookup("k")
+        await rp.bootstrap(BootstrapOptions(discover_provider=["127.0.0.1:4000"]))
+        assert rp.state == State.READY
+        assert rp.ready()
+        assert rp.who_am_i() == "127.0.0.1:4000"
+        assert rp.app() == "rp-test"
+        assert rp.uptime() >= 0
+        rp.destroy()
+        assert rp.state == State.DESTROYED
+
+    run(main())
+
+
+def test_ephemeral_identity_refused():
+    network = LocalNetwork()
+    ch = LocalChannel(network, "127.0.0.1:0")
+    rp = Ringpop("x", ch, Options(clock=MockClock()))
+    with pytest.raises(EphemeralIdentityError):
+        rp._init()
+
+
+def test_channel_required():
+    with pytest.raises(ValueError):
+        Ringpop("x", None)
+
+
+def test_membership_drives_ring():
+    async def main():
+        network, rps = await boot_cluster(3)
+        for rp in rps:
+            assert sorted(rp.ring.servers()) == sorted(r.who_am_i() for r in rps)
+        # all rings agree -> same checksum
+        assert len({rp.checksum() for rp in rps}) == 1
+
+        # faulty member leaves the ring
+        victim = rps[2]
+        m = rps[0].node.memberlist.member(victim.who_am_i())
+        rps[0].node.memberlist.make_faulty(victim.who_am_i(), m.incarnation)
+        assert victim.who_am_i() not in rps[0].ring.servers()
+
+    run(main())
+
+
+def test_lookup_consistent_across_nodes():
+    async def main():
+        network, rps = await boot_cluster(3)
+        for key in ("alpha", "beta", "gamma", "delta"):
+            owners = {rp.lookup(key) for rp in rps}
+            assert len(owners) == 1  # everyone agrees
+        dests = rps[0].lookup_n("alpha", 2)
+        assert len(dests) == 2 and len(set(dests)) == 2
+
+    run(main())
+
+
+def test_handle_or_forward_routes_to_owner():
+    async def main():
+        network, rps = await boot_cluster(3)
+        service, endpoint = "rp-test", "/app/echo"
+
+        # register an app endpoint on every node that reports who served it
+        for rp in rps:
+            me = rp.who_am_i()
+
+            async def echo(body, headers, me=me):
+                return {"served_by": me, "payload": body.get("payload")}
+
+            rp.channel.register(service, endpoint, echo)
+
+        key = "some-key"
+        owner = rps[0].lookup(key)
+        # pick a caller that does NOT own the key
+        caller = next(rp for rp in rps if rp.who_am_i() != owner)
+
+        handled, res = await caller.handle_or_forward(
+            key, {"payload": 42}, service, endpoint
+        )
+        assert not handled
+        assert res == {"served_by": owner, "payload": 42}
+
+        # the owner itself is told to handle locally
+        owner_rp = next(rp for rp in rps if rp.who_am_i() == owner)
+        handled, res = await owner_rp.handle_or_forward(key, {}, service, endpoint)
+        assert handled and res is None
+
+    run(main())
+
+
+def test_stats_emitted():
+    async def main():
+        stats = InMemoryStats()
+        network, rps = await boot_cluster(2, stats_for_first=stats)
+        rps[0].lookup("k")
+        prefix = f"ringpop.{rps[0].who_am_i().replace(':', '_').replace('.', '_')}."
+        assert any(k.startswith(prefix + "lookup") for k in stats.timers)
+        assert any(k.startswith(prefix + "ping.send") for k in stats.counters)
+        assert prefix + "ring.server-added" in stats.counters
+
+    run(main())
+
+
+def test_admin_endpoints():
+    async def main():
+        network, rps = await boot_cluster(2)
+        client = LocalChannel(network, "127.0.0.1:9999")
+        target = rps[0].who_am_i()
+
+        res = await client.call(target, "ringpop", "/health", {}, timeout=1.0)
+        assert res == {"ok": True}
+
+        res = await client.call(target, "ringpop", "/admin/lookup", {"key": "k"}, timeout=1.0)
+        assert res["dest"] == rps[0].lookup("k")
+
+        res = await client.call(target, "ringpop", "/admin/stats", {}, timeout=1.0)
+        assert res["state"] == "ready"
+        assert len(res["membership"]["members"]) == 2
+        assert sorted(res["ring"]["servers"]) == sorted(r.who_am_i() for r in rps)
+        assert res["protocol"]["timing"]["count"] >= 1
+
+    run(main())
+
+
+def test_periodic_checksum_stat_timers():
+    async def main():
+        stats = InMemoryStats()
+        network, rps = await boot_cluster(2, stats_for_first=stats)
+        rp = rps[0]
+        prefix = f"ringpop.{rp.who_am_i().replace(':', '_').replace('.', '_')}."
+        # advance the mock clock past the stat period; timers fire and renew
+        rp.node.clock.advance(5.5)
+        assert prefix + "membership.checksum-periodic" in stats.gauges
+        assert prefix + "ring.checksum-periodic" in stats.gauges
+        before = stats.gauges[prefix + "membership.checksum-periodic"]
+        rp.node.clock.advance(5.5)  # fires again (renewed timer)
+        assert stats.gauges[prefix + "membership.checksum-periodic"] == before
+
+    run(main())
