@@ -1,0 +1,65 @@
+"""Benchmark: million-node SWIM dissemination on one chip.
+
+North star (BASELINE.json): simulate 1M-node SWIM convergence < 60 s.  This
+bench runs the delta engine — 1M nodes, 128 concurrent rumors — until every
+rumor reaches every node, and reports wall-clock seconds with
+``vs_baseline = 60 / measured`` (>1 beats the target).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from ringpop_tpu.sim.delta import DeltaParams, DeltaSim, init_state, run_until_converged
+
+    platform = jax.devices()[0].platform
+    # full scale on an accelerator; CPU fallback keeps CI fast
+    if platform in ("tpu", "axon") or os.environ.get("BENCH_FULL"):
+        n, k = 1_000_000, 128
+    else:
+        n, k = 50_000, 64
+
+    sim = DeltaSim(n=n, k=k, seed=0)
+
+    # compile + warm up one step so the measurement is steady-state
+    t_compile = time.perf_counter()
+    sim.tick()
+    jax.block_until_ready(sim.state.learned)
+    compile_s = time.perf_counter() - t_compile
+
+    # fresh state, timed convergence run
+    sim.state = init_state(sim.params, seed=1)
+    t0 = time.perf_counter()
+    state, ticks, ok = run_until_converged(sim.params, sim.state, max_ticks=4096)
+    jax.block_until_ready(state.learned)
+    elapsed = time.perf_counter() - t0
+
+    baseline_s = 60.0  # BASELINE.json north star
+    result = {
+        "metric": f"swim_sim_convergence_n{n}",
+        "value": round(elapsed, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / elapsed, 2) if elapsed > 0 else 0.0,
+        "converged": ok,
+        "ticks": ticks,
+        "ticks_per_s": round(ticks / elapsed, 1) if elapsed > 0 else 0.0,
+        "n_nodes": n,
+        "n_rumors": k,
+        "compile_s": round(compile_s, 2),
+        "platform": platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from ringpop_tpu.ops.ring_ops import ring_lookup, ring_lookup_n, build_ring_tokens
+
+__all__ = ["ring_lookup", "ring_lookup_n", "build_ring_tokens"]

@@ -1,0 +1,68 @@
+"""Device-side consistent-ring lookup ops.
+
+The host ring (``ringpop_tpu.hashring``) answers scalar lookups; these jnp
+ops answer *batched* lookups on-device — millions of keys per call against a
+million-vnode ring, which the reference's pointer-chasing red-black tree
+(``hashring/rbtree.go``) fundamentally cannot do.
+
+``searchsorted`` over the sorted token array is O(log T) per key and
+vectorizes onto the TPU; key hashes are computed host-side with the batch
+FarmHash (``ringpop_tpu.hashing``) or come from any uint32 source.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.hashing.farm import fingerprint32_batch, pack_strings
+
+
+def build_ring_tokens(servers: list[str], replica_points: int = 100):
+    """Host-side construction of the (tokens, owners) arrays for a server
+    list — same hash/replica scheme as the host ring
+    (``hashring.go:148-154``)."""
+    all_strings = [f"{s}{i}" for s in servers for i in range(replica_points)]
+    mat, lens = pack_strings(all_strings)
+    toks = fingerprint32_batch(mat, lens).astype(np.uint32)
+    owners = np.repeat(np.arange(len(servers), dtype=np.int32), replica_points)
+    composite = toks.astype(np.uint64) << np.uint64(32) | owners.astype(np.uint64)
+    order = np.argsort(composite, kind="stable")
+    return jnp.asarray(toks[order]), jnp.asarray(owners[order])
+
+
+@jax.jit
+def ring_lookup(tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array) -> jax.Array:
+    """Owner index for each key hash: first token >= hash, wrapping to 0
+    (parity: ``hashring.go:279-301`` walk semantics)."""
+    idx = jnp.searchsorted(tokens, key_hashes, side="left")
+    idx = jnp.where(idx == tokens.shape[0], 0, idx)
+    return owners[idx]
+
+
+def ring_lookup_n(tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array, n: int, num_servers: int) -> jax.Array:
+    """First ``n`` *unique* owners walking the ring upward per key.
+
+    Scans a bounded window of ``w`` consecutive tokens (w chosen so that
+    missing n distinct owners in w replica slots is vanishingly unlikely at
+    100 vnodes/server); returns int32[B, n] owner ids, -1 padded."""
+    w = max(4 * n, 16)
+    b = key_hashes.shape[0]
+    start = jnp.searchsorted(tokens, key_hashes, side="left")
+    offs = (start[:, None] + jnp.arange(w)[None, :]) % tokens.shape[0]
+    cand = owners[offs].astype(jnp.int32)  # [B, w]
+
+    # first occurrence of each owner along the walk
+    eq = cand[:, :, None] == cand[:, None, :]  # [B, i, j]
+    prior = eq & (jnp.arange(w)[None, None, :] < jnp.arange(w)[None, :, None])
+    first_seen = ~prior.any(axis=2)
+
+    # rank among first-seen owners, jit-safe scatter into slot `rank`
+    rank = jnp.cumsum(first_seen, axis=1) - 1
+    take = first_seen & (rank < n)
+    slot = jnp.where(take, rank, n)  # overflow slot n is sliced away
+    b_idx = jnp.broadcast_to(jnp.arange(b)[:, None], cand.shape)
+    out = jnp.full((b, n + 1), -1, dtype=jnp.int32)
+    out = out.at[b_idx, slot].set(jnp.where(take, cand, -1))
+    return out[:, :n]

@@ -1,0 +1,7 @@
+from ringpop_tpu.parallel.mesh import (
+    make_mesh,
+    shard_delta_state,
+    sharded_delta_step,
+)
+
+__all__ = ["make_mesh", "shard_delta_state", "sharded_delta_step"]

@@ -1,0 +1,68 @@
+"""Device-mesh sharding for the sim plane.
+
+The reference scales by running more processes connected over TChannel
+(§2.8 of SURVEY.md); the sim plane scales by sharding the cluster-state
+arrays over a ``jax.sharding.Mesh`` and letting GSPMD insert the
+collectives:
+
+* ``DeltaState.learned/pcount [N, K]`` shard as ``("node", "rumor")`` — a 2D
+  mesh: node-axis data parallelism × rumor-axis model parallelism.
+* the per-tick cross-shard traffic is the ping scatter/gather
+  (``segment_max`` by target + row gather), which XLA lowers to
+  all-to-all/all-gather over ICI — the message-exchange analog of the
+  reference's peer-to-peer RPC fabric.
+
+This is annotate-and-let-XLA-partition (the scaling-book recipe), not
+hand-written collectives: the same jitted ``step`` runs single-chip or on a
+v5e-8 unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ringpop_tpu.sim.delta import DeltaParams, DeltaState, step
+
+
+def make_mesh(n_devices: Optional[int] = None, shape: Optional[tuple[int, int]] = None) -> Mesh:
+    """2D ("node", "rumor") mesh over the first ``n_devices`` devices.
+    Default shape puts most parallelism on the node axis."""
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if shape is None:
+        rumor = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+        shape = (n_devices // rumor, rumor)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=("node", "rumor"))
+
+
+def delta_shardings(mesh: Mesh) -> DeltaState:
+    """PartitionSpecs for each DeltaState leaf."""
+    return DeltaState(
+        learned=NamedSharding(mesh, P("node", "rumor")),
+        pcount=NamedSharding(mesh, P("node", "rumor")),
+        tick=NamedSharding(mesh, P()),
+        key=NamedSharding(mesh, P()),
+    )
+
+
+def shard_delta_state(state: DeltaState, mesh: Mesh) -> DeltaState:
+    sh = delta_shardings(mesh)
+    return jax.tree.map(jax.device_put, state, sh)
+
+
+def sharded_delta_step(params: DeltaParams, mesh: Mesh):
+    """Jitted step with explicit in/out shardings over the mesh."""
+    sh = delta_shardings(mesh)
+    return jax.jit(
+        functools.partial(step, params),
+        in_shardings=(sh,),
+        out_shardings=sh,
+    )

@@ -1,0 +1,28 @@
+"""TPU sim plane — whole simulated clusters as dense JAX arrays.
+
+The reference runs one goroutine-driven protocol loop per process
+(``swim/gossip.go:151``); simulating big clusters means big fleets.  Here the
+*entire cluster* is one pytree and one jitted ``step`` advances every node's
+protocol period at once:
+
+* :mod:`ringpop_tpu.sim.fullview` — exact semantics, O(N²) state
+  (``view[i, j]`` = node i's belief about node j): ping targeting, piggyback
+  dissemination with SWIM's maxP bound, override/refutation rules, indirect
+  ping-req probes, suspicion timers, full sync — the host plane's behavior,
+  vectorized.  The override rule is a join-semilattice max over
+  ``(incarnation, precedence)``, which is exactly why concurrent change
+  application vectorizes as an elementwise/segment max without order effects.
+
+* :mod:`ringpop_tpu.sim.delta` — scalable dissemination engine, O(N·K)
+  state for K in-flight changes over a converged base — runs 1M+ nodes on
+  one chip and shards over a mesh for more.
+
+Fault injection is first-class: partition group arrays, per-edge drop
+probability, process-liveness masks — plain arrays applied to the message
+exchange step (BASELINE.json's 5% loss / 30% partition configs).
+"""
+
+from ringpop_tpu.sim.fullview import FullViewSim, FullViewParams
+from ringpop_tpu.sim.delta import DeltaSim, DeltaParams
+
+__all__ = ["FullViewSim", "FullViewParams", "DeltaSim", "DeltaParams"]

@@ -1,0 +1,187 @@
+"""Scalable delta-dissemination simulator: O(N·K) state for million-node
+clusters.
+
+A full per-node view is O(N²) — 1M nodes would need 1TB.  But a SWIM view is
+``converged base ⊔ set of applied changes``, and because change application
+is a lattice max (order-independent — see ``ringpop_tpu.swim.member``), a
+node's view is EXACTLY determined by *which* of the K in-flight changes it
+has learned.  So the cluster state compresses to:
+
+* a change table (member, incarnation, status) × K — the rumors in flight;
+* ``learned[N, K]``  — which rumors each node has absorbed;
+* ``pcount[N, K]``   — per-node piggyback counters with the SWIM maxP bound
+  (``disseminator.go:75-97``).
+
+One tick: every node pings one peer (fault-masked), rumors ride both legs of
+the exchange (request via scatter-or = ``segment_max``, response via gather),
+counters bump, expired rumors stop riding.  Convergence = every live node has
+learned every rumor — the million-node analog of "all checksums agree".
+
+This is the benchmark engine (BASELINE north star: 1M-node convergence
+< 60s).  Failure-detection *dynamics* (probe → suspect → timers → refute)
+live in the exact O(N²) engine (``fullview``); here rumors are injected,
+matching the reference's dissemination-bound analysis (the SWIM paper's
+infection model).
+
+Sharding: arrays are sharded over the node axis (`shard_map`/NamedSharding on
+a mesh); the per-tick cross-shard traffic is the scatter/gather of (N, K)
+bools — XLA lowers these to all-to-all/all-gather over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeltaState(NamedTuple):
+    learned: jax.Array  # bool[N, K]
+    pcount: jax.Array  # int8[N, K]
+    tick: jax.Array  # int32
+    key: jax.Array  # PRNG key
+
+
+@dataclass(frozen=True)
+class DeltaParams:
+    n: int
+    k: int  # change-table capacity (rumors in flight)
+    p_factor: int = 15  # disseminator.go:35
+    max_p: Optional[int] = None  # override; default pFactor*ceil(log10(n+1))
+
+    def resolved_max_p(self) -> int:
+        if self.max_p is not None:
+            return self.max_p
+        return int(self.p_factor * np.ceil(np.log10(self.n + 1)))
+
+
+@dataclass(frozen=True)
+class DeltaFaults:
+    up: Optional[jax.Array] = None  # bool[N]
+    group: Optional[jax.Array] = None  # int32[N], -1 = unpartitioned
+    drop_rate: float = 0.0
+
+
+jax.tree_util.register_pytree_node(
+    DeltaFaults,
+    lambda f: ((f.up, f.group), f.drop_rate),
+    lambda aux, children: DeltaFaults(up=children[0], group=children[1], drop_rate=aux),
+)
+
+
+def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray] = None) -> DeltaState:
+    """K rumors, each initially known only to its source node (default:
+    rumor j starts at node j mod N)."""
+    n, k = params.n, params.k
+    if sources is None:
+        sources = np.arange(k, dtype=np.int64) % n
+    learned = jnp.zeros((n, k), dtype=bool).at[jnp.asarray(sources), jnp.arange(k)].set(True)
+    return DeltaState(
+        learned=learned,
+        pcount=jnp.zeros((n, k), dtype=jnp.int8),
+        tick=jnp.asarray(0, jnp.int32),
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> DeltaState:
+    """One protocol period for all N nodes (jit/shard-friendly: fixed shapes,
+    one segment_max scatter + one gather per tick)."""
+    n, k = params.n, params.k
+    max_p = jnp.int8(min(params.resolved_max_p(), 127))
+    key, k_target, k_drop = jax.random.split(state.key, 3)
+
+    # random peer selection (uniform over other nodes; the reference's
+    # shuffled round-robin has the same epidemic mixing rate)
+    targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+    targets = jnp.where(targets >= jnp.arange(n, dtype=jnp.int32), targets + 1, targets)
+
+    up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
+    conn = up & up[targets]
+    if faults.group is not None:
+        g = faults.group
+        conn &= (g < 0) | (g[targets] < 0) | (g == g[targets])
+    if faults.drop_rate > 0:
+        conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
+
+    active = state.pcount < max_p
+    riding = state.learned & active
+
+    # request leg: scatter-or by target (bool max == or; duplicate targets
+    # merge for free)
+    sent = riding & conn[:, None]
+    inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+    learned = state.learned | inbound
+
+    # response leg: gather the target's riding rumors back to the pinger
+    resp = (learned & (state.pcount < max_p))[targets] & conn[:, None]
+    learned = learned | resp
+
+    # piggyback bumps: sender on success; receiver once per busy tick
+    got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
+    bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
+    pcount = jnp.minimum(state.pcount + bump, max_p)
+    # newly learned rumors start at pcount 0 (RecordChange)
+    pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)
+
+    return DeltaState(learned=learned, pcount=pcount, tick=state.tick + 1, key=key)
+
+
+def converged_fraction(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Array:
+    """Fraction of (live node, rumor) pairs delivered."""
+    if faults.up is not None:
+        live = state.learned[faults.up]
+        return live.mean()
+    return state.learned.mean()
+
+
+def run_until_converged(
+    params: DeltaParams,
+    state: DeltaState,
+    faults: DeltaFaults = DeltaFaults(),
+    max_ticks: int = 10_000,
+    check_every: int = 8,
+):
+    """Run jitted blocks of ticks until all rumors reach all live nodes.
+    Returns (state, ticks_used, converged)."""
+
+    @jax.jit
+    def block(s):
+        def body(_, s):
+            return step(params, s, faults)
+
+        return jax.lax.fori_loop(0, check_every, body, s)
+
+    up = faults.up
+    ticks = 0
+    while ticks < max_ticks:
+        state = block(state)
+        ticks += check_every
+        if up is not None:
+            done = bool(state.learned[up].all())
+        else:
+            done = bool(state.learned.all())
+        if done:
+            return state, ticks, True
+    return state, ticks, False
+
+
+class DeltaSim:
+    def __init__(self, n: int, k: int, seed: int = 0, **kw):
+        self.params = DeltaParams(n=n, k=k, **kw)
+        self.state = init_state(self.params, seed=seed)
+        self._step = jax.jit(functools.partial(step, self.params))
+
+    def tick(self, faults: DeltaFaults = DeltaFaults()) -> DeltaState:
+        self.state = self._step(self.state, faults)
+        return self.state
+
+    def run_until_converged(self, faults: DeltaFaults = DeltaFaults(), max_ticks: int = 10_000):
+        self.state, ticks, ok = run_until_converged(
+            self.params, self.state, faults, max_ticks=max_ticks
+        )
+        return ticks, ok

@@ -1,0 +1,440 @@
+"""Exact full-view SWIM simulator: the whole cluster as (N × N) arrays.
+
+Semantic parity with the host plane, vectorized (reference call stack
+``swim/gossip.go:178`` → ``node.go:470-513`` → ``memberlist.go:310-390``):
+
+* one tick = one protocol period for EVERY node simultaneously;
+* change application is the lattice max over ``key = (incarnation << 3) |
+  state_precedence`` — the pure-function override rule from
+  ``ringpop_tpu.swim.member`` lifted to arrays (same ordering, so the result
+  is identical to sequential application in any order);
+* refutation: a node receiving a detraction about itself at incarnation >=
+  its own reasserts Alive at a fresh wall-ms incarnation
+  (``memberlist.go:337-354``);
+* failed direct probe → k indirect probes → Suspect (``node.go:494-510``,
+  all-errors inconclusive rule included);
+* suspicion timers are deadline arrays compared against sim time
+  (``state_transitions.go:90-117`` — suspect→faulty→tombstone→evict, same-
+  state dedup, cross-state replace, never for self);
+* full sync: a ping answered with zero changes against a mismatched view is
+  answered with the full membership, both directions (``disseminator.go:156-304``).
+
+Deviations from the host plane (documented, not semantic):
+* no source-filtering of piggybacked responses (``disseminator.go:185-199``)
+  — refiltering only saves bandwidth; application is idempotent under max;
+* receiver piggyback counters bump once per tick instead of once per
+  concurrent ping; maxP expiry timing can differ by a tick under ping
+  collisions.
+
+State dtypes: ``status`` int8, ``incarnation`` int32, counters int32 —
+bandwidth-lean for HBM and x64-free (TPUs default to 32-bit).  Incarnations
+are *relative*: milliseconds since the sim epoch (the host plane's wall-ms
+incarnations map onto this by subtracting a base; 2^27 ms of headroom ≈ 37
+hours of simulated time before key packing would overflow int32).  The N×N
+key ops fuse into a handful of XLA kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.swim.member import ALIVE, FAULTY, LEAVE, SUSPECT, TOMBSTONE
+
+STATE_BITS = 3  # 5 states fit in 3 bits; key = (inc << 3) | state
+
+
+class FullViewState(NamedTuple):
+    """One pytree = the whole simulated cluster."""
+
+    status: jax.Array  # int8[N, N]   view[i, j]
+    incarnation: jax.Array  # int32[N, N], ms since sim epoch
+    present: jax.Array  # bool[N, N]  j exists in i's member table
+    has_change: jax.Array  # bool[N, N] i has a dissemination record about j
+    pcount: jax.Array  # int32[N, N] piggyback counter
+    pending: jax.Array  # int8[N, N]  scheduled transition source state or -1
+    deadline: jax.Array  # int32[N, N] tick at which the transition fires
+    tick: jax.Array  # int32 scalar, sim time in protocol periods
+    key: jax.Array  # PRNG key
+
+
+@dataclass(frozen=True)
+class FullViewParams:
+    n: int
+    # reference defaults expressed in ticks (protocol period = 200ms):
+    suspect_ticks: int = 25  # 5s / 200ms   (swim/node.go:74)
+    faulty_ticks: int = 432000  # 24h
+    tombstone_ticks: int = 300  # 60s
+    ping_req_size: int = 3  # swim/node.go:86
+    p_factor: int = 15  # disseminator.go:35
+    tick_ms: int = 200  # ms of simulated time per tick
+
+
+def _now_ms(params: FullViewParams, tick) -> jax.Array:
+    # relative wall-ms: strictly positive so refutes always exceed the
+    # converged base incarnation (0)
+    return (tick.astype(jnp.int32) + 1) * params.tick_ms
+
+
+def _key_of(inc, status):
+    """Override-order key: lexicographic (incarnation, precedence) as one
+    int32 — the array form of member.overrides."""
+    return (inc.astype(jnp.int32) << STATE_BITS) | status.astype(jnp.int32)
+
+
+def _is_detraction(status):
+    return (status == SUSPECT) | (status == FAULTY) | (status == TOMBSTONE)
+
+
+def init_state(
+    params: FullViewParams, seed: int = 0, converged: bool = True
+) -> FullViewState:
+    """All nodes alive; everyone knows everyone (converged) or only itself."""
+    n = params.n
+    eye = np.eye(n, dtype=bool)
+    present = np.ones((n, n), dtype=bool) if converged else eye.copy()
+    status = np.zeros((n, n), dtype=np.int8)
+    inc = np.zeros((n, n), dtype=np.int32)  # converged base = incarnation 0
+    return FullViewState(
+        status=jnp.asarray(status),
+        incarnation=jnp.asarray(inc),
+        present=jnp.asarray(present),
+        has_change=jnp.zeros((n, n), dtype=bool),
+        pcount=jnp.zeros((n, n), dtype=jnp.int32),
+        pending=jnp.full((n, n), -1, dtype=jnp.int8),
+        deadline=jnp.zeros((n, n), dtype=jnp.int32),
+        tick=jnp.asarray(0, dtype=jnp.int32),
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+@dataclass(frozen=True)
+class Faults:
+    """Fault model for a step: all plain arrays (BASELINE fault configs)."""
+
+    up: Optional[jax.Array] = None  # bool[N] process liveness
+    group: Optional[jax.Array] = None  # int32[N] partition group (-1 = all)
+    drop_rate: float = 0.0  # per-message loss probability
+
+
+# Faults flows through jit: up/group are traced children, drop_rate is
+# static aux data (a new rate simply retraces once)
+jax.tree_util.register_pytree_node(
+    Faults,
+    lambda f: ((f.up, f.group), f.drop_rate),
+    lambda aux, children: Faults(up=children[0], group=children[1], drop_rate=aux),
+)
+
+
+def _connectivity(params, faults: Faults, key, targets):
+    """conn[i] = can i's ping reach targets[i] this tick."""
+    n = params.n
+    up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
+    conn = up & up[targets]
+    if faults.group is not None:
+        g = faults.group
+        gt = g[targets]
+        conn &= (g < 0) | (gt < 0) | (g == gt)
+    if faults.drop_rate > 0:
+        conn &= jax.random.uniform(key, (n,)) >= faults.drop_rate
+    return conn, up
+
+
+def _pair_connected(params, faults: Faults, a, b):
+    """Static (no-drop) connectivity between index arrays a and b."""
+    up = faults.up if faults.up is not None else None
+    ok = jnp.ones(a.shape, dtype=bool)
+    if up is not None:
+        ok &= up[a] & up[b]
+    if faults.group is not None:
+        g = faults.group
+        ok &= (g[a] < 0) | (g[b] < 0) | (g[a] == g[b])
+    return ok
+
+
+def _max_p(params, status, present, eye):
+    """Per-node dissemination bound maxP = pFactor * ceil(log10(pingable+1))
+    (parity: ``disseminator.go:75-97``)."""
+    pingable = present & ((status == ALIVE) | (status == SUSPECT)) & ~eye
+    num = pingable.sum(axis=1)
+    return (
+        params.p_factor * jnp.ceil(jnp.log10(num.astype(jnp.float32) + 1.0))
+    ).astype(jnp.int32)
+
+
+def _apply_batch(params, state: FullViewState, cand_key, cand_mask, now_ms, eye):
+    """Apply a batch of candidate changes (one candidate per (observer,
+    subject) cell, already max-merged) — the array form of
+    ``memberlist.Update``.  Returns new state pieces + applied mask."""
+    n = params.n
+    status, inc, present = state.status, state.incarnation, state.present
+    pending, deadline = state.pending, state.deadline
+
+    cand_status = (cand_key & ((1 << STATE_BITS) - 1)).astype(jnp.int8)
+    cand_inc = cand_key >> STATE_BITS
+
+    local_key = _key_of(inc, status)
+    local_eff = jnp.where(present, local_key, jnp.int32(-1))
+
+    # refutation: a detraction about myself at inc >= mine
+    # (memberlist.go:337-354; localOverride member.go:98-110)
+    refute = (
+        cand_mask
+        & eye
+        & _is_detraction(cand_status)
+        & (cand_inc >= inc)
+        & present
+    )
+
+    # non-local (and first-seen) override by strict key order
+    wins = cand_mask & (cand_key > local_eff) & ~refute
+    # first-seen tombstones are refused (memberlist.go:421-426)
+    first_seen = wins & ~present
+    wins &= ~(first_seen & (cand_status == TOMBSTONE))
+
+    new_status = jnp.where(wins, cand_status, status)
+    new_inc = jnp.where(wins, cand_inc, inc)
+    new_present = present | wins
+
+    # refutations reassert alive at a fresh wall-ms incarnation
+    refute_inc = jnp.broadcast_to(now_ms, (n, n))
+    new_status = jnp.where(refute, jnp.int8(ALIVE), new_status)
+    new_inc = jnp.where(refute, refute_inc, new_inc)
+
+    applied = wins | refute
+
+    # dissemination records for every applied change (node.go:424-427)
+    has_change = jnp.where(applied, True, state.has_change)
+    pcount = jnp.where(applied, 0, state.pcount)
+
+    # suspicion timers (node.go:429-445, state_transitions.go:119-160):
+    # alive/leave cancel; suspect/faulty/tombstone schedule unless a timer
+    # for the same state is already pending; never for self.
+    eff_status = new_status
+    cancel = applied & ((eff_status == ALIVE) | (eff_status == LEAVE))
+    timeout_for = {
+        SUSPECT: params.suspect_ticks,
+        FAULTY: params.faulty_ticks,
+        TOMBSTONE: params.tombstone_ticks,
+    }
+    new_pending = jnp.where(cancel, jnp.int8(-1), pending)
+    new_deadline = deadline
+    for st, ticks in timeout_for.items():
+        sched = applied & (eff_status == st) & ~eye & (pending != st)
+        new_pending = jnp.where(sched, jnp.int8(st), new_pending)
+        new_deadline = jnp.where(sched, state.tick + ticks, new_deadline)
+
+    return state._replace(
+        status=new_status,
+        incarnation=new_inc,
+        present=new_present,
+        has_change=has_change,
+        pcount=pcount,
+        pending=new_pending,
+        deadline=new_deadline,
+    ), applied
+
+
+def _fire_timers(params, state: FullViewState, now_ms, eye):
+    """Deadline-array transitions (state_transitions.go:90-117): the timer
+    fires a Make{Faulty,Tombstone} / Evict, which is itself a local change."""
+    due = (state.pending >= 0) & (state.tick >= state.deadline)
+
+    # suspect->faulty, faulty->tombstone at the member's current incarnation
+    fire_faulty = due & (state.pending == SUSPECT)
+    fire_tomb = due & (state.pending == FAULTY)
+    fire_evict = due & (state.pending == TOMBSTONE)
+
+    cand_status = jnp.where(
+        fire_faulty, jnp.int8(FAULTY), jnp.where(fire_tomb, jnp.int8(TOMBSTONE), jnp.int8(0))
+    )
+    cand_mask = fire_faulty | fire_tomb
+    cand_key = _key_of(state.incarnation, cand_status)
+
+    state = state._replace(pending=jnp.where(due, jnp.int8(-1), state.pending))
+    state, _ = _apply_batch(params, state, cand_key, cand_mask, now_ms, eye)
+
+    # eviction removes the member entirely (memberlist.Evict; never self —
+    # self never gets a timer)
+    state = state._replace(
+        present=state.present & ~fire_evict,
+        has_change=state.has_change & ~fire_evict,
+    )
+    return state
+
+
+def step(
+    params: FullViewParams,
+    state: FullViewState,
+    faults: Faults = Faults(),
+    targets: Optional[jax.Array] = None,
+) -> FullViewState:
+    """One protocol period for every node (jit-compatible; ``targets`` may be
+    injected for deterministic conformance runs)."""
+    n = params.n
+    eye = jnp.eye(n, dtype=bool)
+    key, k_target, k_drop, k_peers = jax.random.split(state.key, 4)
+    now = _now_ms(params, state.tick)
+
+    # -- ping target selection (memberlist_iter.go round-robin becomes a
+    # masked categorical draw; injectable for lockstep conformance)
+    pingable = state.present & ((state.status == ALIVE) | (state.status == SUSPECT)) & ~eye
+    if targets is None:
+        logits = jnp.where(pingable, 0.0, -jnp.inf)
+        any_pingable = pingable.any(axis=1)
+        safe_logits = jnp.where(any_pingable[:, None], logits, 0.0)
+        targets = jax.random.categorical(k_target, safe_logits, axis=1)
+    else:
+        any_pingable = pingable.any(axis=1)
+    targets = targets.astype(jnp.int32)
+
+    conn, up = _connectivity(params, faults, k_drop, targets)
+    delivered = conn & any_pingable & up  # dead/idle nodes don't ping
+
+    max_p = _max_p(params, state.status, state.present, eye)
+
+    # -- request leg: senders' unexpired changes, max-merged per target ----
+    send_mask = state.has_change & (state.pcount < max_p[:, None]) & delivered[:, None]
+    send_key = jnp.where(send_mask, _key_of(state.incarnation, state.status), jnp.int32(-1))
+    # scatter-max by target: concurrent pings to one node merge exactly
+    # because application is a lattice max
+    inbound = jax.ops.segment_max(
+        jnp.where(delivered[:, None], send_key, jnp.int32(-1)),
+        targets,
+        num_segments=n,
+        indices_are_sorted=False,
+    )
+    inbound = jnp.maximum(inbound, jnp.int32(-1))  # segment_max fills -inf-ish
+    state, _ = _apply_batch(params, state, inbound, inbound >= 0, now, eye)
+
+    # -- full-sync detection (disseminator.go:156-181): target had no
+    # changes to answer with AND the sender's view differs from its own
+    t = targets
+    has_any = state.has_change.any(axis=1)
+    both = state.present & state.present[t]
+    cell_eq = jnp.where(
+        both,
+        (state.status == state.status[t]) & (state.incarnation == state.incarnation[t]),
+        state.present == state.present[t],
+    )
+    views_equal = cell_eq.all(axis=1)
+    full_sync = delivered & ~has_any[t] & ~views_equal
+
+    # -- response leg: target's changes (or full membership on full sync)
+    resp_mask = state.has_change[t] & (state.pcount[t] < max_p[t][:, None])
+    resp_mask = jnp.where(full_sync[:, None], state.present[t], resp_mask)
+    resp_key = jnp.where(
+        resp_mask & delivered[:, None],
+        _key_of(state.incarnation[t], state.status[t]),
+        jnp.int32(-1),
+    )
+    state, _ = _apply_batch(params, state, resp_key, resp_key >= 0, now, eye)
+
+    # reverse full sync (disseminator.go:257-304): the target pulls the
+    # sender's membership too — scatter the sender's full view at the target
+    rfs_key = jnp.where(
+        (full_sync & delivered)[:, None] & state.present,
+        _key_of(state.incarnation, state.status),
+        jnp.int32(-1),
+    )
+    rfs_inbound = jax.ops.segment_max(rfs_key, targets, num_segments=n)
+    rfs_inbound = jnp.maximum(rfs_inbound, jnp.int32(-1))
+    state, _ = _apply_batch(params, state, rfs_inbound, rfs_inbound >= 0, now, eye)
+
+    # -- piggyback counter bumps + expiry (disseminator.go:128-153) --------
+    sender_bump = send_mask  # bump on success only (ping_sender.go:52)
+    recv_count = jax.ops.segment_sum(
+        delivered.astype(jnp.int32), targets, num_segments=n
+    )
+    receiver_bump = state.has_change & (recv_count[:, None] > 0) & (state.pcount < max_p[:, None])
+    pcount = state.pcount + sender_bump + receiver_bump
+    expired = pcount >= max_p[:, None]
+    state = state._replace(
+        pcount=jnp.where(expired, 0, pcount),
+        has_change=state.has_change & ~expired,
+    )
+
+    # -- failed probe: indirect ping-req then Suspect (node.go:494-510) ----
+    probing = any_pingable & up & ~delivered
+    # peers drawn from each node's pingable view excluding the target
+    # (memberlist.go:200-218 RandomPingableMembers; with replacement here)
+    peer_pool = pingable & ~jax.nn.one_hot(targets, n, dtype=bool)
+    peer_logits = jnp.where(peer_pool, 0.0, -jnp.inf)
+    peer_logits = jnp.where(peer_pool.any(axis=1)[:, None], peer_logits, 0.0)
+    peer_choices = jax.random.categorical(
+        k_peers, peer_logits[:, None, :], axis=-1, shape=(n, params.ping_req_size)
+    ).astype(jnp.int32)
+    i_idx = jnp.arange(n)[:, None]
+    peer_ok = (
+        peer_pool[i_idx, peer_choices]
+        & _pair_connected(params, faults, jnp.broadcast_to(i_idx, peer_choices.shape), peer_choices)
+    )
+    peer_reaches = peer_ok & _pair_connected(
+        params, faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape)
+    )
+    if faults.up is not None:
+        peer_reaches &= faults.up[targets][:, None]
+    reached = peer_reaches.any(axis=1)
+    errs = (~peer_ok).sum(axis=1)
+    inconclusive = errs == params.ping_req_size
+    declare_suspect = probing & ~reached & ~inconclusive
+
+    # suspect at the member's current incarnation (node.go:508)
+    tgt_inc = state.incarnation[jnp.arange(n), targets]
+    cand_key = _key_of(tgt_inc, jnp.int8(SUSPECT))
+    suspect_cand = jnp.full((n, n), -1, dtype=jnp.int32)
+    suspect_cand = suspect_cand.at[jnp.arange(n), targets].set(
+        jnp.where(declare_suspect, cand_key, jnp.int32(-1))
+    )
+    state, _ = _apply_batch(params, state, suspect_cand, suspect_cand >= 0, now, eye)
+
+    # -- timers fire against sim time --------------------------------------
+    state = _fire_timers(params, state, now, eye)
+
+    return state._replace(tick=state.tick + 1, key=key)
+
+
+class FullViewSim:
+    """Convenience wrapper: init + jitted multi-tick runs."""
+
+    def __init__(self, n: int, seed: int = 0, converged: bool = True, **kw):
+        self.params = FullViewParams(n=n, **kw)
+        self.state = init_state(self.params, seed=seed, converged=converged)
+        self._step = jax.jit(
+            functools.partial(step, self.params), static_argnames=()
+        )
+
+    def tick(self, faults: Faults = Faults(), targets=None) -> FullViewState:
+        self.state = self._step(self.state, faults, targets)
+        return self.state
+
+    def run(self, ticks: int, faults: Faults = Faults()) -> FullViewState:
+        for _ in range(ticks):
+            self.tick(faults)
+        return self.state
+
+    # -- queries ------------------------------------------------------------
+
+    def views_converged(self) -> bool:
+        """All live nodes share an identical view (the sim analog of equal
+        checksums)."""
+        s = self.state
+        ref_status, ref_inc, ref_p = s.status[0], s.incarnation[0], s.present[0]
+        return bool(
+            (
+                (s.status == ref_status[None, :]).all()
+                & (s.incarnation == ref_inc[None, :]).all()
+                & (s.present == ref_p[None, :]).all()
+            )
+        )
+
+    def status_matrix(self) -> np.ndarray:
+        return np.asarray(self.state.status)
+
+    def has_changes(self) -> bool:
+        return bool(self.state.has_change.any())

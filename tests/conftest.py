@@ -1,7 +1,12 @@
 import os
 
-# Sharding tests run on a virtual 8-device CPU mesh; set before jax imports.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Sharding tests run on a virtual 8-device CPU mesh. XLA_FLAGS must be set
+# before jax initializes; JAX_PLATFORMS alone is unreliable here because the
+# environment re-exports JAX_PLATFORMS=axon, so also pin via jax.config.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,224 @@
+"""Sim-plane tests: full-view protocol semantics, delta dissemination,
+fault models, mesh sharding, ring ops (all on the CPU backend from
+conftest; the 8-device mesh exercises the sharded path)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.sim.delta import (
+    DeltaFaults,
+    DeltaParams,
+    DeltaSim,
+    init_state as delta_init,
+    run_until_converged,
+)
+from ringpop_tpu.sim.fullview import Faults, FullViewParams, FullViewSim, init_state, step
+from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
+
+
+class TestFullView:
+    def test_converged_cluster_is_stable(self):
+        sim = FullViewSim(16, seed=0)
+        sim.run(8)
+        assert sim.views_converged()
+        assert not sim.has_changes()
+        assert (sim.status_matrix() == ALIVE).all()
+
+    def test_dead_node_detected_and_marked_faulty(self):
+        n = 16
+        up = np.ones(n, dtype=bool)
+        up[5] = False
+        faults = Faults(up=jnp.asarray(up))
+        sim = FullViewSim(n, seed=1, suspect_ticks=5)
+        sim.run(40, faults)
+        sm = sim.status_matrix()
+        for i in range(n):
+            if i != 5:
+                assert sm[i, 5] == FAULTY
+
+    def test_suspect_is_refuted_when_node_is_alive(self):
+        n = 12
+        sim = FullViewSim(n, seed=2, suspect_ticks=50)
+        # declare node 3 suspect at node 0 by fiat
+        from ringpop_tpu.sim import fullview as fv
+
+        st = sim.state
+        key = (st.incarnation[0, 3].astype(jnp.int32) << 3) | SUSPECT
+        cand = jnp.full((n, n), -1, jnp.int32).at[0, 3].set(key)
+        sim.state, _ = fv._apply_batch(
+            sim.params, st, cand, cand >= 0, jnp.int32(1), jnp.eye(n, dtype=bool)
+        )
+        assert sim.status_matrix()[0, 3] == SUSPECT
+
+        sim.run(60)
+        sm = sim.status_matrix()
+        inc = np.asarray(sim.state.incarnation)
+        assert (sm[:, 3] == ALIVE).all()
+        assert inc[3, 3] > 0  # reincarnated
+        assert sim.views_converged()
+
+    def test_suspect_faulty_tombstone_evict_chain(self):
+        n = 8
+        up = np.ones(n, dtype=bool)
+        up[2] = False
+        faults = Faults(up=jnp.asarray(up))
+        sim = FullViewSim(n, seed=3, suspect_ticks=3, faulty_ticks=5, tombstone_ticks=5)
+        sim.run(60, faults)
+        present = np.asarray(sim.state.present)
+        for i in range(n):
+            if i != 2:
+                assert not present[i, 2]  # evicted everywhere
+
+    def test_partition_blocks_dissemination_then_heals(self):
+        n = 12
+        group = np.zeros(n, dtype=np.int32)
+        group[n // 2 :] = 1
+        parted = Faults(group=jnp.asarray(group))
+        sim = FullViewSim(n, seed=4, suspect_ticks=1000)  # no state churn
+        # inject a rumor on side 0: node 0 reincarnates itself
+        from ringpop_tpu.sim import fullview as fv
+
+        st = sim.state
+        key = ((st.incarnation[0, 0] + 200).astype(jnp.int32) << 3) | ALIVE
+        cand = jnp.full((n, n), -1, jnp.int32).at[0, 0].set(key)
+        sim.state, _ = fv._apply_batch(
+            sim.params, st, cand, cand >= 0, jnp.int32(1), jnp.eye(n, dtype=bool)
+        )
+        sim.run(40, parted)
+        inc = np.asarray(sim.state.incarnation)
+        side_a = range(n // 2)
+        side_b = range(n // 2, n)
+        assert all(inc[i, 0] > 0 for i in side_a)  # spread within partition
+        assert all(inc[i, 0] == 0 for i in side_b)  # blocked by partition
+
+        sim.run(40)  # partition heals (no faults)
+        inc = np.asarray(sim.state.incarnation)
+        assert all(inc[i, 0] > 0 for i in range(n))
+
+    def test_deterministic_given_seed(self):
+        a = FullViewSim(10, seed=7)
+        b = FullViewSim(10, seed=7)
+        a.run(10)
+        b.run(10)
+        assert (a.status_matrix() == b.status_matrix()).all()
+        assert (np.asarray(a.state.incarnation) == np.asarray(b.state.incarnation)).all()
+
+    def test_injected_targets_for_lockstep_runs(self):
+        n = 6
+        params = FullViewParams(n=n)
+        st = init_state(params, seed=0)
+        targets = jnp.asarray([1, 2, 3, 4, 5, 0], dtype=jnp.int32)
+        out = step(params, st, Faults(), targets=targets)
+        assert int(out.tick) == 1
+
+
+class TestDelta:
+    def test_rumors_converge(self):
+        sim = DeltaSim(512, 32, seed=0)
+        ticks, ok = sim.run_until_converged()
+        assert ok and ticks <= 64
+
+    def test_convergence_under_packet_loss(self):
+        # BASELINE config: 5% loss
+        sim = DeltaSim(512, 32, seed=1)
+        ticks, ok = sim.run_until_converged(DeltaFaults(drop_rate=0.05))
+        assert ok
+
+    def test_partition_blocks_then_heals(self):
+        n, k = 256, 16
+        group = np.zeros(n, dtype=np.int32)
+        group[n // 2 :] = 1
+        sim = DeltaSim(n, k, seed=2)
+        # all rumors start on side 0
+        sim.state = delta_init(sim.params, seed=2, sources=np.zeros(k, dtype=np.int64))
+        parted = DeltaFaults(group=jnp.asarray(group))
+        for _ in range(64):
+            sim.tick(parted)
+        learned = np.asarray(sim.state.learned)
+        assert learned[: n // 2].all()  # side 0 fully infected
+        assert not learned[n // 2 :].any()  # side 1 isolated
+
+        # heal: rumors cross over. piggyback counters on side 0 may have
+        # expired (maxP bound) — the healed cluster still converges because
+        # side-1 learners re-disseminate with fresh counters
+        ticks, ok = sim.run_until_converged(max_ticks=512)
+        assert ok
+
+    def test_max_p_bounds_dissemination_traffic(self):
+        # a rumor stops riding after maxP propagations per node
+        sim = DeltaSim(64, 4, seed=3, max_p=2)
+        for _ in range(50):
+            sim.tick()
+        # counters are capped at max_p
+        assert int(np.asarray(sim.state.pcount).max()) <= 2
+
+    def test_dead_nodes_do_not_block_convergence_check(self):
+        n = 128
+        up = np.ones(n, dtype=bool)
+        up[50] = False  # dead node is NOT a rumor source (sources are 0..7)
+        faults = DeltaFaults(up=jnp.asarray(up))
+        sim = DeltaSim(n, 8, seed=4)
+        ticks, ok = sim.run_until_converged(faults)
+        assert ok  # converged over LIVE nodes
+        assert not bool(np.asarray(sim.state.learned)[50].all())
+
+
+class TestMeshSharding:
+    def test_sharded_step_matches_single_device(self):
+        from ringpop_tpu.parallel.mesh import make_mesh, shard_delta_state, sharded_delta_step
+
+        params = DeltaParams(n=64, k=16)
+        state = delta_init(params, seed=5)
+        mesh = make_mesh(8)
+        sharded = shard_delta_state(state, mesh)
+        step_fn = sharded_delta_step(params, mesh)
+        out_sharded = step_fn(sharded)
+
+        from ringpop_tpu.sim.delta import step as plain_step
+
+        out_plain = jax.jit(lambda s: plain_step(params, s))(state)
+        assert (np.asarray(out_sharded.learned) == np.asarray(out_plain.learned)).all()
+        assert (np.asarray(out_sharded.pcount) == np.asarray(out_plain.pcount)).all()
+
+    def test_mesh_shapes(self):
+        from ringpop_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        assert mesh.shape["node"] * mesh.shape["rumor"] == 8
+
+
+class TestRingOps:
+    def test_device_lookup_matches_host_ring(self):
+        from ringpop_tpu.hashing.farm import fingerprint32_batch, pack_strings
+        from ringpop_tpu.hashring import HashRing
+        from ringpop_tpu.ops import build_ring_tokens, ring_lookup, ring_lookup_n
+
+        servers = sorted(f"10.0.1.{i}:3000" for i in range(12))
+        r = HashRing()
+        r.add_remove_servers(servers, [])
+        toks, owners = build_ring_tokens(servers, 100)
+
+        keys = [f"key-{i}" for i in range(500)]
+        mat, lens = pack_strings(keys)
+        hashes = jnp.asarray(fingerprint32_batch(mat, lens))
+
+        got = np.asarray(ring_lookup(toks, owners, hashes))
+        want = np.array([servers.index(r.lookup(k)) for k in keys])
+        assert (got == want).all()
+
+        got_n = np.asarray(ring_lookup_n(toks, owners, hashes[:64], 3, len(servers)))
+        want_n = np.array([[servers.index(s) for s in r.lookup_n(k, 3)] for k in keys[:64]])
+        assert (got_n == want_n).all()
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert int(out.tick) == 1
+    g.dryrun_multichip(8)
