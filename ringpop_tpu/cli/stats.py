@@ -1,0 +1,60 @@
+"""Stats reporter implementations for the CLI
+(parity: reference ``scripts/testpop/statter.go:48-59`` file-statsd adapter +
+UDP statsd option ``scripts/testpop/testpop.go``)."""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Optional, TextIO
+
+from ringpop_tpu.options import StatsReporter
+
+
+class FileStats(StatsReporter):
+    """Timestamped stat lines to a file (parity: statter.go FileStatter)."""
+
+    def __init__(self, path: str):
+        self._f: TextIO = open(path, "a", buffering=1)
+
+    def _write(self, kind: str, key: str, value) -> None:
+        self._f.write(f"{time.time():.6f} {kind} {key} {value}\n")
+
+    def incr(self, key: str, value: int = 1) -> None:
+        self._write("count", key, value)
+
+    def gauge(self, key: str, value: float) -> None:
+        self._write("gauge", key, value)
+
+    def timing(self, key: str, seconds: float) -> None:
+        self._write("timing", key, seconds)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class UDPStatsd(StatsReporter):
+    """Plain statsd wire format over UDP (``key:value|type``)."""
+
+    def __init__(self, hostport: str):
+        host, port = hostport.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass  # stats must never take the node down
+
+    def incr(self, key: str, value: int = 1) -> None:
+        self._send(f"{key}:{value}|c")
+
+    def gauge(self, key: str, value: float) -> None:
+        self._send(f"{key}:{value}|g")
+
+    def timing(self, key: str, seconds: float) -> None:
+        self._send(f"{key}:{seconds * 1000:.3f}|ms")
+
+    def close(self) -> None:
+        self._sock.close()

@@ -1,0 +1,94 @@
+"""testpop — standalone node binary for integration testing
+(parity: reference ``scripts/testpop/testpop.go:38-118``).
+
+Run one framework node that listens on a TCP hostport, bootstraps from a
+JSON hosts file, and gossips until killed.  Flags mirror the reference:
+listen address, hosts file, stats to UDP statsd or a timestamped file, and
+suspect/faulty/tombstone period overrides.
+
+    python -m ringpop_tpu.cli.testpop --listen 127.0.0.1:3000 \
+        --hosts /tmp/hosts.json [--stats-file FILE | --stats-udp HOST:PORT] \
+        [--suspect-period S] [--faulty-period S] [--tombstone-period S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+
+from ringpop_tpu.discovery import JSONFile
+from ringpop_tpu.net import TCPChannel
+from ringpop_tpu.options import Options
+from ringpop_tpu.ringpop import Ringpop
+from ringpop_tpu.swim.node import BootstrapOptions
+from ringpop_tpu.swim.state_transitions import StateTimeouts
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="testpop", description=__doc__)
+    p.add_argument("--listen", required=True, help="hostport to listen on")
+    p.add_argument("--hosts", required=True, help="path to JSON bootstrap hosts file")
+    p.add_argument("--app", default="testpop", help="ringpop app name")
+    p.add_argument("--stats-file", default=None, help="write stats to this file")
+    p.add_argument("--stats-udp", default=None, help="send statsd to this hostport")
+    p.add_argument("--suspect-period", type=float, default=0.0, help="seconds (0=default 5s)")
+    p.add_argument("--faulty-period", type=float, default=0.0, help="seconds (0=default 24h)")
+    p.add_argument("--tombstone-period", type=float, default=0.0, help="seconds (0=default 60s)")
+    p.add_argument("--join-timeout", type=float, default=0.0, help="seconds per join attempt")
+    return p.parse_args(argv)
+
+
+async def amain(args) -> int:
+    stats = None
+    if args.stats_file:
+        from ringpop_tpu.cli.stats import FileStats
+
+        stats = FileStats(args.stats_file)
+    elif args.stats_udp:
+        from ringpop_tpu.cli.stats import UDPStatsd
+
+        stats = UDPStatsd(args.stats_udp)
+
+    host, port = args.listen.rsplit(":", 1)
+    channel = TCPChannel(app=args.app)
+    await channel.listen(host, int(port))
+    print(f"testpop listening on {channel.hostport}", flush=True)
+
+    rp = Ringpop(
+        args.app,
+        channel,
+        Options(
+            stats_reporter=stats,
+            state_timeouts=StateTimeouts(
+                suspect=args.suspect_period,
+                faulty=args.faulty_period,
+                tombstone=args.tombstone_period,
+            ),
+        ),
+    )
+    joined = await rp.bootstrap(
+        BootstrapOptions(
+            discover_provider=JSONFile(args.hosts), join_timeout=args.join_timeout
+        )
+    )
+    print(f"testpop ready; joined {len(joined)} nodes: {joined}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    rp.destroy()
+    await channel.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    return asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Tier-3 integration tests: real multi-process local clusters
+(model: reference test/run-integration-tests cluster sizes — scaled down to
+keep CI fast; sizes 1/2/3/5 covered across the tests here)."""
+
+import asyncio
+import signal
+
+import pytest
+
+from ringpop_tpu.harness import ProcessCluster
+
+from swim_utils import run
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_process_cluster_converges(n):
+    async def main():
+        cluster = ProcessCluster(n)
+        cluster.start()
+        try:
+            stats = await cluster.wait_converged(expect_members=n, timeout=45)
+            for s in stats.values():
+                assert all(m["status"] == "alive" for m in s["membership"]["members"])
+        finally:
+            await cluster.shutdown()
+
+    run(main())
+
+
+def test_killed_process_is_detected_faulty():
+    async def main():
+        cluster = ProcessCluster(3, suspect_period=1.0)
+        cluster.start()
+        try:
+            await cluster.wait_converged(expect_members=3, timeout=45)
+            victim = cluster.hosts[2]
+            survivors = cluster.hosts[:2]
+            cluster.kill(victim, signal.SIGKILL)
+            # ping timeout (1.5s) + ping-req + suspect period (1s)
+            for obs in survivors:
+                await cluster.wait_member_status(obs, victim, "faulty", timeout=45)
+        finally:
+            await cluster.shutdown()
+
+    run(main())
+
+
+def test_five_process_cluster_and_reap():
+    async def main():
+        cluster = ProcessCluster(5, suspect_period=1.0)
+        cluster.start()
+        try:
+            await cluster.wait_converged(expect_members=5, timeout=60)
+            victim = cluster.hosts[4]
+            survivors = cluster.hosts[:4]
+            cluster.kill(victim, signal.SIGKILL)
+            await cluster.wait_member_status(survivors[0], victim, "faulty", timeout=45)
+
+            # admin reap: faulty -> tombstone, gossiped cluster-wide
+            client = await cluster.client()
+            await client.call(survivors[0], "ringpop", "/admin/reap", {}, timeout=2.0)
+            # tombstones are excluded from the checksum; survivors re-converge
+            await cluster.wait_converged(hosts=survivors, timeout=45)
+        finally:
+            await cluster.shutdown()
+
+    run(main())
