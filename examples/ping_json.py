@@ -21,13 +21,6 @@ from ringpop_tpu.swim.node import BootstrapOptions
 APP = "ping-app"
 
 
-async def make_node(hosts):
-    channel = TCPChannel(app=APP)
-    await channel.listen()
-    rp = Ringpop(APP, channel, Options())
-    return rp, channel
-
-
 async def main():
     # start three nodes
     channels = []
@@ -41,8 +34,6 @@ async def main():
 
     # each node's /ping handler: handle locally or forward to the owner
     for rp in rps:
-        me = None
-
         async def ping(body, headers, rp=rp):
             key = body.get("key", "")
             handled, res = await rp.handle_or_forward(

@@ -273,9 +273,13 @@ def step(
     state: FullViewState,
     faults: Faults = Faults(),
     targets: Optional[jax.Array] = None,
+    peers: Optional[jax.Array] = None,
 ) -> FullViewState:
-    """One protocol period for every node (jit-compatible; ``targets`` may be
-    injected for deterministic conformance runs)."""
+    """One protocol period for every node (jit-compatible; ``targets`` and
+    ping-req ``peers`` may be injected for deterministic conformance runs —
+    with both injected and ``drop_rate == 0`` the step is a pure function of
+    the state, which is what the lockstep harness in
+    ``ringpop_tpu.sim.conformance`` relies on)."""
     n = params.n
     eye = jnp.eye(n, dtype=bool)
     key, k_target, k_drop, k_peers = jax.random.split(state.key, 4)
@@ -364,11 +368,14 @@ def step(
     # peers drawn from each node's pingable view excluding the target
     # (memberlist.go:200-218 RandomPingableMembers; with replacement here)
     peer_pool = pingable & ~jax.nn.one_hot(targets, n, dtype=bool)
-    peer_logits = jnp.where(peer_pool, 0.0, -jnp.inf)
-    peer_logits = jnp.where(peer_pool.any(axis=1)[:, None], peer_logits, 0.0)
-    peer_choices = jax.random.categorical(
-        k_peers, peer_logits[:, None, :], axis=-1, shape=(n, params.ping_req_size)
-    ).astype(jnp.int32)
+    if peers is None:
+        peer_logits = jnp.where(peer_pool, 0.0, -jnp.inf)
+        peer_logits = jnp.where(peer_pool.any(axis=1)[:, None], peer_logits, 0.0)
+        peer_choices = jax.random.categorical(
+            k_peers, peer_logits[:, None, :], axis=-1, shape=(n, params.ping_req_size)
+        ).astype(jnp.int32)
+    else:
+        peer_choices = peers.astype(jnp.int32)
     i_idx = jnp.arange(n)[:, None]
     peer_ok = (
         peer_pool[i_idx, peer_choices]
@@ -409,8 +416,8 @@ class FullViewSim:
             functools.partial(step, self.params), static_argnames=()
         )
 
-    def tick(self, faults: Faults = Faults(), targets=None) -> FullViewState:
-        self.state = self._step(self.state, faults, targets)
+    def tick(self, faults: Faults = Faults(), targets=None, peers=None) -> FullViewState:
+        self.state = self._step(self.state, faults, targets, peers)
         return self.state
 
     def run(self, ticks: int, faults: Faults = Faults()) -> FullViewState:

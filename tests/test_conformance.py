@@ -1,0 +1,72 @@
+"""Lockstep conformance: the vectorized fullview engine must be bit-identical
+to the sequential reference interpreter under injected randomness
+(the BASELINE "bit-identical member states vs sequential reference semantics"
+gate; semantics parity ``swim/memberlist.go:310-390``, ``swim/node.go:470-513``,
+``swim/state_transitions.go:90-117``)."""
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim.conformance import LockstepRunner
+from ringpop_tpu.sim.fullview import Faults
+from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT
+
+
+class TestLockstepConformance:
+    def test_stable_cluster(self):
+        r = LockstepRunner(n=32, seed=1)
+        r.run(30)
+
+    def test_dead_nodes_full_lifecycle(self):
+        # short timeouts so the whole suspect→faulty→tombstone→evict chain
+        # plays out inside the run
+        r = LockstepRunner(
+            n=32, seed=2, suspect_ticks=4, faulty_ticks=8, tombstone_ticks=4
+        )
+        up = np.ones(32, bool)
+        up[[3, 11, 19]] = False
+        r.run(40, faults=Faults(up=np.asarray(up)))
+        # sanity: the oracle actually detected the failures (not a vacuous run)
+        seq_view = r.seq.nodes[0].view
+        assert all(seq_view.get(d, (ALIVE, 0))[0] != ALIVE or d not in seq_view for d in (3, 11, 19))
+
+    def test_kill_then_revive_refutation(self):
+        r = LockstepRunner(n=24, seed=3, suspect_ticks=6)
+        up = np.ones(24, bool)
+        up[5] = False
+        r.run(10, faults=Faults(up=np.asarray(up)))
+        # someone detected node 5 by now (suspect, or already faulty)
+        assert any(n.view.get(5, (ALIVE, 0))[0] != ALIVE for n in r.seq.nodes)
+        up[5] = True
+        r.run(25)
+        # node 5 refuted: alive at a bumped incarnation everywhere it is known
+        assert all(
+            n.view[5][0] == ALIVE and n.view[5][1] > 0
+            for n in r.seq.nodes
+            if 5 in n.view
+        )
+
+    def test_partition_then_heal(self):
+        n = 32
+        r = LockstepRunner(n=n, seed=4, suspect_ticks=4, faulty_ticks=1000)
+        group = np.zeros(n, np.int32)
+        group[n // 2 :] = 1
+        r.run(25, faults=Faults(group=np.asarray(group)))
+        r.run(40)  # heal: full syncs + refutations reconverge the views
+
+    def test_packet_level_asymmetry_via_groups(self):
+        # three-way partition exercises inconclusive ping-req paths
+        n = 30
+        r = LockstepRunner(n=n, seed=5, suspect_ticks=3)
+        group = np.asarray(np.arange(n) % 3, np.int32)
+        r.run(20, faults=Faults(group=group))
+        r.run(30)
+
+    @pytest.mark.slow
+    def test_midscale_conformance(self):
+        # larger-N spot check (the 1k-node run lives in bench_suite.py)
+        r = LockstepRunner(n=128, seed=6, suspect_ticks=5, faulty_ticks=40, tombstone_ticks=10)
+        up = np.ones(128, bool)
+        up[::16] = False
+        r.run(30, faults=Faults(up=np.asarray(up)), check_every=5)
+        r.run(20, check_every=5)
