@@ -1,3 +1,73 @@
-from ringpop_tpu.hashing.farm import fingerprint32, fingerprint32_batch
+"""Hashing front-end: FarmHash Fingerprint32, native-accelerated.
 
-__all__ = ["fingerprint32", "fingerprint32_batch"]
+Dispatches to the C++ core (``ringpop_tpu.native``) when the lazily-built
+library is available, else to the pure-Python/numpy reference implementation
+(``ringpop_tpu.hashing.farm``).  Both produce identical bits — the test
+suite cross-checks them — so checksums and ring tokens stay wire-compatible
+with the reference (``swim/memberlist.go:86``, ``hashring/hashring.go:107``)
+regardless of which backend serves a call.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ringpop_tpu.hashing import farm as _farm
+from ringpop_tpu.hashing.farm import fingerprint32_batch, pack_strings  # re-export
+
+_backend: str | None = None
+
+
+def _use_native() -> bool:
+    global _backend
+    if _backend is None:
+        from ringpop_tpu import native
+
+        _backend = "native" if native.available() else "python"
+    return _backend == "native"
+
+
+def fingerprint32(data: bytes | str) -> int:
+    """FarmHash Fingerprint32 of ``data`` (farmhashmk::Hash32)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    if _use_native():
+        from ringpop_tpu import native
+
+        return native.fingerprint32(data)
+    return _farm.fingerprint32(data)
+
+
+def fingerprint32_many(strings: Iterable[str | bytes]) -> np.ndarray:
+    """Batch Fingerprint32 -> uint32[n]."""
+    strings = list(strings)
+    if not strings:
+        return np.empty(0, dtype=np.uint32)
+    if _use_native():
+        from ringpop_tpu import native
+
+        return native.fingerprint32_many(strings)
+    mat, lens = pack_strings(strings)
+    return fingerprint32_batch(mat, lens).astype(np.uint32)
+
+
+def ring_tokens(servers: Sequence[str], replica_points: int) -> np.ndarray:
+    """uint32[n_servers, replica_points] of farm32(addr + str(i)) — the
+    hashring vnode tokens (parity: ``hashring.go:148-154``)."""
+    if _use_native():
+        from ringpop_tpu import native
+
+        return native.ring_tokens(servers, replica_points)
+    flat = fingerprint32_many([f"{s}{i}" for s in servers for i in range(replica_points)])
+    return flat.reshape(len(servers), replica_points)
+
+
+__all__ = [
+    "fingerprint32",
+    "fingerprint32_batch",
+    "fingerprint32_many",
+    "pack_strings",
+    "ring_tokens",
+]

@@ -30,8 +30,7 @@ import numpy as np
 from ringpop_tpu import events as events_mod
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.events import EventEmitter, RingChangedEvent, RingChecksumEvent
-from ringpop_tpu.hashing import fingerprint32
-from ringpop_tpu.hashing.farm import fingerprint32_batch, pack_strings
+from ringpop_tpu.hashing import fingerprint32, fingerprint32_many, ring_tokens
 
 
 class Configuration:
@@ -72,8 +71,7 @@ class HashRing:
         toks = self._server_tokens.get(server)
         if toks is None:
             if self.hashfunc is fingerprint32:
-                mat, lens = pack_strings([f"{server}{i}" for i in range(self.replica_points)])
-                toks = fingerprint32_batch(mat, lens).astype(np.uint64)
+                toks = ring_tokens([server], self.replica_points)[0].astype(np.uint64)
             else:
                 toks = np.array(
                     [self.hashfunc(f"{server}{i}") for i in range(self.replica_points)],
@@ -186,8 +184,7 @@ class HashRing:
         with self._lock:
             if not self._server_list:
                 return [None] * len(keys)
-            mat, lens = pack_strings(keys)
-            hashes = fingerprint32_batch(mat, lens).astype(np.uint64)
+            hashes = fingerprint32_many(keys).astype(np.uint64)
             idx = np.searchsorted(self._tokens, hashes, side="left")
             idx = np.where(idx == self._tokens.shape[0], 0, idx)
             owners = self._owners[idx]

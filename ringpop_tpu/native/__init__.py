@@ -1,0 +1,131 @@
+"""Native (C++) host-plane runtime core, loaded via ctypes.
+
+The reference keeps its hot host paths in compiled Go; our host plane keeps
+them in a small C++ library (``farmhash.cpp``): scalar + batch FarmHash
+Fingerprint32 and the hashring token builder (parity:
+``hashring/hashring.go:148-154``, ``swim/memberlist.go:86``).  The library is
+compiled lazily with ``g++`` on first use and cached next to this file; every
+entry point has a pure-Python/numpy fallback in ``ringpop_tpu.hashing.farm``,
+so the framework works without a toolchain (set ``RINGPOP_TPU_NO_NATIVE=1``
+to force the fallback).
+
+ctypes releases the GIL for the duration of each call, so batch hashing can
+additionally be driven from a thread pool by callers that want host-core
+parallelism.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "farmhash.cpp")
+_SO = os.path.join(_DIR, "_rpnative.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # compile to a per-pid temp path and rename into place: concurrent
+    # builders may race but each rename publishes a complete library
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return os.path.exists(_SO)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        try:
+            _lib = _try_load()
+        finally:
+            _tried = True
+        return _lib
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("RINGPOP_TPU_NO_NATIVE"):
+        return None
+    src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0.0
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+    u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
+    lib.rp_fingerprint32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rp_fingerprint32.restype = ctypes.c_uint32
+    lib.rp_fingerprint32_batch.argtypes = [u8p, u64p, ctypes.c_uint64, u32p]
+    lib.rp_fingerprint32_batch.restype = None
+    lib.rp_ring_tokens.argtypes = [u8p, u64p, ctypes.c_uint64, ctypes.c_uint32, u32p]
+    lib.rp_ring_tokens.restype = None
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def fingerprint32(data: bytes) -> int:
+    """Scalar native hash; caller guarantees :func:`available`."""
+    lib = _load()
+    return int(lib.rp_fingerprint32(data, len(data)))
+
+
+def _pack(strings: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    offsets = np.zeros(len(strings) + 1, dtype=np.uint64)
+    np.cumsum([len(s) for s in strings], out=offsets[1:])
+    buf = np.frombuffer(b"".join(strings), dtype=np.uint8) if strings else np.empty(0, np.uint8)
+    return buf, offsets
+
+
+def fingerprint32_many(strings: Iterable[str | bytes]) -> np.ndarray:
+    """Batch native hash over arbitrary strings -> uint32[n]."""
+    bs = [s.encode("utf-8") if isinstance(s, str) else s for s in strings]
+    lib = _load()
+    buf, offsets = _pack(bs)
+    out = np.empty(len(bs), dtype=np.uint32)
+    if len(bs):
+        lib.rp_fingerprint32_batch(buf, offsets, len(bs), out)
+    return out
+
+
+def ring_tokens(servers: Sequence[str], replica_points: int) -> np.ndarray:
+    """uint32[n_servers, replica_points] of farm32(addr + str(i)) — the ring
+    build hot path in one native call."""
+    lib = _load()
+    bs = [s.encode("utf-8") for s in servers]
+    buf, offsets = _pack(bs)
+    out = np.empty(len(bs) * replica_points, dtype=np.uint32)
+    if len(bs):
+        lib.rp_ring_tokens(buf, offsets, len(bs), replica_points, out)
+    return out.reshape(len(bs), replica_points)

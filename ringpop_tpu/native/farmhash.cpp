@@ -1,0 +1,184 @@
+// Native host-plane hash core: FarmHash Fingerprint32 (farmhashmk::Hash32).
+//
+// This is the hash the reference uses everywhere (dgryski/go-farm
+// Fingerprint32: ring tokens hashring/hashring.go:107, membership checksum
+// swim/memberlist.go:86, facade ring ringpop.go:172).  Implemented from the
+// published algorithm — the same routine as the pure-Python semantic
+// reference in ringpop_tpu/hashing/farm.py, which the tests cross-check
+// against this library byte-for-byte.
+//
+// Exposed C ABI (consumed via ctypes from ringpop_tpu.native):
+//   rp_fingerprint32        — one string
+//   rp_fingerprint32_batch  — packed concatenated strings (offsets[n+1])
+//   rp_ring_tokens          — farm32(addr + decimal(i)) for every (server,
+//                             replica) pair: the hashring build hot path
+//                             (parity: hashring.go:148-154)
+//
+// Build: g++ -O3 -shared -fPIC -o _rpnative.so farmhash.cpp
+// (done lazily by ringpop_tpu/native/__init__.py)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t C1 = 0xcc9e2d51u;
+constexpr uint32_t C2 = 0x1b873593u;
+
+inline uint32_t ror32(uint32_t v, int s) {
+  return s == 0 ? v : (v >> s) | (v << (32 - s));
+}
+
+inline uint32_t fmix(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+inline uint32_t mur(uint32_t a, uint32_t h) {
+  a *= C1;
+  a = ror32(a, 17);
+  a *= C2;
+  h ^= a;
+  h = ror32(h, 19);
+  return h * 5 + 0xe6546b64u;
+}
+
+inline uint32_t fetch32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);  // little-endian hosts only (x86-64 / aarch64)
+  return v;
+}
+
+uint32_t hash32_len_0_to_4(const uint8_t* data, uint64_t n, uint32_t seed) {
+  uint32_t b = seed;
+  uint32_t c = 9;
+  for (uint64_t i = 0; i < n; i++) {
+    int8_t v = static_cast<int8_t>(data[i]);  // signed char semantics
+    b = b * C1 + static_cast<uint32_t>(static_cast<int32_t>(v));
+    c ^= b;
+  }
+  return fmix(mur(b, mur(static_cast<uint32_t>(n), c)));
+}
+
+uint32_t hash32_len_5_to_12(const uint8_t* data, uint64_t n, uint32_t seed) {
+  uint32_t a = static_cast<uint32_t>(n), b = a * 5, c = 9, d = b + seed;
+  a += fetch32(data);
+  b += fetch32(data + n - 4);
+  c += fetch32(data + ((n >> 1) & 4));
+  return fmix(seed ^ mur(c, mur(b, mur(a, d))));
+}
+
+uint32_t hash32_len_13_to_24(const uint8_t* data, uint64_t n, uint32_t seed) {
+  uint32_t a = fetch32(data + (n >> 1) - 4);
+  uint32_t b = fetch32(data + 4);
+  uint32_t c = fetch32(data + n - 8);
+  uint32_t d = fetch32(data + (n >> 1));
+  uint32_t e = fetch32(data);
+  uint32_t f = fetch32(data + n - 4);
+  uint32_t h = d * C1 + static_cast<uint32_t>(n) + seed;
+  a = ror32(a, 12) + f;
+  h = mur(c, h) + a;
+  a = ror32(a, 3) + c;
+  h = mur(e, h) + a;
+  a = ror32(a + f, 12) + d;
+  h = mur(b ^ seed, h) + a;
+  return fmix(h);
+}
+
+uint32_t hash32(const uint8_t* data, uint64_t n) {
+  if (n <= 4) return hash32_len_0_to_4(data, n, 0);
+  if (n <= 12) return hash32_len_5_to_12(data, n, 0);
+  if (n <= 24) return hash32_len_13_to_24(data, n, 0);
+
+  uint32_t h = static_cast<uint32_t>(n), g = C1 * h, f = g;
+  uint32_t a0 = ror32(fetch32(data + n - 4) * C1, 17) * C2;
+  uint32_t a1 = ror32(fetch32(data + n - 8) * C1, 17) * C2;
+  uint32_t a2 = ror32(fetch32(data + n - 16) * C1, 17) * C2;
+  uint32_t a3 = ror32(fetch32(data + n - 12) * C1, 17) * C2;
+  uint32_t a4 = ror32(fetch32(data + n - 20) * C1, 17) * C2;
+  h ^= a0;
+  h = ror32(h, 19);
+  h = h * 5 + 0xe6546b64u;
+  h ^= a2;
+  h = ror32(h, 19);
+  h = h * 5 + 0xe6546b64u;
+  g ^= a1;
+  g = ror32(g, 19);
+  g = g * 5 + 0xe6546b64u;
+  g ^= a3;
+  g = ror32(g, 19);
+  g = g * 5 + 0xe6546b64u;
+  f += a4;
+  f = ror32(f, 19) + 113;
+  uint64_t iters = (n - 1) / 20;
+  const uint8_t* p = data;
+  do {
+    uint32_t a = fetch32(p);
+    uint32_t b = fetch32(p + 4);
+    uint32_t c = fetch32(p + 8);
+    uint32_t d = fetch32(p + 12);
+    uint32_t e = fetch32(p + 16);
+    h += a;
+    g += b;
+    f += c;
+    h = mur(d, h) + e;
+    g = mur(c, g) + a;
+    f = mur(b + e * C1, f) + d;
+    f += g;
+    g += f;
+    p += 20;
+  } while (--iters != 0);
+  g = ror32(g, 11) * C1;
+  g = ror32(g, 17) * C1;
+  f = ror32(f, 11) * C1;
+  f = ror32(f, 17) * C1;
+  h = ror32(h + g, 19);
+  h = h * 5 + 0xe6546b64u;
+  h = ror32(h, 17) * C1;
+  h = ror32(h + f, 19);
+  h = h * 5 + 0xe6546b64u;
+  h = ror32(h, 17) * C1;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t rp_fingerprint32(const uint8_t* data, uint64_t len) {
+  return hash32(data, len);
+}
+
+// strings i lives at buf[offsets[i] : offsets[i+1]]; offsets has n+1 entries
+void rp_fingerprint32_batch(const uint8_t* buf, const uint64_t* offsets,
+                            uint64_t n, uint32_t* out) {
+  for (uint64_t i = 0; i < n; i++) {
+    out[i] = hash32(buf + offsets[i], offsets[i + 1] - offsets[i]);
+  }
+}
+
+// out has n_servers * replica_points entries, row-major by server:
+// out[s * replica_points + r] = farm32(server_s + decimal(r))
+void rp_ring_tokens(const uint8_t* buf, const uint64_t* offsets,
+                    uint64_t n_servers, uint32_t replica_points,
+                    uint32_t* out) {
+  std::vector<uint8_t> tmp;
+  for (uint64_t s = 0; s < n_servers; s++) {
+    uint64_t len = offsets[s + 1] - offsets[s];
+    tmp.resize(len + 24);
+    std::memcpy(tmp.data(), buf + offsets[s], len);
+    for (uint32_t r = 0; r < replica_points; r++) {
+      int d = std::snprintf(reinterpret_cast<char*>(tmp.data()) + len, 24,
+                            "%u", r);
+      out[s * replica_points + r] = hash32(tmp.data(), len + d);
+    }
+  }
+}
+
+}  // extern "C"

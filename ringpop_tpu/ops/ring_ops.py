@@ -16,16 +16,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.hashing.farm import fingerprint32_batch, pack_strings
+from ringpop_tpu.hashing import ring_tokens as _ring_tokens
 
 
 def build_ring_tokens(servers: list[str], replica_points: int = 100):
     """Host-side construction of the (tokens, owners) arrays for a server
     list — same hash/replica scheme as the host ring
-    (``hashring.go:148-154``)."""
-    all_strings = [f"{s}{i}" for s in servers for i in range(replica_points)]
-    mat, lens = pack_strings(all_strings)
-    toks = fingerprint32_batch(mat, lens).astype(np.uint32)
+    (``hashring.go:148-154``); native C++ batch hash when available."""
+    toks = _ring_tokens(servers, replica_points).reshape(-1).astype(np.uint32)
     owners = np.repeat(np.arange(len(servers), dtype=np.int32), replica_points)
     composite = toks.astype(np.uint64) << np.uint64(32) | owners.astype(np.uint64)
     order = np.argsort(composite, kind="stable")

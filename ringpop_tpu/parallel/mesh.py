@@ -31,10 +31,27 @@ from ringpop_tpu.sim.delta import DeltaParams, DeltaState, step
 
 def make_mesh(n_devices: Optional[int] = None, shape: Optional[tuple[int, int]] = None) -> Mesh:
     """2D ("node", "rumor") mesh over the first ``n_devices`` devices.
-    Default shape puts most parallelism on the node axis."""
+    Default shape puts most parallelism on the node axis.
+
+    If the default backend exposes fewer than ``n_devices`` devices (e.g. a
+    single real TPU chip), falls back to the CPU backend, which honors
+    ``--xla_force_host_platform_device_count`` — so sharding dry-runs work on
+    any host."""
     devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
+    if len(devices) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devices = cpu
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices, have {len(devices)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
     devices = devices[:n_devices]
     if shape is None:
         rumor = 2 if n_devices % 2 == 0 and n_devices > 1 else 1

@@ -1,0 +1,91 @@
+"""Native C++ hash core vs the pure-Python semantic reference.
+
+The native library (``ringpop_tpu/native/farmhash.cpp``) must produce
+bit-identical FarmHash Fingerprint32 values to ``ringpop_tpu.hashing.farm``
+— wire/checksum compatibility (reference: ``swim/memberlist.go:86``,
+``hashring/hashring.go:107``) depends on it.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+
+import numpy as np
+import pytest
+
+from ringpop_tpu import native
+from ringpop_tpu.hashing import farm
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def _rand_strings(rng: random.Random, n: int, max_len: int = 96) -> list[str]:
+    alpha = string.ascii_letters + string.digits + ".:-_/"
+    return ["".join(rng.choices(alpha, k=rng.randint(0, max_len))) for _ in range(n)]
+
+
+class TestScalar:
+    def test_all_length_classes(self):
+        # covers the 0-4 / 5-12 / 13-24 / >24 control-flow branches,
+        # including multi-iteration >24 loops
+        rng = random.Random(1)
+        for ln in list(range(0, 64)) + [100, 1000, 4096]:
+            s = bytes(rng.getrandbits(8) for _ in range(ln))
+            assert native.fingerprint32(s) == farm.fingerprint32(s), ln
+
+    def test_known_inputs(self):
+        for s in ["", "a", "hello", "10.0.0.1:3000", "10.0.0.1:30000", "x" * 200]:
+            assert native.fingerprint32(s.encode()) == farm.fingerprint32(s)
+
+    def test_high_bytes_signed_char_semantics(self):
+        # the <=4-byte branch uses signed char arithmetic
+        for s in [b"\xff", b"\x80\xff", b"\xfe\xca\xbe", b"\xde\xad\xbe\xef"]:
+            assert native.fingerprint32(s) == farm.fingerprint32(s)
+
+
+class TestBatch:
+    def test_batch_matches_scalar(self):
+        rng = random.Random(2)
+        strs = _rand_strings(rng, 300)
+        out = native.fingerprint32_many(strs)
+        expect = np.array([farm.fingerprint32(s) for s in strs], dtype=np.uint32)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_batch_matches_numpy_batch(self):
+        rng = random.Random(3)
+        strs = _rand_strings(rng, 500)
+        mat, lens = farm.pack_strings(strs)
+        expect = farm.fingerprint32_batch(mat, lens).astype(np.uint32)
+        np.testing.assert_array_equal(native.fingerprint32_many(strs), expect)
+
+    def test_empty(self):
+        assert native.fingerprint32_many([]).shape == (0,)
+
+
+class TestRingTokens:
+    def test_matches_reference_scheme(self):
+        servers = [f"10.0.0.{i}:30{i:02d}" for i in range(8)]
+        rp = 37
+        toks = native.ring_tokens(servers, rp)
+        assert toks.shape == (8, rp)
+        for si, s in enumerate(servers):
+            for r in (0, 1, 9, 10, 36):
+                assert int(toks[si, r]) == farm.fingerprint32(f"{s}{r}")
+
+
+class TestDispatch:
+    def test_hashing_frontend_uses_same_bits(self):
+        # the dispatching front-end must agree with the pure reference
+        from ringpop_tpu import hashing
+
+        rng = random.Random(4)
+        for s in _rand_strings(rng, 50):
+            assert hashing.fingerprint32(s) == farm.fingerprint32(s)
+        strs = _rand_strings(rng, 50)
+        np.testing.assert_array_equal(
+            hashing.fingerprint32_many(strs),
+            np.array([farm.fingerprint32(s) for s in strs], dtype=np.uint32),
+        )
