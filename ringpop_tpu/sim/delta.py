@@ -46,6 +46,19 @@ class DeltaState(NamedTuple):
     key: jax.Array  # PRNG key
 
 
+# int8 piggyback counters can take a sender + receiver bump (+2) in one tick
+# from max_p-1, so the usable cap is 126, not 127 — shared by every engine
+INT8_SAFE_MAX_P = 126
+
+
+def resolve_max_p(n: int, p_factor: int, max_p: Optional[int]) -> int:
+    """SWIM dissemination bound maxP = pFactor·⌈log10(n+1)⌉ unless overridden
+    (parity: ``disseminator.go:75-97``)."""
+    if max_p is not None:
+        return max_p
+    return int(p_factor * np.ceil(np.log10(n + 1)))
+
+
 @dataclass(frozen=True)
 class DeltaParams:
     n: int
@@ -54,9 +67,7 @@ class DeltaParams:
     max_p: Optional[int] = None  # override; default pFactor*ceil(log10(n+1))
 
     def resolved_max_p(self) -> int:
-        if self.max_p is not None:
-            return self.max_p
-        return int(self.p_factor * np.ceil(np.log10(self.n + 1)))
+        return resolve_max_p(self.n, self.p_factor, self.max_p)
 
 
 @dataclass(frozen=True)
@@ -71,6 +82,19 @@ jax.tree_util.register_pytree_node(
     lambda f: ((f.up, f.group), f.drop_rate),
     lambda aux, children: DeltaFaults(up=children[0], group=children[1], drop_rate=aux),
 )
+
+
+def pair_connected(faults: DeltaFaults, a, b):
+    """Static (loss-free) connectivity between node index arrays ``a`` and
+    ``b`` under the fault model: both processes up and not separated by a
+    partition group."""
+    ok = jnp.ones(a.shape, dtype=bool)
+    if faults.up is not None:
+        ok &= faults.up[a] & faults.up[b]
+    if faults.group is not None:
+        g = faults.group
+        ok &= (g[a] < 0) | (g[b] < 0) | (g[a] == g[b])
+    return ok
 
 
 def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray] = None) -> DeltaState:
@@ -92,7 +116,7 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     """One protocol period for all N nodes (jit/shard-friendly: fixed shapes,
     one segment_max scatter + one gather per tick)."""
     n, k = params.n, params.k
-    max_p = jnp.int8(min(params.resolved_max_p(), 127))
+    max_p = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
     key, k_target, k_drop = jax.random.split(state.key, 3)
 
     # random peer selection (uniform over other nodes; the reference's

@@ -44,9 +44,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.swim.member import ALIVE, FAULTY, LEAVE, SUSPECT, TOMBSTONE
-
-STATE_BITS = 3  # 5 states fit in 3 bits; key = (inc << 3) | state
+from ringpop_tpu.sim.delta import pair_connected
+from ringpop_tpu.swim.member import (
+    ALIVE,
+    FAULTY,
+    KEY_STATE_BITS as STATE_BITS,  # re-export kept for conformance harness
+    LEAVE,
+    SUSPECT,
+    TOMBSTONE,
+    is_detraction,
+    pack_key,
+)
 
 
 class FullViewState(NamedTuple):
@@ -83,12 +91,11 @@ def _now_ms(params: FullViewParams, tick) -> jax.Array:
 
 def _key_of(inc, status):
     """Override-order key: lexicographic (incarnation, precedence) as one
-    int32 — the array form of member.overrides."""
-    return (inc.astype(jnp.int32) << STATE_BITS) | status.astype(jnp.int32)
+    int32 — ``member.pack_key`` with array dtype coercion."""
+    return pack_key(inc.astype(jnp.int32), status.astype(jnp.int32))
 
 
-def _is_detraction(status):
-    return (status == SUSPECT) | (status == FAULTY) | (status == TOMBSTONE)
+_is_detraction = is_detraction
 
 
 def init_state(
@@ -146,15 +153,9 @@ def _connectivity(params, faults: Faults, key, targets):
 
 
 def _pair_connected(params, faults: Faults, a, b):
-    """Static (no-drop) connectivity between index arrays a and b."""
-    up = faults.up if faults.up is not None else None
-    ok = jnp.ones(a.shape, dtype=bool)
-    if up is not None:
-        ok &= up[a] & up[b]
-    if faults.group is not None:
-        g = faults.group
-        ok &= (g[a] < 0) | (g[b] < 0) | (g[a] == g[b])
-    return ok
+    """Static (no-drop) connectivity between index arrays a and b (shared
+    impl: ``ringpop_tpu.sim.delta.pair_connected``)."""
+    return pair_connected(faults, a, b)
 
 
 def _max_p(params, status, present, eye):
@@ -384,6 +385,15 @@ def step(
     peer_reaches = peer_ok & _pair_connected(
         params, faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape)
     )
+    # each indirect leg is its own RPC and suffers packet loss too (drawn
+    # only when drop_rate > 0, so deterministic conformance runs keep their
+    # documented RNG draw order)
+    if faults.drop_rate > 0:
+        k_pd1, k_pd2 = jax.random.split(jax.random.fold_in(k_peers, 1), 2)
+        peer_ok &= jax.random.uniform(k_pd1, peer_choices.shape) >= faults.drop_rate
+        peer_reaches &= peer_ok & (
+            jax.random.uniform(k_pd2, peer_choices.shape) >= faults.drop_rate
+        )
     if faults.up is not None:
         peer_reaches &= faults.up[targets][:, None]
     reached = peer_reaches.any(axis=1)

@@ -1,0 +1,584 @@
+"""Scalable full-lifecycle SWIM simulator: failure detection at O(N·K).
+
+The delta engine (``ringpop_tpu.sim.delta``) measures pure dissemination of
+pre-injected rumors.  This engine adds the *failure-detection dynamics* of
+the reference — probe → indirect probe → Suspect → deadline → Faulty →
+Tombstone → evict, and refutation-by-reincarnation (call stack
+``swim/node.go:470-513``, ``swim/state_transitions.go:90-117``,
+``swim/memberlist.go:337-354``) — while keeping memory O(N·K), so 100k–1M
+node clusters fit on one chip (a full per-node view is O(N²)).
+
+Representation.  Every node's view is ``converged base ⊔ learned rumors``:
+
+* ``base_{status,incarnation,present}[N]`` — the view every node agrees on;
+* a K-slot rumor table ``(subject, incarnation, status, deadline)`` — the
+  changes currently in flight;
+* ``learned[N, K]`` / ``pcount[N, K]`` — who has absorbed which rumor and
+  the SWIM piggyback counters bounding how long it rides
+  (``disseminator.go:75-97``).
+
+Because change application is a lattice max over ``key = (incarnation <<
+3) | state_precedence`` (``ringpop_tpu.swim.member``), a node's belief about
+subject ``s`` is exactly ``max(base_key[s], max of learned rumor keys about
+s)`` — order-independent, so "which rumors were learned" fully determines
+the view.
+
+A probabilistic partition healer (one attempted full rumor-swap between a
+random connected pair per tick, rate-matched to the reference's ~6
+discovery-provider calls/min — ``heal_via_discover_provider.go:63-88``)
+repairs the mutual-faulty deadlock two partitioned sides otherwise end in.
+
+Rumor lifecycle: allocated (probe failure / refutation / fired timer) →
+disseminated by piggybacking on ping request+response legs → learned by all
+live nodes → **folded into the base** (its pending deadline transfers to a
+per-subject base timer) → slot freed for reuse.  Saturation of the K slots
+just delays new declarations a tick — they regenerate as long as their
+cause persists.
+
+Deliberate approximations vs the reference (documented, aggregate-faithful):
+
+* suspicion timers are per-rumor (earliest declarer's clock), not
+  per-(observer, subject) — the reference's first-firing timer is the one
+  that generates the Faulty change anyway (``state_transitions.go:90-117``);
+* a node whose sampled ping target is believed unpingable idles for a tick
+  instead of advancing a shuffled iterator (``memberlist_iter.go:50-72``);
+* a rumor that expired (maxP) before reaching every live node is re-seeded
+  (counters reset) — the analog of the checksum-mismatch full-sync repair
+  path (``disseminator.go:156-304``), without shipping O(N) payloads;
+* eviction clears the subject from the shared base once the Tombstone is
+  fully disseminated, instead of per-view removal (``memberlist.go:271-279``).
+
+Exact per-node semantics (including the paths above in full) live in the
+O(N²) ``fullview`` engine; the lockstep conformance harness validates that
+engine against the sequential host plane.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim.delta import (
+    INT8_SAFE_MAX_P,
+    DeltaFaults,
+    pair_connected as _pair_connected,
+    resolve_max_p,
+)
+from ringpop_tpu.swim.member import (
+    ALIVE,
+    FAULTY,
+    SUSPECT,
+    TOMBSTONE,
+    is_detraction as _is_detraction,
+    is_pingable,
+    key_incarnation,
+    key_state,
+    pack_key,
+)
+
+NO_DEADLINE = np.int32(np.iinfo(np.int32).max)
+
+
+class LifecycleState(NamedTuple):
+    # rumor table (K slots; subject -1 = free)
+    r_subject: jax.Array  # int32[K]
+    r_inc: jax.Array  # int32[K] incarnation (protocol-tick counter)
+    r_status: jax.Array  # int8[K]
+    r_deadline: jax.Array  # int32[K] tick when the state timer fires
+    # per-(node, rumor)
+    learned: jax.Array  # bool[N, K]
+    pcount: jax.Array  # int8[N, K]
+    # converged base view shared by all nodes
+    base_status: jax.Array  # int8[N]
+    base_inc: jax.Array  # int32[N]
+    base_present: jax.Array  # bool[N]
+    base_pending: jax.Array  # int8[N] scheduled transition source state or -1
+    base_deadline: jax.Array  # int32[N]
+    # each node's own incarnation (refutation bumps it)
+    self_inc: jax.Array  # int32[N]
+    tick: jax.Array  # int32
+    key: jax.Array  # PRNG key
+
+
+@dataclass(frozen=True)
+class LifecycleParams:
+    n: int
+    k: int = 128  # rumor-slot capacity
+    # reference defaults in ticks (protocol period 200ms, swim/node.go:74-100)
+    suspect_ticks: int = 25  # 5s
+    faulty_ticks: int = 432000  # 24h
+    tombstone_ticks: int = 300  # 60s
+    ping_req_size: int = 3
+    p_factor: int = 15
+    max_p: Optional[int] = None
+    alloc_per_tick: int = 64  # new-rumor budget per tick (<= k)
+    tick_ms: int = 200  # simulated ms per tick (reporting only)
+    # partition-healer attempt rate, cluster-wide per tick.  Reference: each
+    # node tries every 30s with probability 3/n → ~one attempt per 10s
+    # cluster-wide (swim/node.go:59-67, heal_via_discover_provider.go:63-88),
+    # i.e. ~0.02 per 200ms tick.
+    heal_prob: float = 0.02
+
+    def resolved_max_p(self) -> int:
+        return resolve_max_p(self.n, self.p_factor, self.max_p)
+
+
+def init_state(params: LifecycleParams, seed: int = 0) -> LifecycleState:
+    n, k = params.n, params.k
+    return LifecycleState(
+        r_subject=jnp.full((k,), -1, jnp.int32),
+        r_inc=jnp.zeros((k,), jnp.int32),
+        r_status=jnp.zeros((k,), jnp.int8),
+        r_deadline=jnp.full((k,), NO_DEADLINE, jnp.int32),
+        learned=jnp.zeros((n, k), bool),
+        pcount=jnp.zeros((n, k), jnp.int8),
+        base_status=jnp.zeros((n,), jnp.int8),
+        base_inc=jnp.zeros((n,), jnp.int32),
+        base_present=jnp.ones((n,), bool),
+        base_pending=jnp.full((n,), -1, jnp.int8),
+        base_deadline=jnp.full((n,), NO_DEADLINE, jnp.int32),
+        self_inc=jnp.zeros((n,), jnp.int32),
+        tick=jnp.asarray(0, jnp.int32),
+        key=jax.random.PRNGKey(seed),
+    )
+
+
+def _key_of(inc, status):
+    """``member.pack_key`` with array dtype coercion."""
+    return pack_key(inc.astype(jnp.int32), status.astype(jnp.int32))
+
+
+def _status_of(key):
+    return key_state(key).astype(jnp.int8)
+
+
+_inc_of = key_incarnation
+
+
+def step(
+    params: LifecycleParams,
+    state: LifecycleState,
+    faults: DeltaFaults = DeltaFaults(),
+) -> LifecycleState:
+    """One protocol period for all N nodes.  Fixed shapes throughout; jit-
+    and shard-friendly (the only cross-node ops are segment reductions by
+    ping target / rumor subject and row gathers)."""
+    n, k = params.n, params.k
+    m = min(params.alloc_per_tick, params.k, params.n)
+    maxp = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
+    key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
+    # incarnation epoch = tick counter (strictly increasing, like the
+    # reference's wall-ms but 200× denser in int32: 2^28 ticks ≈ 621 days of
+    # simulated time before the packed key would overflow)
+    now = state.tick + 1
+    i_all = jnp.arange(n, dtype=jnp.int32)
+
+    up = faults.up if faults.up is not None else jnp.ones(n, bool)
+
+    active = state.r_subject >= 0
+    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+    # segment id n == dump bucket for free slots
+    subj = jnp.where(active, state.r_subject, jnp.int32(n))
+    subj_rumor_max = jnp.maximum(
+        jax.ops.segment_max(rkey, subj, num_segments=n + 1)[:n], jnp.int32(-1)
+    )
+    base_key = jnp.where(
+        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+    )
+    eff_max = jnp.maximum(subj_rumor_max, base_key)
+
+    # -- ping target selection + belief gate --------------------------------
+    targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+    targets = jnp.where(targets >= i_all, targets + 1, targets)
+    # belief[i] about its target: max(base, learned rumors about target)
+    bmask = state.learned & active[None, :] & (state.r_subject[None, :] == targets[:, None])
+    bel_rumor = jnp.max(
+        jnp.where(bmask, rkey[None, :], jnp.int32(-1)), axis=1, initial=jnp.int32(-1)
+    )
+    bel = jnp.maximum(bel_rumor, base_key[targets])
+    bel_status = _status_of(jnp.maximum(bel, 0))
+    believes_pingable = (bel >= 0) & is_pingable(bel_status)
+    wants = up & believes_pingable
+
+    conn = _pair_connected(faults, i_all, targets)
+    if faults.drop_rate > 0:
+        conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
+    delivered = conn & wants
+
+    # -- piggyback exchange: request leg (scatter-or) + response (gather) ---
+    riding = state.learned & active[None, :] & (state.pcount < maxp)
+    sent = riding & delivered[:, None]
+    inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+    learned = state.learned | inbound
+    resp = (learned & active[None, :] & (state.pcount < maxp))[targets] & delivered[:, None]
+    learned = learned | resp
+
+    got_pinged = jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
+    bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
+    pcount = jnp.minimum(state.pcount + bump, maxp)
+    pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)
+
+    # -- partition healer (heal_via_discover_provider.go, heal_partition.go):
+    # a discovery provider knows every address, so the heal channel ignores
+    # belief gating.  One probabilistic attempt per tick: a random connected
+    # pair swaps its full rumor set (the join + membership-merge of
+    # AttemptHeal); detractions thereby reach their subjects, whose
+    # refutations re-establish cross-partition liveness.
+    if params.heal_prob > 0:
+        kh1, kh2, kh3 = jax.random.split(k_heal, 3)
+        h = jax.random.randint(kh1, (), 0, n, dtype=jnp.int32)
+        p = jax.random.randint(kh2, (), 0, n, dtype=jnp.int32)
+        attempt = (
+            (jax.random.uniform(kh3, ()) < params.heal_prob)
+            & (h != p)
+            & up[h]
+            & up[p]
+            & _pair_connected(faults, h[None], p[None])[0]
+        )
+        pair = (i_all == h) | (i_all == p)
+        merged = (learned[h] | learned[p]) & active
+        learned = jnp.where((pair & attempt)[:, None], merged[None, :], learned)
+        # a join transfer restarts dissemination of everything it carried
+        pcount = jnp.where((pair & attempt)[:, None] & merged[None, :], jnp.int8(0), pcount)
+
+    # full-sync analog: re-seed rumors that expired short of full coverage
+    live_col = up[:, None]
+    riding_now = learned & active[None, :] & (pcount < maxp) & live_col
+    fully_learned = jnp.all(learned | ~live_col, axis=0) & active
+    stuck = active & ~riding_now.any(axis=0) & ~fully_learned
+    pcount = jnp.where(stuck[None, :] & learned, jnp.int8(0), pcount)
+
+    state = state._replace(learned=learned, pcount=pcount)
+
+    # -- timers fire: slot rumors (state_transitions.go:90-117) -------------
+    due = active & (state.tick >= state.r_deadline)
+    dominant = rkey >= eff_max[jnp.clip(subj, 0, n - 1)]
+    fire = due & dominant
+    fire_subj = jnp.clip(subj, 0, n - 1)
+    # a transition can only fire where some live node can seed the successor
+    # rumor; otherwise the deadline persists and the slot is reclaimed below
+    has_live_learner = (learned & live_col).any(axis=0)
+    fire_s = fire & (state.r_status == SUSPECT) & has_live_learner
+    fire_f = fire & (state.r_status == FAULTY) & has_live_learner
+    # eviction additionally waits for the tombstone to be fully disseminated
+    # (per-view eviction in the reference only completes once every node has
+    # learned it); an undisseminated tombstone's deadline simply refires
+    fire_t = fire & (state.r_status == TOMBSTONE) & fully_learned
+    slot_next = jnp.where(fire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))
+    slot_cand = jnp.where(
+        fire_s | fire_f, _key_of(state.r_inc, slot_next), jnp.int32(-1)
+    )
+    fire_key = jnp.maximum(
+        jax.ops.segment_max(slot_cand, subj, num_segments=n + 1)[:n], jnp.int32(-1)
+    )
+    # seed for a fired transition: first live node that learned the rumor
+    slot_seed = jnp.argmax(state.learned & live_col, axis=0).astype(jnp.int32)
+    seed_node = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(fire_s | fire_f, slot_seed, jnp.int32(-1)), subj, num_segments=n + 1
+        )[:n],
+        jnp.int32(-1),
+    )
+    # deadlines are NOT cleared here: a fired transition's deadline survives
+    # until its successor rumor actually allocates (deferred clear below), so
+    # K-slot saturation only delays the transition instead of dropping it
+    r_deadline = state.r_deadline
+
+    # dominated base timers cancel; due+dominant base timers fire
+    bdue = (state.base_pending >= 0) & (state.tick >= state.base_deadline) & state.base_present
+    bdom = base_key >= subj_rumor_max
+    bfire = bdue & bdom
+    base_pending = jnp.where(bdue & ~bdom, jnp.int8(-1), state.base_pending)
+    bfire_s = bfire & (state.base_pending == SUSPECT)
+    bfire_f = bfire & (state.base_pending == FAULTY)
+    bfire_t = bfire & (state.base_pending == TOMBSTONE)
+    first_live = jnp.argmax(up).astype(jnp.int32)
+    bfire_key = jnp.where(
+        bfire_s | bfire_f,
+        _key_of(state.base_inc, jnp.where(bfire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))),
+        jnp.int32(-1),
+    )
+    fire_key = jnp.maximum(fire_key, bfire_key)
+    seed_node = jnp.where(bfire_key > jnp.int32(-1), first_live, seed_node)
+
+    # -- evictions (tombstone timer expired; memberlist.Evict analog) -------
+    evicted = jnp.zeros((n,), bool).at[jnp.clip(subj, 0, n - 1)].max(fire_t) | bfire_t
+    base_present = state.base_present & ~evicted
+    freed_by_evict = active & evicted[jnp.clip(subj, 0, n - 1)]
+
+    # -- fold fully-learned dominant rumors into the base -------------------
+    foldable = fully_learned & (rkey >= eff_max[jnp.clip(subj, 0, n - 1)]) & ~freed_by_evict
+    folded_key = jnp.maximum(
+        jax.ops.segment_max(jnp.where(foldable, rkey, jnp.int32(-1)), subj, num_segments=n + 1)[:n],
+        jnp.int32(-1),
+    )
+    fold_mask = folded_key >= 0
+    base_status = jnp.where(fold_mask, _status_of(jnp.maximum(folded_key, 0)), state.base_status)
+    base_inc = jnp.where(fold_mask, _inc_of(jnp.maximum(folded_key, 0)), state.base_inc)
+    # transfer the folded rumor's pending deadline to the base timer
+    fold_dl = jax.ops.segment_min(
+        jnp.where(
+            foldable & (rkey == folded_key[jnp.clip(subj, 0, n - 1)]),
+            r_deadline,
+            NO_DEADLINE,
+        ),
+        subj,
+        num_segments=n + 1,
+    )[:n]
+    base_pending = jnp.where(
+        fold_mask,
+        jnp.where(fold_dl < NO_DEADLINE, _status_of(jnp.maximum(folded_key, 0)), jnp.int8(-1)),
+        base_pending,
+    )
+    base_deadline = jnp.where(fold_mask, fold_dl, state.base_deadline)
+    # free every slot of a folded subject (all are dominated by the base
+    # now), plus dead rumors whose only learners have crashed — freeing them
+    # drops eff_max so a live prober can re-declare from scratch
+    freed = (
+        freed_by_evict
+        | (active & fold_mask[jnp.clip(subj, 0, n - 1)])
+        | (active & ~has_live_learner)
+    )
+    r_subject = jnp.where(freed, jnp.int32(-1), state.r_subject)
+    learned = state.learned & ~freed[None, :]
+    pcount = jnp.where(freed[None, :], jnp.int8(0), state.pcount)
+    active = r_subject >= 0
+    base_key = jnp.where(base_present, _key_of(base_inc, base_status), jnp.int32(-1))
+    subj = jnp.where(active, r_subject, jnp.int32(n))
+    subj_rumor_max = jnp.maximum(
+        jax.ops.segment_max(
+            jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1)),
+            subj,
+            num_segments=n + 1,
+        )[:n],
+        jnp.int32(-1),
+    )
+    eff_max = jnp.maximum(subj_rumor_max, base_key)
+
+    # -- refutation candidates (memberlist.go:337-354) ----------------------
+    self_mask = learned & active[None, :] & (r_subject[None, :] == i_all[:, None])
+    self_detract = jnp.any(
+        self_mask
+        & _is_detraction(state.r_status)[None, :]
+        & (state.r_inc[None, :] >= state.self_inc[:, None]),
+        axis=1,
+    )
+    base_detract = (
+        _is_detraction(base_status) & (base_inc >= state.self_inc) & base_present
+    )
+    refute = up & (self_detract | base_detract)
+    refute_key = jnp.where(refute, _key_of(now, jnp.int8(ALIVE)), jnp.int32(-1))
+
+    # -- failed probe → indirect probes → Suspect (node.go:494-510) ---------
+    probing = wants & ~conn
+    k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
+    peer_choices = jax.random.randint(
+        k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
+    )
+    i_bcast = jnp.broadcast_to(i_all[:, None], peer_choices.shape)
+    peer_ok = (
+        _pair_connected(faults, i_bcast, peer_choices)
+        & (peer_choices != i_bcast)
+        & (peer_choices != targets[:, None])
+    )
+    peer_reaches = (
+        peer_ok
+        & _pair_connected(faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape))
+        & up[targets][:, None]
+    )
+    # each indirect leg is its own RPC and suffers packet loss too
+    if faults.drop_rate > 0:
+        peer_ok &= jax.random.uniform(k_pd1, peer_choices.shape) >= faults.drop_rate
+        peer_reaches &= peer_ok & (
+            jax.random.uniform(k_pd2, peer_choices.shape) >= faults.drop_rate
+        )
+    reached = peer_reaches.any(axis=1)
+    inconclusive = (~peer_ok).all(axis=1)
+    declare = probing & ~reached & ~inconclusive
+    susp_cand = jnp.where(
+        declare, _key_of(_inc_of(jnp.maximum(bel, 0)), jnp.int8(SUSPECT)), jnp.int32(-1)
+    )
+    susp_key = jnp.maximum(
+        jax.ops.segment_max(
+            susp_cand, jnp.where(declare, targets, jnp.int32(n)), num_segments=n + 1
+        )[:n],
+        jnp.int32(-1),
+    )
+    susp_key = jnp.where(susp_key > eff_max, susp_key, jnp.int32(-1))
+
+    # -- merge per-subject candidates & allocate into free slots ------------
+    cand = jnp.maximum(jnp.maximum(refute_key, susp_key), fire_key)
+    cand_vals, cand_subj = jax.lax.top_k(cand, m)
+    free_vals, free_slots = jax.lax.top_k((~active).astype(jnp.int32), m)
+    place = (cand_vals >= 0) & (free_vals == 1)
+
+    new_status = _status_of(jnp.maximum(cand_vals, 0))
+    new_inc = _inc_of(jnp.maximum(cand_vals, 0))
+    new_dl = jnp.where(
+        new_status == SUSPECT,
+        state.tick + params.suspect_ticks,
+        jnp.where(
+            new_status == FAULTY,
+            state.tick + params.faulty_ticks,
+            jnp.where(new_status == TOMBSTONE, state.tick + params.tombstone_ticks, NO_DEADLINE),
+        ),
+    )
+    r_subject = r_subject.at[free_slots].set(jnp.where(place, cand_subj, r_subject[free_slots]))
+    r_inc = state.r_inc.at[free_slots].set(jnp.where(place, new_inc, state.r_inc[free_slots]))
+    r_status = state.r_status.at[free_slots].set(
+        jnp.where(place, new_status, state.r_status[free_slots])
+    )
+    r_deadline = r_deadline.at[free_slots].set(jnp.where(place, new_dl, r_deadline[free_slots]))
+
+    # fresh slots start unlearned, then get seeded
+    placed_col = jnp.zeros((k,), bool).at[free_slots].set(place)
+    learned = learned & ~placed_col[None, :]
+    pcount = jnp.where(placed_col[None, :], jnp.int8(0), pcount)
+
+    # seed row per placed candidate: refute → the subject itself; timer
+    # transition → first live learner of the precursor rumor.  Fresh suspect
+    # rumors are seeded by their declarers below, not here.
+    seed_rows = jnp.where(new_status == ALIVE, cand_subj, seed_node[cand_subj])
+    seed_ok = place & (new_status != SUSPECT) & (seed_rows >= 0)
+    learned = learned.at[jnp.clip(seed_rows, 0, n - 1), free_slots].max(seed_ok)
+    # suspect rumors: every declarer that targeted the subject seeds it
+    subj_to_slot = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
+        jnp.where(place & (new_status == SUSPECT), free_slots, jnp.int32(-1))
+    )
+    decl_slot = subj_to_slot[targets]
+    decl_ok = declare & (decl_slot >= 0)
+    learned = learned.at[i_all, jnp.clip(decl_slot, 0, k - 1)].max(decl_ok)
+
+    # refutation bumps the refuter's own incarnation (iff its rumor placed)
+    placed_subject = jnp.zeros((n,), bool).at[cand_subj].max(place & (new_status == ALIVE))
+    self_inc = jnp.where(refute & placed_subject, now, state.self_inc)
+
+    # deferred timer clears: a fired suspect/faulty timer only retires once a
+    # rumor at least as strong as its successor was actually allocated for
+    # its subject (otherwise it refires next tick and retries)
+    placed_key = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
+        jnp.where(place, cand_vals, jnp.int32(-1))
+    )
+    slot_fired_ok = (
+        (fire_s | fire_f) & (placed_key[fire_subj] >= slot_cand) & ~placed_col
+    )
+    r_deadline = jnp.where(slot_fired_ok, NO_DEADLINE, r_deadline)
+    base_fired_ok = (
+        (bfire_s | bfire_f) & (bfire_key >= 0) & (placed_key >= bfire_key)
+    ) | bfire_t
+    base_pending = jnp.where(base_fired_ok, jnp.int8(-1), base_pending)
+
+    return LifecycleState(
+        r_subject=r_subject,
+        r_inc=r_inc,
+        r_status=r_status,
+        r_deadline=r_deadline,
+        learned=learned,
+        pcount=pcount,
+        base_status=base_status,
+        base_inc=base_inc,
+        base_present=base_present,
+        base_pending=base_pending,
+        base_deadline=base_deadline,
+        self_inc=self_inc,
+        tick=state.tick + 1,
+        key=key,
+    )
+
+
+# -- queries ----------------------------------------------------------------
+
+
+def believed_key(state: LifecycleState, subjects) -> jax.Array:
+    """int32[N, S]: node i's belief key about each subject (-1 = not
+    present).  O(N·K·S) — intended for small subject lists."""
+    subjects = jnp.asarray(subjects, jnp.int32)
+    active = state.r_subject >= 0
+    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+    sel = active[:, None] & (state.r_subject[:, None] == subjects[None, :])  # [K, S]
+    per_rumor = jnp.where(sel[None, :, :], rkey[None, :, None], jnp.int32(-1))  # [1,K,S]
+    bel_rumor = jnp.max(
+        jnp.where(state.learned[:, :, None], per_rumor, jnp.int32(-1)),
+        axis=1,
+        initial=jnp.int32(-1),
+    )  # [N, S]
+    base_key = jnp.where(
+        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+    )
+    return jnp.maximum(bel_rumor, base_key[subjects][None, :])
+
+
+def believed_status(state: LifecycleState, subjects) -> jax.Array:
+    """int8[N, S]: belief status; -1 where the subject is absent."""
+    bk = believed_key(state, subjects)
+    return jnp.where(bk >= 0, _status_of(jnp.maximum(bk, 0)), jnp.int8(-1))
+
+
+def detection_fraction(
+    state: LifecycleState,
+    subjects,
+    faults: DeltaFaults = DeltaFaults(),
+    min_status: int = FAULTY,
+) -> jax.Array:
+    """float[S]: fraction of live observers whose belief about each subject
+    has reached ``min_status`` (or the subject is evicted)."""
+    subjects = jnp.asarray(subjects, jnp.int32)
+    bk = believed_key(state, subjects)
+    detected = (bk < 0) | (_status_of(jnp.maximum(bk, 0)) >= min_status)
+    up = faults.up if faults.up is not None else jnp.ones(state.learned.shape[0], bool)
+    is_subject = jnp.zeros_like(up).at[subjects].set(True)
+    observer = up & ~is_subject
+    num = (detected & observer[:, None]).sum(axis=0)
+    return num / jnp.maximum(observer.sum(), 1)
+
+
+def _run_block(params: LifecycleParams, state, faults, ticks: int):
+    return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
+
+
+class LifecycleSim:
+    """Convenience wrapper: jitted step + detection queries.  The jitted
+    multi-tick block is cached on the instance (keyed on the static tick
+    count; faults flow through as a traced pytree), so repeated run calls
+    reuse one compilation."""
+
+    def __init__(self, n: int, seed: int = 0, **kw):
+        self.params = LifecycleParams(n=n, **kw)
+        self.state = init_state(self.params, seed=seed)
+        self._step = jax.jit(functools.partial(step, self.params))
+        self._block = jax.jit(
+            functools.partial(_run_block, self.params), static_argnames="ticks"
+        )
+
+    def tick(self, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
+        self.state = self._step(self.state, faults)
+        return self.state
+
+    def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
+        self.state = self._block(self.state, faults, ticks=ticks)
+        return self.state
+
+    def run_until_detected(
+        self,
+        subjects: Sequence[int],
+        faults: DeltaFaults = DeltaFaults(),
+        min_status: int = FAULTY,
+        max_ticks: int = 5000,
+        check_every: int = 8,
+    ):
+        """Tick until every live observer believes every subject has reached
+        ``min_status``.  Returns (ticks_used, detected)."""
+        subjects = jnp.asarray(list(subjects), jnp.int32)
+        ticks = 0
+        while ticks < max_ticks:
+            self.state = self._block(self.state, faults, ticks=check_every)
+            ticks += check_every
+            frac = detection_fraction(self.state, subjects, faults, min_status)
+            if bool((frac >= 1.0).all()):
+                return ticks, True
+        return ticks, False

@@ -73,10 +73,14 @@ def local_override(inc_change, state_change, inc_local):
     reincarnation: any Suspect/Faulty/Tombstone claim at incarnation >= ours
     (parity: ``member.go:98-110`` localOverride).  Works elementwise on
     arrays."""
-    is_detraction = (state_change == SUSPECT) | (state_change == FAULTY) | (
-        state_change == TOMBSTONE
-    )
-    return is_detraction & (inc_change >= inc_local)
+    return is_detraction(state_change) & (inc_change >= inc_local)
+
+
+def is_detraction(state):
+    """Suspect/Faulty/Tombstone claims are detractions — the ones a live
+    subject must refute (the predicate inside ``member.go:98-110``
+    localOverride).  Elementwise on arrays."""
+    return (state == SUSPECT) | (state == FAULTY) | (state == TOMBSTONE)
 
 
 def is_reachable(state):
@@ -87,6 +91,28 @@ def is_reachable(state):
 
 
 is_pingable = is_reachable
+
+
+# -- packed override keys (sim plane) ----------------------------------------
+# The (incarnation, state-precedence) lexicographic order of ``overrides``
+# packs into one int32 so array engines can take lattice maxes over it.
+# 5 states fit in 3 bits; incarnations get 28 bits.
+
+KEY_STATE_BITS = 3
+
+
+def pack_key(incarnation, state):
+    """Order-embedding of ``overrides``: pack_key(a) > pack_key(b) iff
+    change a overrides b.  Works on ints and int32 arrays."""
+    return (incarnation << KEY_STATE_BITS) | state
+
+
+def key_state(key):
+    return key & ((1 << KEY_STATE_BITS) - 1)
+
+
+def key_incarnation(key):
+    return key >> KEY_STATE_BITS
 
 
 @dataclass

@@ -1,0 +1,221 @@
+"""Lifecycle engine: failure-detection dynamics at O(N·K).
+
+Covers the SWIM lifecycle the reference implements per-node
+(``swim/node.go:470-513``, ``state_transitions.go:90-117``,
+``memberlist.go:337-354``) as emergent behavior of the vectorized engine:
+crash → suspect → faulty, false suspicion → refutation, partition → heal,
+eviction, and slot recycling under churn.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.sim.lifecycle import (
+    LifecycleParams,
+    LifecycleSim,
+    believed_status,
+    detection_fraction,
+    init_state,
+    step,
+)
+from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
+
+
+def make_faults(n, down=(), group=None, drop=0.0):
+    up = np.ones(n, bool)
+    for i in down:
+        up[i] = False
+    g = None if group is None else jnp.asarray(group, jnp.int32)
+    return DeltaFaults(up=jnp.asarray(up), group=g, drop_rate=drop)
+
+
+def test_steady_state_quiet():
+    """No faults → no rumors ever allocated; base stays all-alive."""
+    sim = LifecycleSim(n=32, k=16, seed=0)
+    sim.run(50)
+    assert int((sim.state.r_subject >= 0).sum()) == 0
+    assert bool((sim.state.base_status == ALIVE).all())
+    assert bool(sim.state.base_present.all())
+
+
+def test_crash_detected_and_becomes_faulty():
+    """A crashed node is suspected, then declared faulty after the suspicion
+    deadline, and every live node converges on that belief."""
+    n = 64
+    sim = LifecycleSim(n=n, k=32, seed=1, suspect_ticks=10)
+    faults = make_faults(n, down=[7])
+    ticks, ok = sim.run_until_detected([7], faults, min_status=FAULTY, max_ticks=600)
+    assert ok, f"not detected after {ticks} ticks"
+    # other nodes stay believed-alive everywhere
+    others = believed_status(sim.state, [3, 19])
+    assert bool((others == ALIVE).all())
+
+
+def test_false_suspicion_refuted():
+    """Suspicion of a LIVE node is refuted by reincarnation: the victim
+    reasserts Alive at a higher incarnation and never turns faulty."""
+    n = 48
+    params = LifecycleParams(n=n, k=32, suspect_ticks=12)
+    state = init_state(params, seed=2)
+    # drop every message for a while: probes fail, suspects pile up,
+    # but ping-reqs also fail -> inconclusive, no declarations. Instead,
+    # partition node 5 away briefly so it gets suspected, then heal.
+    group = np.zeros(n, np.int32)
+    group[5] = 1
+    part = DeltaFaults(up=jnp.ones(n, bool), group=jnp.asarray(group))
+    heal = DeltaFaults(up=jnp.ones(n, bool))
+    for _ in range(8):
+        state = step(params, state, part)
+    # under partition some nodes should have declared node 5 suspect
+    sus = believed_status(state, [5])
+    assert int((sus == SUSPECT).sum()) > 0
+    # heal before the suspicion deadline can finish propagating faulty
+    for _ in range(60):
+        state = step(params, state, heal)
+    final = believed_status(state, [5])
+    assert bool((final == ALIVE).all()), np.asarray(final).tolist()
+    # refutation bumped the victim's incarnation
+    assert int(state.self_inc[5]) > 0
+
+
+def test_faulty_to_tombstone_to_evict():
+    """The faulty→tombstone→evict chain runs on deadline arrays (reference
+    state_transitions.go:90-117 + memberlist.Evict)."""
+    n = 32
+    sim = LifecycleSim(
+        n=n, k=32, seed=3, suspect_ticks=5, faulty_ticks=10, tombstone_ticks=10
+    )
+    faults = make_faults(n, down=[4])
+    # long enough for suspect(5) + faulty(10) + tombstone(10) + dissemination
+    for _ in range(40):
+        sim.tick(faults)
+    ticks, ok = sim.run_until_detected([4], faults, min_status=TOMBSTONE, max_ticks=800)
+    assert ok
+    # eventually evicted from the base entirely
+    for _ in range(400):
+        sim.tick(faults)
+        if not bool(sim.state.base_present[4]):
+            break
+    assert not bool(sim.state.base_present[4])
+
+
+def test_partition_detection_and_heal():
+    """30%/70% partition: each side declares the other faulty; healing the
+    partition lets refutations re-establish a fully-alive view."""
+    n = 40
+    sim = LifecycleSim(n=n, k=96, seed=4, suspect_ticks=8, alloc_per_tick=96)
+    group = np.zeros(n, np.int32)
+    group[: int(0.3 * n)] = 1
+    part = DeltaFaults(up=jnp.ones(n, bool), group=jnp.asarray(group))
+    for _ in range(120):
+        sim.tick(part)
+    # majority side believes minority faulty
+    minority = list(range(int(0.3 * n)))
+    frac = detection_fraction(sim.state, minority, part, min_status=FAULTY)
+    assert float(frac.mean()) > 0.5
+    # heal: everyone reconverges to alive within a few hundred ticks
+    heal = DeltaFaults(up=jnp.ones(n, bool))
+    ok = False
+    for _ in range(40):
+        for _ in range(10):
+            sim.tick(heal)
+        status = believed_status(sim.state, list(range(n)))
+        if bool((status == ALIVE).all()):
+            ok = True
+            break
+    assert ok, "views did not reconverge to all-alive after heal"
+
+
+def test_slot_recycling_under_sequential_churn():
+    """K slots far below total event count: folding must recycle slots."""
+    n = 48
+    sim = LifecycleSim(n=n, k=16, seed=5, suspect_ticks=4, faulty_ticks=100000)
+    down = []
+    for victim in (3, 9, 21, 33):
+        down.append(victim)
+        faults = make_faults(n, down=down)
+        ticks, ok = sim.run_until_detected(
+            down, faults, min_status=FAULTY, max_ticks=900
+        )
+        assert ok, f"victim {victim} undetected (slots leaked?)"
+    # all four victims faulty, slots mostly reclaimed
+    assert int((sim.state.r_subject >= 0).sum()) <= 16
+
+
+def test_slot_saturation_retries_transitions():
+    """K far too small for the concurrent failures: fired suspicion timers
+    must retry until their successor rumor finds a slot (regression: a
+    fired-but-unplaced transition used to be dropped forever)."""
+    n = 24
+    sim = LifecycleSim(n=n, k=2, seed=11, suspect_ticks=4, alloc_per_tick=2)
+    victims = [1, 2, 3]
+    faults = make_faults(n, down=victims)
+    ticks, ok = sim.run_until_detected(victims, faults, min_status=FAULTY, max_ticks=2000)
+    assert ok, f"saturated slots dropped a transition (after {ticks} ticks)"
+
+
+def test_packet_loss_still_converges():
+    """BASELINE config: 5% packet loss — detection still completes and no
+    live node ends up believed-faulty."""
+    n = 64
+    sim = LifecycleSim(n=n, k=64, seed=6, suspect_ticks=10, alloc_per_tick=64)
+    faults = make_faults(n, down=[11], drop=0.05)
+    ticks, ok = sim.run_until_detected([11], faults, min_status=FAULTY, max_ticks=1500)
+    assert ok
+    # spurious suspicions from drops must have been refuted by now
+    sim.run(150, make_faults(n, down=[11], drop=0.0))
+    status = believed_status(sim.state, [0, 1, 2, 30, 63])
+    assert bool((status == ALIVE).all())
+
+
+def test_jit_shapes_stable_and_sharded():
+    """The step runs under jit with in/out shardings on the 8-device CPU
+    mesh (node × rumor), proving the multi-chip path compiles + executes."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("node", "rumor"))
+    params = LifecycleParams(n=64, k=16, suspect_ticks=6)
+    state = init_state(params, seed=7)
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    shardings = state._replace(
+        r_subject=sh(P("rumor")),
+        r_inc=sh(P("rumor")),
+        r_status=sh(P("rumor")),
+        r_deadline=sh(P("rumor")),
+        learned=sh(P("node", "rumor")),
+        pcount=sh(P("node", "rumor")),
+        base_status=sh(P("node")),
+        base_inc=sh(P("node")),
+        base_present=sh(P("node")),
+        base_pending=sh(P("node")),
+        base_deadline=sh(P("node")),
+        self_inc=sh(P("node")),
+        tick=sh(P()),
+        key=sh(P()),
+    )
+    state = jax.tree.map(jax.device_put, state, shardings)
+    faults = make_faults(64, down=[9])
+    stepper = jax.jit(lambda s: step(params, s, faults))
+    for _ in range(30):
+        state = stepper(state)
+    assert int(state.tick) == 30
+    frac = detection_fraction(state, [9], faults, min_status=SUSPECT)
+    assert float(frac[0]) >= 0.0  # executes end-to-end under sharding
+
+
+@pytest.mark.slow
+def test_scale_spot_check_20k():
+    """100k-class config scaled for CI: 20k nodes, crash 5, detect all."""
+    n = 20_000
+    sim = LifecycleSim(n=n, k=128, seed=8, suspect_ticks=15)
+    victims = [17, 999, 5000, 12345, 19999]
+    faults = make_faults(n, down=victims)
+    ticks, ok = sim.run_until_detected(victims, faults, min_status=FAULTY, max_ticks=1200)
+    assert ok, f"only partial detection after {ticks} ticks"

@@ -98,6 +98,24 @@ class TestFullView:
         inc = np.asarray(sim.state.incarnation)
         assert all(inc[i, 0] > 0 for i in range(n))
 
+    def test_packet_loss_on_all_probe_legs(self):
+        """drop_rate>0 exercises loss on the direct ping AND both indirect
+        ping-req legs; heavy loss must still detect a dead node and never
+        wedge a live cluster in a non-alive view."""
+        n = 16
+        sim = FullViewSim(n, seed=9, suspect_ticks=6)
+        up = np.ones(n, bool)
+        up[5] = False
+        faults = Faults(up=jnp.asarray(up), drop_rate=0.3)
+        sim.run(120, faults)
+        sm = sim.status_matrix()
+        live = [i for i in range(n) if i != 5]
+        assert (sm[live, 5] >= FAULTY).all()
+        # with loss gone, any spurious suspicions get refuted
+        sim.run(80, Faults(up=jnp.asarray(up)))
+        sm = sim.status_matrix()
+        assert (sm[np.ix_(live, live)] == ALIVE).all()
+
     def test_deterministic_given_seed(self):
         a = FullViewSim(10, seed=7)
         b = FullViewSim(10, seed=7)
