@@ -17,12 +17,36 @@ import sys
 import time
 
 
+def _accelerator_alive(timeout_s: float = 120.0) -> bool:
+    """Probe device init in a subprocess: a wedged TPU tunnel can HANG
+    jax.devices() indefinitely rather than raise, which would otherwise
+    leave the bench silent.  A dead probe → CPU fallback."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     import jax
 
+    if not _accelerator_alive():
+        jax.config.update("jax_platforms", "cpu")
+
     from ringpop_tpu.sim.delta import DeltaParams, DeltaSim, init_state, run_until_converged
 
-    platform = jax.devices()[0].platform
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # accelerator backend down — still produce a result
+        jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
     # full scale on an accelerator; CPU fallback keeps CI fast
     if platform in ("tpu", "axon") or os.environ.get("BENCH_FULL"):
         n, k = 1_000_000, 128
@@ -44,6 +68,25 @@ def main() -> None:
     jax.block_until_ready(state.learned)
     elapsed = time.perf_counter() - t0
 
+    # secondary BASELINE metric: batched ring lookup qps (1M-vnode ring on
+    # the accelerator; cheap relative to the convergence run)
+    import numpy as np
+
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens, ring_lookup
+
+    n_servers = 4096 if n >= 1_000_000 else 512
+    servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_servers)]
+    tokens, owners = build_ring_tokens(servers, 256)
+    rng = np.random.default_rng(0)
+    batch = 1_000_000 if n >= 1_000_000 else 100_000
+    hashes = jax.numpy.asarray(rng.integers(0, 2**32, size=batch, dtype=np.uint32))
+    jax.block_until_ready(ring_lookup(tokens, owners, hashes))  # compile
+    t_r = time.perf_counter()
+    for _ in range(10):
+        out = ring_lookup(tokens, owners, hashes)
+    jax.block_until_ready(out)
+    ring_qps = batch * 10 / (time.perf_counter() - t_r)
+
     baseline_s = 60.0  # BASELINE.json north star
     result = {
         "metric": f"swim_sim_convergence_n{n}",
@@ -56,6 +99,7 @@ def main() -> None:
         "n_nodes": n,
         "n_rumors": k,
         "compile_s": round(compile_s, 2),
+        "ring_lookup_qps": round(ring_qps, 0),
         "platform": platform,
     }
     print(json.dumps(result))

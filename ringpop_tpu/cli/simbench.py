@@ -1,0 +1,314 @@
+"""simbench — the BASELINE.json benchmark suite.
+
+Runs the five scenario configs from ``BASELINE.json`` and prints one JSON
+line per scenario:
+
+1. ``host10``      — 10-node in-process host-plane cluster (real asyncio
+                     TCP gossip): time to bootstrap + converge to one
+                     checksum (reference tier: ``scripts/testpop`` cluster).
+2. ``loss1k``      — 1k-node lifecycle sim, 5% packet loss: crash 1% of
+                     nodes, wall-clock + ticks until every live node
+                     believes every victim faulty.
+3. ``sweep100k``   — 100k-node lifecycle sim, 3 indirect probes: suspicion
+                     timeout sweep; detection latency per suspect period.
+4. ``partition1m`` — 1M-node delta sim: 30% partition, run, heal, run;
+                     wall-clock until post-heal full dissemination.
+5. ``ring1m``      — 1M-vnode ring: batched device Lookup qps and a 1%
+                     churn rebalance (reference analog:
+                     ``hashring_test.go:332`` micro-bench, scaled up).
+
+Scale auto-shrinks on CPU hosts (full sizes on an accelerator or with
+``--full``).  Usage::
+
+    python -m ringpop_tpu.cli.simbench [--only NAME] [--full] [--seed N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def _platform():
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # axon tunnel down, etc. — fall back to CPU
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+
+
+def bench_host10(seed: int, full: bool) -> dict:
+    """10 real nodes over asyncio TCP: bootstrap → converged checksums →
+    kill one → survivors converge on faulty."""
+    import asyncio
+
+    from ringpop_tpu.net import TCPChannel
+    from ringpop_tpu.swim.node import BootstrapOptions, Node, NodeOptions
+    from ringpop_tpu.swim.state_transitions import StateTimeouts
+
+    n = 10
+
+    async def run():
+        chans = [TCPChannel(app="simbench") for _ in range(n)]
+        for ch in chans:
+            await ch.listen()
+        nodes = [
+            Node(
+                "simbench",
+                ch.hostport,
+                ch,
+                NodeOptions(
+                    min_protocol_period=0.02,
+                    ping_timeout=0.2,
+                    ping_request_timeout=0.4,
+                    state_timeouts=StateTimeouts(suspect=0.8),
+                ),
+            )
+            for ch in chans
+        ]
+        hosts = [nd.address for nd in nodes]
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(
+                nd.bootstrap(BootstrapOptions(discover_provider=hosts, join_timeout=1.0))
+                for nd in nodes
+            )
+        )
+        # converge: all checksums equal
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            if len({nd.memberlist.checksum() for nd in nodes}) == 1:
+                break
+            await asyncio.sleep(0.05)
+        t_converge = time.perf_counter() - t0
+        converged = len({nd.memberlist.checksum() for nd in nodes}) == 1
+
+        # kill one, detect
+        t1 = time.perf_counter()
+        victim = nodes[-1]
+        victim.gossip.stop()
+        await chans[-1].close()
+        detected = False
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            ok = all(
+                any(
+                    m.address == victim.address and m.status >= 2
+                    for m in nd.memberlist.get_members()
+                )
+                for nd in nodes[:-1]
+            )
+            if ok:
+                detected = True
+                break
+            await asyncio.sleep(0.05)
+        t_detect = time.perf_counter() - t1
+
+        for nd in nodes[:-1]:
+            nd.destroy()
+        for ch in chans[:-1]:
+            await ch.close()
+        return t_converge, converged, t_detect, detected
+
+    t_converge, converged, t_detect, detected = asyncio.run(run())
+    return {
+        "metric": "host_cluster_10node",
+        "value": round(t_converge, 3),
+        "unit": "s_to_converge",
+        "converged": converged,
+        "failure_detect_s": round(t_detect, 3),
+        "detected": detected,
+    }
+
+
+def bench_loss1k(seed: int, full: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults
+    from ringpop_tpu.sim.lifecycle import LifecycleSim
+
+    n = 1000
+    sim = LifecycleSim(n=n, k=128, seed=seed, suspect_ticks=25)
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=10, replace=False).tolist())
+    up = np.ones(n, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=0.05)
+
+    sim.tick(faults)  # compile
+    jax.block_until_ready(sim.state.learned)
+    t0 = time.perf_counter()
+    ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "lifecycle_1k_5pct_loss_detection",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "ticks": ticks,
+        "sim_seconds": round(ticks * sim.params.tick_ms / 1000, 1),
+        "detected": ok,
+        "n_victims": len(victims),
+    }
+
+
+def bench_sweep100k(seed: int, full: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults
+    from ringpop_tpu.sim.lifecycle import LifecycleSim
+
+    n = 100_000 if full else 20_000
+    sweep = {}
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=20, replace=False).tolist())
+    up = np.ones(n, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    t0 = time.perf_counter()
+    for suspect_ticks in (5, 25, 50):
+        sim = LifecycleSim(n=n, k=256, seed=seed, suspect_ticks=suspect_ticks)
+        ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
+        sweep[str(suspect_ticks)] = {"ticks": ticks, "detected": ok}
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": f"lifecycle_{n//1000}k_suspicion_sweep",
+        "value": round(elapsed, 3),
+        "unit": "s_total",
+        "n_nodes": n,
+        "sweep": sweep,
+    }
+
+
+def bench_partition1m(seed: int, full: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import (
+        DeltaFaults,
+        DeltaParams,
+        DeltaSim,
+        init_state,
+        run_until_converged,
+    )
+
+    n = 1_000_000 if full else 50_000
+    k = 128 if full else 64
+    params = DeltaParams(n=n, k=k)
+    group = np.zeros(n, np.int32)
+    group[: int(0.3 * n)] = 1
+    part = DeltaFaults(up=jnp.ones(n, bool), group=jnp.asarray(group))
+    heal = DeltaFaults(up=jnp.ones(n, bool))
+
+    state = init_state(params, seed=seed)
+    t0 = time.perf_counter()
+    # partition phase: dissemination proceeds within each side only
+    state, t_part, _ = run_until_converged(params, state, part, max_ticks=256)
+    # heal phase: cross-side exchange completes global convergence
+    state, t_heal, ok = run_until_converged(params, state, heal, max_ticks=4096)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": f"delta_{n//1000}k_30pct_partition_heal",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "partition_ticks": t_part,
+        "heal_ticks": t_heal,
+        "converged": ok,
+        "n_nodes": n,
+    }
+
+
+def bench_ring1m(seed: int, full: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens, ring_lookup
+
+    # 256 vnodes/server per the BASELINE config line
+    n_servers = 4096 if full else 512
+    replicas = 256
+    batch = 1_000_000 if full else 100_000
+    servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_servers)]
+    t0 = time.perf_counter()
+    tokens, owners = build_ring_tokens(servers, replicas)
+    build_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(seed)
+    hashes = jnp.asarray(rng.integers(0, 2**32, size=batch, dtype=np.uint32))
+    out = ring_lookup(tokens, owners, hashes)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        out = ring_lookup(tokens, owners, hashes)
+    jax.block_until_ready(out)
+    qps = batch * iters / (time.perf_counter() - t0)
+
+    # 1% churn: remove + add servers, rebuild the token arrays
+    n_churn = max(1, n_servers // 100)
+    t0 = time.perf_counter()
+    survivors = servers[n_churn:] + [f"10.9.{i // 256}.{i % 256}:3000" for i in range(n_churn)]
+    tokens2, owners2 = build_ring_tokens(survivors, replicas)
+    jax.block_until_ready(ring_lookup(tokens2, owners2, hashes[:1024]))
+    rebalance_s = time.perf_counter() - t0
+
+    return {
+        "metric": f"ring_lookup_{n_servers * replicas // 1000}k_vnodes",
+        "value": round(qps, 0),
+        "unit": "lookups_per_s",
+        "build_s": round(build_s, 3),
+        "churn_rebalance_s": round(rebalance_s, 3),
+        "n_servers": n_servers,
+        "replica_points": replicas,
+        "batch": batch,
+    }
+
+
+BENCHES = {
+    "host10": bench_host10,
+    "loss1k": bench_loss1k,
+    "sweep100k": bench_sweep100k,
+    "partition1m": bench_partition1m,
+    "ring1m": bench_ring1m,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="simbench", description=__doc__)
+    p.add_argument("--only", choices=sorted(BENCHES), default=None)
+    p.add_argument("--full", action="store_true", help="full BASELINE sizes even on CPU")
+    p.add_argument("--cpu", action="store_true", help="pin the CPU backend")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    platform = _platform()
+    full = args.full or platform in ("tpu", "axon")
+    names = [args.only] if args.only else list(BENCHES)
+    for name in names:
+        t0 = time.perf_counter()
+        result = BENCHES[name](args.seed, full)
+        result.setdefault("bench", name)
+        result["platform"] = platform
+        result["wall_s"] = round(time.perf_counter() - t0, 2)
+        _emit(result)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,6 +152,15 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     # newly learned rumors start at pcount 0 (RecordChange)
     pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)
 
+    # full-sync analog (disseminator.go:156-304): a rumor whose piggyback
+    # counters all expired short of full coverage (e.g. it saturated one
+    # side of a partition) is re-seeded, the way checksum-mismatch full
+    # syncs repair divergence the maxP bound left behind
+    live = up[:, None]
+    fully = jnp.all(learned | ~live, axis=0)
+    stuck = ~((learned & live & (pcount < max_p)).any(axis=0)) & ~fully
+    pcount = jnp.where(stuck[None, :] & learned, jnp.int8(0), pcount)
+
     return DeltaState(learned=learned, pcount=pcount, tick=state.tick + 1, key=key)
 
 
