@@ -297,7 +297,8 @@ def step(
     bfire_s = bfire & (state.base_pending == SUSPECT)
     bfire_f = bfire & (state.base_pending == FAULTY)
     bfire_t = bfire & (state.base_pending == TOMBSTONE)
-    first_live = jnp.argmax(up).astype(jnp.int32)
+    # (skip the argmax when no fault model: XLA constant-folds it slowly)
+    first_live = jnp.argmax(up).astype(jnp.int32) if faults.up is not None else jnp.int32(0)
     bfire_key = jnp.where(
         bfire_s | bfire_f,
         _key_of(state.base_inc, jnp.where(bfire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))),

@@ -1,0 +1,98 @@
+"""Checkpoint / resume for cluster state.
+
+The reference has NO checkpointing — membership is soft state rebuilt from
+the network on every boot (``swim/join_handler.go:69-75``; incarnations are
+wall-clock ms so reborn nodes self-supersede, ``swim/memberlist.go:235``).
+Because the sim plane holds an entire simulated cluster as one pytree of
+dense arrays, snapshotting it is nearly free — a capability the reference
+architecture cannot offer (SURVEY §5).  A 1M-node lifecycle state is a
+handful of ``np.savez``-compressed arrays; save/restore round-trips
+bit-exactly, including the PRNG key, so a resumed run continues the exact
+trajectory of the original.
+
+Host-plane membership can also be exported/imported as a change list in the
+reference's own wire schema (``disseminator.go:107-123``
+MembershipAsChanges), which doubles as a warm-boot list: a restarted node
+can apply the snapshot before gossiping, then let newer incarnations
+supersede stale entries — the same lattice rules make stale snapshots safe.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Type, TypeVar
+
+import numpy as np
+
+T = TypeVar("T", bound=tuple)
+
+_MAGIC = "ringpop_tpu-snapshot-v1"
+
+
+def save_state(path: str, state) -> None:
+    """Write any engine state (a NamedTuple of arrays) to ``path`` (.npz).
+    Works for DeltaState, FullViewState and LifecycleState alike."""
+    arrays = {f: np.asarray(v) for f, v in zip(state._fields, state)}
+    meta = json.dumps(
+        {"magic": _MAGIC, "type": type(state).__name__, "fields": list(state._fields)}
+    )
+    np.savez_compressed(path, __meta__=np.frombuffer(meta.encode(), dtype=np.uint8), **arrays)
+
+
+def load_state(path: str, cls: Type[T]) -> T:
+    """Load a snapshot written by :func:`save_state` back into ``cls``.
+    Validates the engine type and field list before reconstructing."""
+    import jax.numpy as jnp
+
+    with np.load(path) as data:
+        if "__meta__" not in data.files:
+            raise ValueError(f"{path}: not a ringpop_tpu snapshot")
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        if meta.get("magic") != _MAGIC:
+            raise ValueError(f"{path}: not a ringpop_tpu snapshot")
+        if meta["type"] != cls.__name__:
+            raise ValueError(
+                f"{path}: snapshot holds {meta['type']}, asked to load {cls.__name__}"
+            )
+        if list(meta["fields"]) != list(cls._fields):
+            raise ValueError(
+                f"{path}: field mismatch {meta['fields']} != {list(cls._fields)}"
+            )
+        return cls(**{f: jnp.asarray(data[f]) for f in cls._fields})
+
+
+# -- host-plane membership export/import -------------------------------------
+
+
+def export_membership(memberlist, path: str | None = None) -> list[dict]:
+    """Serialize a host-plane memberlist as a wire-schema change list
+    (the same JSON shape joins/full-syncs ship; ``member.go`` JSON tags)."""
+    from ringpop_tpu.swim.member import member_to_change
+
+    local = memberlist.local
+    addr = local.address if local else ""
+    inc = local.incarnation if local else 0
+    changes = [
+        member_to_change(m, source=addr, source_inc=inc).to_wire()
+        for m in memberlist.get_members()
+    ]
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(changes, f)
+    return changes
+
+
+def import_membership(memberlist, source: str | list[dict]) -> int:
+    """Apply an exported change list to a memberlist (warm boot).  Entries
+    older than what the node already knows are discarded by the normal
+    override rules, so stale snapshots are harmless.  Returns the number of
+    changes that applied."""
+    from ringpop_tpu.swim.member import Change
+
+    if isinstance(source, str):
+        with open(source) as f:
+            data = json.load(f)
+    else:
+        data = source
+    applied = memberlist.update([Change.from_wire(d) for d in data])
+    return len(applied)
