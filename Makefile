@@ -1,0 +1,45 @@
+# ringpop_tpu build/test entry points (model: reference Makefile:1-75 —
+# test / test-race / lint / integration split, adapted to the Python+JAX
+# toolchain; native hash core built via g++ like the reference's vendored
+# deps were via glide).
+
+PY ?= python
+
+.PHONY: all test test-fast test-slow test-integration bench simbench native lint clean
+
+all: native test
+
+# full unit+functional suite (CPU, virtual 8-device mesh via tests/conftest.py)
+test:
+	$(PY) -m pytest tests/ -q
+
+# skip the scale spot-checks
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+# only the scale spot-checks (20k-node sim, 10-process cluster)
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
+
+# tier-3 multi-process clusters only (reference: make test-integration)
+test-integration:
+	$(PY) -m pytest tests/test_integration_processes.py -q
+
+# headline benchmark — one JSON line (1M-node convergence on an accelerator)
+bench:
+	$(PY) bench.py
+
+# all five BASELINE scenario configs
+simbench:
+	$(PY) -m ringpop_tpu.cli.simbench
+
+# native FarmHash core (rebuilds the .so the hashing layer loads via ctypes)
+native:
+	$(PY) -c "from ringpop_tpu import native; assert native._build(), 'g++ build failed'; print('native hash core built')"
+
+lint:
+	$(PY) -m compileall -q ringpop_tpu tests bench.py __graft_entry__.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -f ringpop_tpu/native/*.so

@@ -1,6 +1,6 @@
 """Tier-3 integration tests: real multi-process local clusters
-(model: reference test/run-integration-tests cluster sizes — scaled down to
-keep CI fast; sizes 1/2/3/5 covered across the tests here)."""
+(model: reference test/run-integration-tests cluster sizes 1..5 and 10;
+the 10-process run is marked slow)."""
 
 import asyncio
 import signal
@@ -21,6 +21,22 @@ def test_process_cluster_converges(n):
             stats = await cluster.wait_converged(expect_members=n, timeout=45)
             for s in stats.values():
                 assert all(m["status"] == "alive" for m in s["membership"]["members"])
+        finally:
+            await cluster.shutdown()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_ten_process_cluster_converges():
+    """The reference's largest integration size (test/run-integration-tests:12)."""
+
+    async def main():
+        cluster = ProcessCluster(10)
+        cluster.start()
+        try:
+            stats = await cluster.wait_converged(expect_members=10, timeout=90)
+            assert len(stats) == 10
         finally:
             await cluster.shutdown()
 
