@@ -65,6 +65,17 @@ class DeltaParams:
     k: int  # change-table capacity (rumors in flight)
     p_factor: int = 15  # disseminator.go:35
     max_p: Optional[int] = None  # override; default pFactor*ceil(log10(n+1))
+    # ping-partner topology per tick:
+    #   "shift"   — targets[i] = (i + s) % n with a fresh random shift s each
+    #               tick: every node pings AND is pinged exactly once, the
+    #               exchange is a pure roll/gather (no scatter — XLA lowers
+    #               TPU scatters serially), and under sharding it maps to a
+    #               collective permute over ICI.  Same epidemic doubling as
+    #               uniform draws (a set S infects S ∪ (S+s) per tick).
+    #   "uniform" — independent uniform target per node (collisions merge
+    #               via scatter-max), closest to the reference's shuffled
+    #               round-robin when probe independence matters.
+    exchange: str = "shift"
 
     def resolved_max_p(self) -> int:
         return resolve_max_p(self.n, self.p_factor, self.max_p)
@@ -113,16 +124,21 @@ def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray]
 
 
 def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> DeltaState:
-    """One protocol period for all N nodes (jit/shard-friendly: fixed shapes,
-    one segment_max scatter + one gather per tick)."""
+    """One protocol period for all N nodes (jit/shard-friendly: fixed
+    shapes; with the default "shift" topology the whole exchange is rolls
+    and gathers — no scatter)."""
     n, k = params.n, params.k
     max_p = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
     key, k_target, k_drop = jax.random.split(state.key, 3)
+    i_all = jnp.arange(n, dtype=jnp.int32)
 
-    # random peer selection (uniform over other nodes; the reference's
-    # shuffled round-robin has the same epidemic mixing rate)
-    targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
-    targets = jnp.where(targets >= jnp.arange(n, dtype=jnp.int32), targets + 1, targets)
+    shift_mode = params.exchange == "shift"
+    if shift_mode:
+        s = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+        targets = (i_all + s) % n
+    else:
+        targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+        targets = jnp.where(targets >= i_all, targets + 1, targets)
 
     up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
     conn = up & up[targets]
@@ -135,18 +151,25 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     active = state.pcount < max_p
     riding = state.learned & active
 
-    # request leg: scatter-or by target (bool max == or; duplicate targets
-    # merge for free)
+    # request leg: sender i's rumors land at targets[i]
     sent = riding & conn[:, None]
-    inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+    if shift_mode:
+        # targets form a cyclic permutation: delivery is a roll, receipt
+        # uniqueness is structural (node j is pinged only by j-s)
+        inbound = jnp.roll(sent, s, axis=0)
+        got_pinged = jnp.roll(conn, s)
+    else:
+        # scatter-or by target (bool max == or; duplicate targets merge)
+        inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+        got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
     learned = state.learned | inbound
 
-    # response leg: gather the target's riding rumors back to the pinger
-    resp = (learned & (state.pcount < max_p))[targets] & conn[:, None]
+    # response leg: the target's riding rumors come back to the pinger
+    answerable = learned & (state.pcount < max_p)
+    resp = (jnp.roll(answerable, -s, axis=0) if shift_mode else answerable[targets]) & conn[:, None]
     learned = learned | resp
 
     # piggyback bumps: sender on success; receiver once per busy tick
-    got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
     bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
     pcount = jnp.minimum(state.pcount + bump, max_p)
     # newly learned rumors start at pcount 0 (RecordChange)

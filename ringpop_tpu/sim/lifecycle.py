@@ -118,6 +118,10 @@ class LifecycleParams:
     max_p: Optional[int] = None
     alloc_per_tick: int = 64  # new-rumor budget per tick (<= k)
     tick_ms: int = 200  # simulated ms per tick (reporting only)
+    # "shift" = cyclic-permutation partners (scatterless exchange, TPU-fast;
+    # exactly one probe per target per tick); "uniform" = independent draws
+    # (expected one probe per target).  See DeltaParams.exchange.
+    exchange: str = "shift"
     # partition-healer attempt rate, cluster-wide per tick.  Reference: each
     # node tries every 30s with probability 3/n → ~one attempt per 10s
     # cluster-wide (swim/node.go:59-67, heal_via_discover_provider.go:63-88),
@@ -193,8 +197,13 @@ def step(
     eff_max = jnp.maximum(subj_rumor_max, base_key)
 
     # -- ping target selection + belief gate --------------------------------
-    targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
-    targets = jnp.where(targets >= i_all, targets + 1, targets)
+    shift_mode = params.exchange == "shift"
+    if shift_mode:
+        shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+        targets = (i_all + shift) % n
+    else:
+        targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+        targets = jnp.where(targets >= i_all, targets + 1, targets)
     # belief[i] about its target: max(base, learned rumors about target)
     bmask = state.learned & active[None, :] & (state.r_subject[None, :] == targets[:, None])
     bel_rumor = jnp.max(
@@ -210,15 +219,23 @@ def step(
         conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
     delivered = conn & wants
 
-    # -- piggyback exchange: request leg (scatter-or) + response (gather) ---
+    # -- piggyback exchange: request leg + response leg ---------------------
     riding = state.learned & active[None, :] & (state.pcount < maxp)
     sent = riding & delivered[:, None]
-    inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+    if shift_mode:
+        inbound = jnp.roll(sent, shift, axis=0)
+        got_pinged = jnp.roll(delivered, shift)
+    else:
+        inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+        got_pinged = (
+            jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
+        )
     learned = state.learned | inbound
-    resp = (learned & active[None, :] & (state.pcount < maxp))[targets] & delivered[:, None]
+    answerable = learned & active[None, :] & (state.pcount < maxp)
+    resp = (
+        jnp.roll(answerable, -shift, axis=0) if shift_mode else answerable[targets]
+    ) & delivered[:, None]
     learned = learned | resp
-
-    got_pinged = jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
     bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
     pcount = jnp.minimum(state.pcount + bump, maxp)
     pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)

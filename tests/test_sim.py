@@ -165,6 +165,14 @@ class TestDelta:
         ticks, ok = sim.run_until_converged(max_ticks=512)
         assert ok
 
+    def test_both_exchange_topologies_converge(self):
+        """shift (scatterless cyclic partners) and uniform (independent
+        draws) give the same epidemic behavior."""
+        for exch in ("shift", "uniform"):
+            sim = DeltaSim(512, 32, seed=4, exchange=exch)
+            ticks, ok = sim.run_until_converged()
+            assert ok and ticks <= 64, (exch, ticks)
+
     def test_max_p_bounds_dissemination_traffic(self):
         # a rumor stops riding after maxP propagations per node
         sim = DeltaSim(64, 4, seed=3, max_p=2)
