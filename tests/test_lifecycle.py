@@ -170,6 +170,31 @@ def test_packet_loss_still_converges():
     assert bool((status == ALIVE).all())
 
 
+def test_crashed_node_revives_and_recovers():
+    """Elastic recovery (SURVEY §5): a node detected faulty comes back up,
+    learns it is believed faulty from the first exchange that reaches it,
+    refutes at a higher incarnation, and the whole cluster returns to an
+    all-alive view (reference: options.go:256-269 — faulty members rejoin
+    and resume their ring position)."""
+    n = 48
+    sim = LifecycleSim(n=n, k=64, seed=13, suspect_ticks=6)
+    dead = make_faults(n, down=[20])
+    ticks, ok = sim.run_until_detected([20], dead, min_status=FAULTY, max_ticks=800)
+    assert ok
+    # revive: node 20 resumes probing; detection of its own detraction
+    # triggers refutation-by-reincarnation
+    alive = make_faults(n)
+    recovered = False
+    for _ in range(60):
+        sim.run(10, alive)
+        status = believed_status(sim.state, list(range(n)))
+        if bool((status == ALIVE).all()):
+            recovered = True
+            break
+    assert recovered, "revived node did not re-establish an all-alive view"
+    assert int(sim.state.self_inc[20]) > 0  # reincarnated
+
+
 def test_jit_shapes_stable_and_sharded():
     """The step runs under jit with in/out shardings on the 8-device CPU
     mesh (node × rumor), proving the multi-chip path compiles + executes."""
