@@ -64,9 +64,21 @@ class TestLockstepConformance:
 
     @pytest.mark.slow
     def test_midscale_conformance(self):
-        # larger-N spot check (the 1k-node run lives in bench_suite.py)
+        # larger-N spot check
         r = LockstepRunner(n=128, seed=6, suspect_ticks=5, faulty_ticks=40, tombstone_ticks=10)
         up = np.ones(128, bool)
         up[::16] = False
         r.run(30, faults=Faults(up=np.asarray(up)), check_every=5)
         r.run(20, check_every=5)
+
+    @pytest.mark.slow
+    def test_1k_node_conformance_gate(self):
+        """The BASELINE gate: bit-identical member states vs the sequential
+        reference semantics at 1k nodes, through a kill + recovery cycle."""
+        n = 1000
+        r = LockstepRunner(n=n, seed=7, suspect_ticks=4, faulty_ticks=30, tombstone_ticks=8)
+        up = np.ones(n, bool)
+        up[[99, 499, 999]] = False
+        r.run(12, faults=Faults(up=np.asarray(up)), check_every=4)
+        r.run(8, check_every=4)
+        r.assert_identical()
