@@ -61,12 +61,18 @@ def main() -> None:
     jax.block_until_ready(sim.state.learned)
     compile_s = time.perf_counter() - t_compile
 
-    # fresh state, timed convergence run
+    # fresh state, timed convergence run (BENCH_PROFILE=dir captures a
+    # jax.profiler trace for kernel-level analysis on real hardware)
     sim.state = init_state(sim.params, seed=1)
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     state, ticks, ok = run_until_converged(sim.params, sim.state, max_ticks=4096)
     jax.block_until_ready(state.learned)
     elapsed = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     # secondary BASELINE metric: batched ring lookup qps (1M-vnode ring on
     # the accelerator; cheap relative to the convergence run)

@@ -276,12 +276,60 @@ def bench_ring1m(seed: int, full: bool) -> dict:
     }
 
 
+def bench_forward_qps(seed: int, full: bool) -> dict:
+    """App data path (SURVEY §3.4 hot loop): keyed requests through
+    handle_or_forward on a live 3-node TCP cluster — ~2/3 of requests
+    proxy to the owner over the wire, 1/3 handle locally."""
+    import asyncio
+
+    from ringpop_tpu.net import TCPChannel
+    from ringpop_tpu.ringpop import Ringpop
+
+    n_req = 2000 if full else 500
+
+    async def run():
+        chans = [TCPChannel(app="fwd") for _ in range(3)]
+        for ch in chans:
+            await ch.listen()
+            ch.register("fwd", "/op", lambda body, headers: {"ok": True})
+        rps = [Ringpop("fwd", ch) for ch in chans]
+        hosts = [ch.hostport for ch in chans]
+        await asyncio.gather(*(rp.bootstrap(discover_provider=hosts) for rp in rps))
+
+        async def one(i):
+            handled, res = await rps[0].handle_or_forward(f"key-{i}", {"i": i}, "fwd", "/op")
+            return handled
+
+        # warm
+        await asyncio.gather(*(one(i) for i in range(32)))
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*(one(i) for i in range(n_req)))
+        elapsed = time.perf_counter() - t0
+        local = sum(1 for h in results if h)
+        for rp in rps:
+            rp.destroy()
+        for ch in chans:
+            await ch.close()
+        return elapsed, local
+
+    elapsed, local = asyncio.run(run())
+    return {
+        "metric": "forward_keyed_qps_3node",
+        "value": round(n_req / elapsed, 0),
+        "unit": "req_per_s",
+        "n_requests": n_req,
+        "handled_locally": local,
+        "forwarded": n_req - local,
+    }
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
     "sweep100k": bench_sweep100k,
     "partition1m": bench_partition1m,
     "ring1m": bench_ring1m,
+    "forward": bench_forward_qps,
 }
 
 

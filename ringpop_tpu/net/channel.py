@@ -29,6 +29,7 @@ Two implementations:
 from __future__ import annotations
 
 import asyncio
+import inspect
 import json
 import random
 from typing import Awaitable, Callable, Optional
@@ -66,7 +67,10 @@ class BaseChannel:
         handler = self._handlers.get((service, endpoint))
         if handler is None:
             raise RemoteError(f"no handler for {service}::{endpoint}")
-        return await handler(body, headers)
+        res = handler(body, headers)
+        if inspect.isawaitable(res):  # sync handlers are fine too
+            res = await res
+        return res
 
     async def call(
         self,
