@@ -338,6 +338,9 @@ def step(
     fold_mask = folded_key >= 0
     base_status = jnp.where(fold_mask, _status_of(jnp.maximum(folded_key, 0)), state.base_status)
     base_inc = jnp.where(fold_mask, _inc_of(jnp.maximum(folded_key, 0)), state.base_inc)
+    # folding any rumor (re-)establishes the subject in the base — this is
+    # how an admitted/rejoining member becomes part of the converged view
+    base_present = base_present | fold_mask
     # transfer the folded rumor's pending deadline to the base timer
     fold_dl = jax.ops.segment_min(
         jnp.where(
@@ -506,6 +509,35 @@ def step(
         self_inc=self_inc,
         tick=state.tick + 1,
         key=key,
+    )
+
+
+# -- membership operations ---------------------------------------------------
+
+
+def admit(params: LifecycleParams, state: LifecycleState, idx: int) -> LifecycleState:
+    """Admit (or re-admit) node ``idx`` into the cluster — the sim analog of
+    the join path (``swim/join_sender.go``): the joiner announces itself
+    with an Alive rumor at a fresh incarnation, seeded only at itself; the
+    rumor gossips outward, peers start pinging the member as they learn of
+    it, and once fully disseminated it folds into the converged base
+    (restoring ``base_present`` for an evicted index).  Raises if the rumor
+    table is full."""
+    free = np.flatnonzero(~np.asarray(state.r_subject >= 0))
+    if free.size == 0:
+        raise RuntimeError("rumor table full; cannot admit now")
+    k0 = int(free[0])
+    now = jnp.int32(int(state.tick) + 1)
+    n = params.n
+    learned_col = jnp.zeros((n,), bool).at[idx].set(True)
+    return state._replace(
+        r_subject=state.r_subject.at[k0].set(idx),
+        r_inc=state.r_inc.at[k0].set(now),
+        r_status=state.r_status.at[k0].set(ALIVE),
+        r_deadline=state.r_deadline.at[k0].set(NO_DEADLINE),
+        learned=state.learned.at[:, k0].set(learned_col),
+        pcount=state.pcount.at[:, k0].set(jnp.int8(0)),
+        self_inc=state.self_inc.at[idx].set(now),
     )
 
 

@@ -195,6 +195,38 @@ def test_crashed_node_revives_and_recovers():
     assert int(sim.state.self_inc[20]) > 0  # reincarnated
 
 
+def test_evicted_node_readmitted_via_join():
+    """Elastic growth: after the full suspect→faulty→tombstone→evict chain
+    removes a member, admit() re-introduces it via an Alive rumor that
+    gossips out and folds back into the base (join-path analog)."""
+    from ringpop_tpu.sim.lifecycle import admit
+
+    n = 32
+    sim = LifecycleSim(
+        n=n, k=32, seed=17, suspect_ticks=4, faulty_ticks=6, tombstone_ticks=6
+    )
+    faults = make_faults(n, down=[9])
+    evicted = False
+    for _ in range(200):
+        sim.tick(faults)
+        if not bool(sim.state.base_present[9]):
+            evicted = True
+            break
+    assert evicted, "node 9 was never evicted"
+
+    # node 9 restarts and rejoins
+    sim.state = admit(sim.params, sim.state, 9)
+    alive = make_faults(n)
+    back = False
+    for _ in range(40):
+        sim.run(10, alive)
+        status = believed_status(sim.state, [9])
+        if bool((status == ALIVE).all()) and bool(sim.state.base_present[9]):
+            back = True
+            break
+    assert back, "re-admitted node did not rejoin the converged base"
+
+
 def test_jit_shapes_stable_and_sharded():
     """The step runs under jit with in/out shardings on the 8-device CPU
     mesh (node × rumor), proving the multi-chip path compiles + executes."""
