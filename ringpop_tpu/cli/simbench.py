@@ -256,6 +256,22 @@ def bench_ring1m(seed: int, full: bool) -> dict:
     jax.block_until_ready(out)
     qps = batch * iters / (time.perf_counter() - t0)
 
+    # fused keyed path: hash raw keys on-device then look up owners
+    from ringpop_tpu.hashing.farm import pack_strings
+    from ringpop_tpu.ops.hash_ops import keyed_owner_lookup
+
+    n_keys = 100_000 if full else 20_000
+    keys = [f"user:{i}:{i * 37}" for i in range(n_keys)]
+    mat, lens = pack_strings([s.encode() for s in keys])
+    mat, lens = jnp.asarray(mat), jnp.asarray(lens)
+    out2 = keyed_owner_lookup(tokens, owners, mat, lens)
+    jax.block_until_ready(out2)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out2 = keyed_owner_lookup(tokens, owners, mat, lens)
+    jax.block_until_ready(out2)
+    keyed_qps = n_keys * iters / (time.perf_counter() - t0)
+
     # 1% churn: remove + add servers, rebuild the token arrays
     n_churn = max(1, n_servers // 100)
     t0 = time.perf_counter()
@@ -269,6 +285,7 @@ def bench_ring1m(seed: int, full: bool) -> dict:
         "value": round(qps, 0),
         "unit": "lookups_per_s",
         "build_s": round(build_s, 3),
+        "keyed_hash_lookup_qps": round(keyed_qps, 0),
         "churn_rebalance_s": round(rebalance_s, 3),
         "n_servers": n_servers,
         "replica_points": replicas,

@@ -1,3 +1,10 @@
+from ringpop_tpu.ops.hash_ops import fingerprint32_device, keyed_owner_lookup
 from ringpop_tpu.ops.ring_ops import ring_lookup, ring_lookup_n, build_ring_tokens
 
-__all__ = ["ring_lookup", "ring_lookup_n", "build_ring_tokens"]
+__all__ = [
+    "ring_lookup",
+    "ring_lookup_n",
+    "build_ring_tokens",
+    "fingerprint32_device",
+    "keyed_owner_lookup",
+]

@@ -1,0 +1,192 @@
+"""On-device FarmHash Fingerprint32 + fused keyed ring routing.
+
+The host plane hashes with the native C++ core (``ringpop_tpu/native``);
+this module computes the SAME bit-exact Fingerprint32 on the accelerator,
+so the entire keyed data path — hash the key, find the owner on the ring —
+runs on-device for millions of keys per call with no host round trip
+(reference equivalents are scalar: ``hashring.go:107`` farm.Fingerprint32 +
+``hashring.go:279-301`` per-key tree walk).
+
+Design notes for TPU:
+
+* the four length-class branches of farmhashmk::Hash32 are evaluated for
+  every row and selected with ``where`` — branchless, vector-friendly,
+  ~4× compute for zero divergence (hash math is cheap; HBM is not);
+* the >24-byte mixing loop runs ``(L_max-1)//20`` iterations at STATIC
+  byte offsets (0, 20, 40, …) with per-row activity masks, so XLA sees a
+  fixed-trip loop over column slices — no dynamic gathers in the hot loop;
+* only the six tail fetches use per-row dynamic offsets
+  (``take_along_axis`` gathers).
+
+``fingerprint32_pallas`` (in ``hash_pallas.py``) runs the same mixing loop
+as a fused Pallas kernel; this jnp version is the portable path and the
+correctness oracle for it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_MIX5 = np.uint32(5)
+_MIXC = np.uint32(0xE6546B64)
+
+
+def _ror(v, s: int):
+    return (v >> U32(s)) | (v << U32(32 - s))
+
+
+def _fmix(h):
+    h ^= h >> U32(16)
+    h = h * np.uint32(0x85EBCA6B)
+    h ^= h >> U32(13)
+    h = h * np.uint32(0xC2B2AE35)
+    h ^= h >> U32(16)
+    return h
+
+
+def _mur(a, h):
+    a = a * _C1
+    a = _ror(a, 17)
+    a = a * _C2
+    h = h ^ a
+    h = _ror(h, 19)
+    return h * _MIX5 + _MIXC
+
+
+def _fetch32_at(mat, idx):
+    """Little-endian u32 at per-row byte offsets (dynamic gather)."""
+    idx = jnp.maximum(idx, 0).astype(jnp.int32)
+    cols = idx[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]
+    b = jnp.take_along_axis(mat, cols, axis=1).astype(U32)  # [B, 4]
+    return b[:, 0] | (b[:, 1] << U32(8)) | (b[:, 2] << U32(16)) | (b[:, 3] << U32(24))
+
+
+def _fetch32_col(mat, off: int):
+    """Little-endian u32 at one static byte offset (column slice)."""
+    b = mat[:, off : off + 4].astype(U32)
+    return b[:, 0] | (b[:, 1] << U32(8)) | (b[:, 2] << U32(16)) | (b[:, 3] << U32(24))
+
+
+def _hash_0_4(mat, lens):
+    b = jnp.zeros(mat.shape[0], U32)
+    c = jnp.full(mat.shape[0], 9, U32)
+    for i in range(min(4, mat.shape[1])):
+        active = lens > i
+        v = mat[:, i].astype(jnp.int8).astype(jnp.int32).astype(U32)  # signed char
+        nb = b * _C1 + v
+        b = jnp.where(active, nb, b)
+        c = jnp.where(active, c ^ nb, c)
+    return _fmix(_mur(b, _mur(lens.astype(U32), c)))
+
+
+def _hash_5_12(mat, lens):
+    ln = lens.astype(U32)
+    a = ln + _fetch32_at(mat, jnp.zeros_like(lens))
+    b = ln * U32(5) + _fetch32_at(mat, lens - 4)
+    c = U32(9) + _fetch32_at(mat, (lens >> 1) & 4)
+    d = ln * U32(5)
+    return _fmix(_mur(c, _mur(b, _mur(a, d))))
+
+
+def _hash_13_24(mat, lens):
+    ln = lens.astype(U32)
+    a = _fetch32_at(mat, (lens >> 1) - 4)
+    b = _fetch32_at(mat, jnp.full_like(lens, 4))
+    c = _fetch32_at(mat, lens - 8)
+    d = _fetch32_at(mat, lens >> 1)
+    e = _fetch32_at(mat, jnp.zeros_like(lens))
+    f = _fetch32_at(mat, lens - 4)
+    h = d * _C1 + ln
+    a = _ror(a, 12) + f
+    h = _mur(c, h) + a
+    a = _ror(a, 3) + c
+    h = _mur(e, h) + a
+    a = _ror(a + f, 12) + d
+    h = _mur(b, h) + a
+    return _fmix(h)
+
+
+def _tail_words(mat, lens):
+    """The five rotated tail constants of the >24 path (dynamic fetches)."""
+    def rot(off):
+        return _ror(_fetch32_at(mat, lens - off) * _C1, 17) * _C2
+
+    return rot(4), rot(8), rot(16), rot(12), rot(20)
+
+
+def _hash_gt24(mat, lens, max_iters: int):
+    ln = lens.astype(U32)
+    a0, a1, a2, a3, a4 = _tail_words(mat, lens)
+    h = ln
+    g = _C1 * ln
+    f = g
+    h = _ror(h ^ a0, 19) * _MIX5 + _MIXC
+    h = _ror(h ^ a2, 19) * _MIX5 + _MIXC
+    g = _ror(g ^ a1, 19) * _MIX5 + _MIXC
+    g = _ror(g ^ a3, 19) * _MIX5 + _MIXC
+    f = _ror(f + a4, 19) + U32(113)
+
+    iters = (lens - 1) // 20
+    for t in range(max_iters):
+        off = 20 * t
+        if off + 20 > mat.shape[1]:
+            break
+        active = iters > t
+        a = _fetch32_col(mat, off)
+        b = _fetch32_col(mat, off + 4)
+        c = _fetch32_col(mat, off + 8)
+        d = _fetch32_col(mat, off + 12)
+        e = _fetch32_col(mat, off + 16)
+        nh = _mur(d, h + a) + e
+        ng = _mur(c, g + b) + a
+        nf = _mur(b + e * _C1, f + c) + d
+        nf = nf + ng
+        ng = ng + nf
+        h = jnp.where(active, nh, h)
+        g = jnp.where(active, ng, g)
+        f = jnp.where(active, nf, f)
+
+    g = _ror(g, 11) * _C1
+    g = _ror(g, 17) * _C1
+    f = _ror(f, 11) * _C1
+    f = _ror(f, 17) * _C1
+    h = _ror(h + g, 19) * _MIX5 + _MIXC
+    h = _ror(h, 17) * _C1
+    h = _ror(h + f, 19) * _MIX5 + _MIXC
+    h = _ror(h, 17) * _C1
+    return h
+
+
+@jax.jit
+def fingerprint32_device(mat, lens) -> jax.Array:
+    """Bit-exact FarmHash Fingerprint32 of B byte strings on-device.
+
+    ``mat`` uint8[B, L] right-padded with >= 4 zero bytes past each row's
+    length; ``lens`` int32[B].  All length classes evaluate branchlessly;
+    jit/vmap/shard-friendly."""
+    mat = jnp.asarray(mat, jnp.uint8)
+    lens = jnp.asarray(lens, jnp.int32)
+    max_iters = max((mat.shape[1] - 1) // 20, 0)
+    h04 = _hash_0_4(mat, lens)
+    h512 = _hash_5_12(mat, lens)
+    h1324 = _hash_13_24(mat, lens)
+    hbig = _hash_gt24(mat, lens, max_iters)
+    return jnp.where(
+        lens <= 4,
+        h04,
+        jnp.where(lens <= 12, h512, jnp.where(lens <= 24, h1324, hbig)),
+    )
+
+
+@jax.jit
+def keyed_owner_lookup(tokens, owners, mat, lens) -> jax.Array:
+    """The full keyed data path on-device: Fingerprint32 each key, then the
+    ring ownership search — int32[B] owner indices, fused under one jit."""
+    from ringpop_tpu.ops.ring_ops import ring_lookup
+
+    return ring_lookup(tokens, owners, fingerprint32_device(mat, lens))

@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the FarmHash Fingerprint32 mixing loop.
+
+Split of labor (see ``hash_ops.py`` for the full algorithm):
+
+* XLA (outside): length-class branches <= 24 bytes, and the six dynamic
+  tail fetches of the >24 path — gather-shaped work XLA already does well;
+* Pallas (this kernel): the >24-byte mixing loop — ``(L-1)//20``
+  iterations of mur/rotate chains over STATIC byte offsets, fully fused in
+  VMEM over row blocks, so the key matrix is read from HBM exactly once
+  regardless of iteration count (the jnp path re-slices `mat` per
+  iteration and leans on XLA fusion to keep it resident).
+
+The kernel is bit-exact against ``hash_ops.fingerprint32_device`` (which is
+itself bit-exact against the scalar/native reference) — tested in
+interpret mode on CPU; compiled mode engages automatically on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ringpop_tpu.ops.hash_ops import (
+    _MIX5,
+    _MIXC,
+    _C1,
+    _C2,
+    _fmix,
+    _hash_0_4,
+    _hash_13_24,
+    _hash_5_12,
+    _mur,
+    _ror,
+    _tail_words,
+    U32,
+)
+
+BLOCK_ROWS = 256
+
+
+def _mix_kernel(mat_ref, pre_ref, out_ref, *, max_iters: int, width: int):
+    """One row block: run the >24 mixing loop to completion in VMEM."""
+    a0 = pre_ref[:, 0]
+    a1 = pre_ref[:, 1]
+    a2 = pre_ref[:, 2]
+    a3 = pre_ref[:, 3]
+    a4 = pre_ref[:, 4]
+    ln = pre_ref[:, 5]
+
+    h = ln
+    g = _C1 * ln
+    f = g
+    h = _ror(h ^ a0, 19) * _MIX5 + _MIXC
+    h = _ror(h ^ a2, 19) * _MIX5 + _MIXC
+    g = _ror(g ^ a1, 19) * _MIX5 + _MIXC
+    g = _ror(g ^ a3, 19) * _MIX5 + _MIXC
+    f = _ror(f + a4, 19) + U32(113)
+
+    iters = (ln.astype(jnp.int32) - 1) // 20
+
+    def fetch(off: int):
+        b0 = mat_ref[:, off].astype(U32)
+        b1 = mat_ref[:, off + 1].astype(U32)
+        b2 = mat_ref[:, off + 2].astype(U32)
+        b3 = mat_ref[:, off + 3].astype(U32)
+        return b0 | (b1 << U32(8)) | (b2 << U32(16)) | (b3 << U32(24))
+
+    for t in range(max_iters):
+        off = 20 * t
+        if off + 20 > width:
+            break
+        active = iters > t
+        a = fetch(off)
+        b = fetch(off + 4)
+        c = fetch(off + 8)
+        d = fetch(off + 12)
+        e = fetch(off + 16)
+        nh = _mur(d, h + a) + e
+        ng = _mur(c, g + b) + a
+        nf = _mur(b + e * _C1, f + c) + d
+        nf = nf + ng
+        ng = ng + nf
+        h = jnp.where(active, nh, h)
+        g = jnp.where(active, ng, g)
+        f = jnp.where(active, nf, f)
+
+    g = _ror(g, 11) * _C1
+    g = _ror(g, 17) * _C1
+    f = _ror(f, 11) * _C1
+    f = _ror(f, 17) * _C1
+    h = _ror(h + g, 19) * _MIX5 + _MIXC
+    h = _ror(h, 17) * _C1
+    h = _ror(h + f, 19) * _MIX5 + _MIXC
+    h = _ror(h, 17) * _C1
+    out_ref[:, 0] = h
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fingerprint32_pallas(mat, lens, interpret: bool = False) -> jax.Array:
+    """Fingerprint32 with the >24-byte mixing loop as a Pallas kernel.
+
+    Same contract as :func:`hash_ops.fingerprint32_device`.  ``interpret``
+    runs the kernel in interpreter mode (CPU testing)."""
+    mat = jnp.asarray(mat, jnp.uint8)
+    lens = jnp.asarray(lens, jnp.int32)
+    b, width = mat.shape
+    max_iters = max((width - 1) // 20, 0)
+
+    # pad rows to a block multiple (padding rows hash garbage, discarded)
+    pad = (-b) % BLOCK_ROWS
+    if pad:
+        mat_p = jnp.pad(mat, ((0, pad), (0, 0)))
+        lens_p = jnp.pad(lens, (0, pad), constant_values=25)
+    else:
+        mat_p, lens_p = mat, lens
+
+    a0, a1, a2, a3, a4 = _tail_words(mat_p, lens_p)
+    pre = jnp.stack([a0, a1, a2, a3, a4, lens_p.astype(U32)], axis=1)  # [B, 6]
+
+    hbig = pl.pallas_call(
+        functools.partial(_mix_kernel, max_iters=max_iters, width=width),
+        grid=((b + pad) // BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, width), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, 6), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(((b + pad), 1), jnp.uint32),
+        interpret=interpret,
+    )(mat_p, pre)[:b, 0]
+
+    h04 = _hash_0_4(mat, lens)
+    h512 = _hash_5_12(mat, lens)
+    h1324 = _hash_13_24(mat, lens)
+    return jnp.where(
+        lens <= 4,
+        h04,
+        jnp.where(lens <= 12, h512, jnp.where(lens <= 24, h1324, hbig)),
+    )

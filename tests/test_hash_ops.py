@@ -1,0 +1,63 @@
+"""On-device FarmHash + fused keyed routing: bit-exactness against the
+scalar reference (which the native C++ core and host ring already match)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ringpop_tpu.hashing.farm import fingerprint32, pack_strings
+from ringpop_tpu.ops.hash_ops import fingerprint32_device, keyed_owner_lookup
+from ringpop_tpu.ops.hash_pallas import fingerprint32_pallas
+from ringpop_tpu.ops.ring_ops import build_ring_tokens
+
+
+def _corpus(seed=0, n_rand=4):
+    rng = np.random.default_rng(seed)
+    strings = []
+    # every length class boundary: 0..25, plus >24 loop counts 1..6
+    for L in list(range(0, 26)) + [30, 40, 41, 60, 61, 80, 99, 100, 120, 127]:
+        for _ in range(n_rand):
+            strings.append(bytes(rng.integers(0, 256, size=L, dtype=np.uint8)))
+    # realistic ring keys
+    strings += [f"10.3.{i % 256}.{i % 40}:31{i % 100:02d}#{i}".encode() for i in range(128)]
+    return strings
+
+
+def test_device_hash_bitexact():
+    strings = _corpus(seed=2)
+    mat, lens = pack_strings(strings)
+    got = np.asarray(fingerprint32_device(mat, lens))
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_pallas_hash_bitexact_interpret():
+    strings = _corpus(seed=3)
+    mat, lens = pack_strings(strings)
+    got = np.asarray(fingerprint32_pallas(mat, lens, interpret=True))
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_device_hash_utf8_and_empty():
+    strings = [b"", b"a", "key-éÅ".encode(), b"0123456789abcdef0123456789"]
+    mat, lens = pack_strings(strings)
+    got = np.asarray(fingerprint32_device(mat, lens))
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_keyed_owner_lookup_matches_host_ring():
+    from ringpop_tpu.hashring import HashRing
+
+    servers = [f"10.0.0.{i}:3000" for i in range(24)]
+    ring = HashRing()
+    ring.add_remove_servers(servers, [])
+    tokens, owners = build_ring_tokens(servers, 100)
+
+    keys = [f"user:{i}:{i * 37}" for i in range(500)]
+    mat, lens = pack_strings([k.encode() for k in keys])
+    got = np.asarray(keyed_owner_lookup(tokens, owners, mat, lens))
+    want = np.array([servers.index(ring.lookup(k)) for k in keys])
+    assert (got == want).all()
