@@ -1,91 +1,137 @@
-"""Benchmark: million-node SWIM dissemination on one chip.
+"""Benchmark: million-node SWIM failure detection + dissemination.
 
-North star (BASELINE.json): simulate 1M-node SWIM convergence < 60 s.  This
-bench runs the delta engine — 1M nodes, 128 concurrent rumors — until every
-rumor reaches every node, and reports wall-clock seconds with
-``vs_baseline = 60 / measured`` (>1 beats the target).
+North star (BASELINE.json): simulate 1M-node SWIM convergence < 60 s.
+
+Headline metric — the *product* (failure detection, reference call stack
+``swim/node.go:470-513``): crash 0.1% of a 1M-node cluster and measure
+wall-clock until every live observer believes every victim faulty
+(probe → suspect → timer → faulty → full dissemination), on the lifecycle
+engine.  Secondary metrics: delta-engine rumor convergence at 1M (the pure
+dissemination axis) and batched ring lookup qps.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...extras}
+
+The accelerator is probed in a subprocess first (a wedged axon tunnel HANGS
+jax device init rather than raising); on a dead probe the bench pins CPU and
+still runs the FULL 1M configs, recording the probe outcome and fallback
+reason in the JSON.  ``BENCH_FAST=1`` shrinks scales for CI smoke runs;
+``BENCH_PROFILE=dir`` captures a jax.profiler trace of the timed sections.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 
-def _accelerator_alive(timeout_s: float = 120.0) -> bool:
-    """Probe device init in a subprocess: a wedged TPU tunnel can HANG
-    jax.devices() indefinitely rather than raise, which would otherwise
-    leave the bench silent.  A dead probe → CPU fallback."""
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main() -> None:
+    from ringpop_tpu.util.accel import ensure_live_backend
+
+    probe = ensure_live_backend()
+
     import jax
-
-    if not _accelerator_alive():
-        jax.config.update("jax_platforms", "cpu")
-
-    from ringpop_tpu.sim.delta import DeltaParams, DeltaSim, init_state, run_until_converged
-
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:  # accelerator backend down — still produce a result
-        jax.config.update("jax_platforms", "cpu")
-        platform = jax.devices()[0].platform
-    # full scale on an accelerator; CPU fallback keeps CI fast
-    if platform in ("tpu", "axon") or os.environ.get("BENCH_FULL"):
-        n, k = 1_000_000, 128
-    else:
-        n, k = 50_000, 64
-
-    sim = DeltaSim(n=n, k=k, seed=0)
-
-    # compile + warm up one step so the measurement is steady-state
-    t_compile = time.perf_counter()
-    sim.tick()
-    jax.block_until_ready(sim.state.learned)
-    compile_s = time.perf_counter() - t_compile
-
-    # fresh state, timed convergence run (BENCH_PROFILE=dir captures a
-    # jax.profiler trace for kernel-level analysis on real hardware)
-    sim.state = init_state(sim.params, seed=1)
-    profile_dir = os.environ.get("BENCH_PROFILE")
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    state, ticks, ok = run_until_converged(sim.params, sim.state, max_ticks=4096)
-    jax.block_until_ready(state.learned)
-    elapsed = time.perf_counter() - t0
-    if profile_dir:
-        jax.profiler.stop_trace()
-
-    # secondary BASELINE metric: batched ring lookup qps (1M-vnode ring on
-    # the accelerator; cheap relative to the convergence run)
     import numpy as np
 
+    # persistent XLA compilation cache: the 1M-node lifecycle step is a big
+    # program (minutes of single-threaded XLA CPU compile); warming the cache
+    # once makes every later bench run on the same machine compile-free
+    cache_dir = os.environ.get(
+        "BENCH_COMPILE_CACHE", os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # cache flags unavailable on this jax version — run uncached
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    fast = bool(os.environ.get("BENCH_FAST"))
+
+    # -- scales -------------------------------------------------------------
+    # delta convergence runs the full 1M config even on CPU (~10 s).  The
+    # lifecycle engine is ~40x heavier per tick at 1M on a CPU host, so the
+    # CPU fallback measures the headline dynamics at 100k and says so.
+    if fast:
+        n_delta, k_delta = 50_000, 64
+        n_life, victims_frac = 20_000, 0.00025
+        life_scale_reason = "BENCH_FAST=1 smoke scales"
+    elif on_accel:
+        n_delta, k_delta = 1_000_000, 128
+        n_life, victims_frac = 1_000_000, 0.001
+        life_scale_reason = None
+    else:
+        n_delta, k_delta = 1_000_000, 128
+        n_life, victims_frac = 100_000, 0.001
+        life_scale_reason = "cpu fallback: lifecycle tick is ~40x slower than delta at 1M"
+
+    # -- headline: lifecycle failure detection ------------------------------
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaSim, init_state, run_until_converged
+
+    rng = np.random.default_rng(0)
+    n_victims = max(1, int(n_life * victims_frac))
+    victims = np.sort(rng.choice(n_life, size=n_victims, replace=False))
+    up = np.ones(n_life, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jax.numpy.asarray(up))
+
+    check_every = 32
+    t_c0 = time.perf_counter()
+    life = lifecycle.LifecycleSim(n=n_life, k=128, seed=0)
+    # warm exactly the multi-tick block run_until_detected uses (one compile,
+    # persisted in the cache dir), then restart from a fresh state
+    life.run(check_every, faults)
+    jax.block_until_ready(life.state.learned)
+    life_warmup_s = time.perf_counter() - t_c0
+
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        # a narrow kernel-level window: one already-warmed steady-state
+        # block (same static tick count as the warmup, so no compile lands
+        # inside the trace)
+        jax.profiler.start_trace(profile_dir)
+        jax.block_until_ready(life.run(check_every, faults).learned)
+        jax.profiler.stop_trace()
+    life.state = lifecycle.init_state(life.params, seed=0)
+
+    t0 = time.perf_counter()
+    life_ticks, life_ok = life.run_until_detected(
+        victims,
+        faults,
+        max_ticks=4096,
+        check_every=check_every,
+        time_budget_s=float(os.environ.get("BENCH_TIME_BUDGET_S", "900")),
+    )
+    jax.block_until_ready(life.state.learned)
+    life_s = time.perf_counter() - t0
+
+    # -- secondary: delta rumor convergence ---------------------------------
+    sim = DeltaSim(n=n_delta, k=k_delta, seed=0)
+    t_c1 = time.perf_counter()
+    sim.tick()
+    jax.block_until_ready(sim.state.learned)
+    delta_compile_s = time.perf_counter() - t_c1
+
+    sim.state = init_state(sim.params, seed=1)
+    t1 = time.perf_counter()
+    dstate, d_ticks, d_ok = run_until_converged(sim.params, sim.state, max_ticks=4096)
+    jax.block_until_ready(dstate.learned)
+    delta_s = time.perf_counter() - t1
+
+    # -- secondary: batched ring lookup qps ---------------------------------
     from ringpop_tpu.ops.ring_ops import build_ring_tokens, ring_lookup
 
-    n_servers = 4096 if n >= 1_000_000 else 512
+    n_servers = 4096 if not fast else 512
     servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_servers)]
     tokens, owners = build_ring_tokens(servers, 256)
-    rng = np.random.default_rng(0)
-    batch = 1_000_000 if n >= 1_000_000 else 100_000
-    hashes = jax.numpy.asarray(rng.integers(0, 2**32, size=batch, dtype=np.uint32))
+    batch = 1_000_000 if not fast else 100_000
+    hashes = jax.numpy.asarray(
+        np.random.default_rng(0).integers(0, 2**32, size=batch, dtype=np.uint32)
+    )
     jax.block_until_ready(ring_lookup(tokens, owners, hashes))  # compile
     t_r = time.perf_counter()
     for _ in range(10):
@@ -95,18 +141,27 @@ def main() -> None:
 
     baseline_s = 60.0  # BASELINE.json north star
     result = {
-        "metric": f"swim_sim_convergence_n{n}",
-        "value": round(elapsed, 4),
+        "metric": f"swim_lifecycle_detect_n{n_life}",
+        "value": round(life_s, 4),
         "unit": "s",
-        "vs_baseline": round(baseline_s / elapsed, 2) if elapsed > 0 else 0.0,
-        "converged": ok,
-        "ticks": ticks,
-        "ticks_per_s": round(ticks / elapsed, 1) if elapsed > 0 else 0.0,
-        "n_nodes": n,
-        "n_rumors": k,
-        "compile_s": round(compile_s, 2),
+        "vs_baseline": round(baseline_s / life_s, 2) if life_s > 0 else 0.0,
+        "detected": life_ok,
+        "ticks": life_ticks,
+        "sim_time_s": round(life_ticks * 0.2, 1),  # 200ms protocol periods
+        "n_nodes": n_life,
+        "n_victims": n_victims,
+        "warmup_s": round(life_warmup_s, 2),  # one block compile + 32 ticks
+        "lifecycle_scale_reason": life_scale_reason,
+        "delta_converge_s": round(delta_s, 4),
+        "delta_n_nodes": n_delta,
+        "delta_n_rumors": k_delta,
+        "delta_ticks": d_ticks,
+        "delta_converged": d_ok,
+        "delta_vs_baseline": round(baseline_s / delta_s, 2) if delta_s > 0 else 0.0,
+        "delta_compile_s": round(delta_compile_s, 2),
         "ring_lookup_qps": round(ring_qps, 0),
         "platform": platform,
+        "probe": probe,
     }
     print(json.dumps(result))
 

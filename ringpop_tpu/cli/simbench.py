@@ -35,13 +35,14 @@ def _emit(result: dict) -> None:
 
 
 def _platform():
+    # A wedged axon tunnel HANGS jax.devices() rather than raising, so ask
+    # via the shared subprocess probe before touching jax in this process.
+    from ringpop_tpu.util.accel import ensure_live_backend
+
+    ensure_live_backend()
     import jax
 
-    try:
-        return jax.devices()[0].platform
-    except Exception:  # axon tunnel down, etc. — fall back to CPU
-        jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
+    return jax.devices()[0].platform
 
 
 def bench_host10(seed: int, full: bool) -> dict:
@@ -93,7 +94,7 @@ def bench_host10(seed: int, full: bool) -> dict:
         # kill one, detect
         t1 = time.perf_counter()
         victim = nodes[-1]
-        victim.gossip.stop()
+        victim.destroy()  # silent death: timers torn down, no Leave announced
         await chans[-1].close()
         detected = False
         deadline = time.perf_counter() + 30
@@ -356,22 +357,39 @@ def main(argv=None) -> int:
     p.add_argument("--full", action="store_true", help="full BASELINE sizes even on CPU")
     p.add_argument("--cpu", action="store_true", help="pin the CPU backend")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--out",
+        default=None,
+        help="also write all scenario results to this JSON file "
+        "(the committed SIMBENCH_r{N}.json artifacts)",
+    )
     args = p.parse_args(argv)
 
     if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"  # before any jax backend init
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    platform = _platform()
+        platform = "cpu"  # pinned — no point probing the accelerator
+    else:
+        platform = _platform()
     full = args.full or platform in ("tpu", "axon")
     names = [args.only] if args.only else list(BENCHES)
+    results = []
     for name in names:
         t0 = time.perf_counter()
         result = BENCHES[name](args.seed, full)
         result.setdefault("bench", name)
         result["platform"] = platform
+        result["full_scale"] = full
         result["wall_s"] = round(time.perf_counter() - t0, 2)
         _emit(result)
+        results.append(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"platform": platform, "full_scale": full, "scenarios": results}, f, indent=1)
     return 0
 
 

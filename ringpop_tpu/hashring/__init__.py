@@ -49,7 +49,9 @@ class HashRing:
         self.replica_points = replica_points
         self._lock = threading.RLock()
         self._server_tokens: dict[str, np.ndarray] = {}  # addr -> uint32[replica_points]
-        self._tokens = np.empty(0, dtype=np.uint64)  # sorted (token<<32 | server_id)
+        # raw uint32 token values (uint64 dtype), sorted by the composite
+        # (token << 32 | server_id) so equal tokens order by server id
+        self._tokens = np.empty(0, dtype=np.uint64)
         self._owners = np.empty(0, dtype=np.int64)
         self._server_list: list[str] = []  # index -> addr for _owners
         self._checksum = 0
@@ -157,6 +159,11 @@ class HashRing:
         """N unique owners walking the ring upward from farm32(key) with
         wraparound, in ring order (parity: ``hashring.go:271-301``; the
         reference returns map order — ring order here is deterministic)."""
+        return self._lookup_n_hash(self.hashfunc(key) & 0xFFFFFFFF, n)
+
+    def _lookup_n_hash(self, h: int, n: int) -> list[str]:
+        """The exact ring walk from a precomputed 32-bit hash — the oracle
+        the device op (``ops/ring_ops.py`` ring_lookup_n) is tested against."""
         with self._lock:
             nservers = len(self._server_list)
             if nservers == 0:
@@ -164,7 +171,6 @@ class HashRing:
             if n >= nservers:
                 # walk order from the key for determinism, all servers
                 n = nservers
-            h = self.hashfunc(key) & 0xFFFFFFFF
             start = int(np.searchsorted(self._tokens, np.uint64(h), side="left"))
             out: list[str] = []
             seen: set[int] = set()

@@ -36,6 +36,17 @@ from typing import Awaitable, Callable, Optional
 
 Handler = Callable[[dict, dict], Awaitable[dict]]
 
+# one shared compact encoder for every frame: json.dumps() rebuilds an
+# encoder per call and emits spaces after separators; reusing a configured
+# JSONEncoder cuts per-frame CPU and bytes on the wire.  ensure_ascii stays
+# True: error strings can carry surrogateescape-decoded bytes (e.g. OSError
+# filenames) that \\uXXXX-escape fine but crash a strict utf-8 encode.
+_encode_frame = json.JSONEncoder(separators=(",", ":")).encode
+
+
+def _frame_bytes(frame: dict) -> bytes:
+    return _encode_frame(frame).encode("ascii") + b"\n"
+
 
 class CallError(Exception):
     """A call failed to complete (network error, black hole, timeout)."""
@@ -187,7 +198,7 @@ class TCPChannel(BaseChannel):
             res["ok"] = False
             res["err"] = str(e)
         try:
-            writer.write(json.dumps(res).encode() + b"\n")
+            writer.write(_frame_bytes(res))
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -244,7 +255,7 @@ class TCPChannel(BaseChannel):
             "headers": headers or {},
         }
         try:
-            conn.writer.write(json.dumps(frame).encode() + b"\n")
+            conn.writer.write(_frame_bytes(frame))
             await conn.writer.drain()
         except (ConnectionError, OSError) as e:
             conn.pending.pop(rid, None)
@@ -322,13 +333,13 @@ class LocalNetwork:
             raise CallError(f"connect {dst}: connection refused")
         try:
             res = await target.dispatch(
-                service, endpoint, json.loads(json.dumps(body)), dict(headers)
+                service, endpoint, json.loads(_encode_frame(body)), dict(headers)
             )
         except CallError:
             raise
         except Exception as e:  # remote handler error, as the TCP path reports it
             raise RemoteError(str(e)) from e
-        return json.loads(json.dumps(res))
+        return json.loads(_encode_frame(res))
 
 
 class LocalChannel(BaseChannel):

@@ -183,10 +183,13 @@ def fingerprint32_device(mat, lens) -> jax.Array:
     )
 
 
-@jax.jit
 def keyed_owner_lookup(tokens, owners, mat, lens) -> jax.Array:
-    """The full keyed data path on-device: Fingerprint32 each key, then the
-    ring ownership search — int32[B] owner indices, fused under one jit."""
+    """The full keyed data path on-device: Fingerprint32 each key (via the
+    Pallas mixing kernel when it lowers on this backend, else the jnp path),
+    then the ring ownership search — int32[B] owner indices.  Both stages
+    are jitted; the hash-path dispatch lives outside jit so a Mosaic compile
+    failure degrades gracefully."""
+    from ringpop_tpu.ops.hash_pallas import fingerprint32_auto
     from ringpop_tpu.ops.ring_ops import ring_lookup
 
-    return ring_lookup(tokens, owners, fingerprint32_device(mat, lens))
+    return ring_lookup(tokens, owners, fingerprint32_auto(mat, lens))

@@ -141,3 +141,41 @@ def fingerprint32_pallas(mat, lens, interpret: bool = False) -> jax.Array:
         h04,
         jnp.where(lens <= 12, h512, jnp.where(lens <= 24, h1324, hbig)),
     )
+
+
+# per-width compile verdicts: the kernel's block width is maxlen+4, so each
+# key-matrix width is a distinct Mosaic lowering that can independently fail
+_pallas_usable: dict[int, bool] = {}
+
+
+def fingerprint32_auto(mat, lens) -> jax.Array:
+    """Fingerprint32 via the Pallas kernel when it compiles on this backend,
+    else the pure-jnp ``hash_ops.fingerprint32_device`` path.
+
+    The kernel uses per-column scalar uint8 loads and a block width of
+    ``maxlen+4`` (not a 128-lane multiple) — patterns Mosaic may decline to
+    lower on some TPU generations — so every call is guarded: a compile
+    failure at any shape falls back and is remembered per width.  Results
+    are bit-identical either way (both paths are tested against the scalar
+    reference)."""
+    from ringpop_tpu.ops.hash_ops import fingerprint32_device
+
+    mat = jnp.asarray(mat, jnp.uint8)
+    width = int(mat.shape[1]) if mat.ndim == 2 else -1
+    verdict = _pallas_usable.get(width)
+    if verdict is None:
+        # first sighting of this width: trial-run to completion (catches
+        # both Mosaic lowering and runtime failures), remember the verdict
+        try:
+            out = jax.block_until_ready(fingerprint32_pallas(mat, lens))
+            _pallas_usable[width] = True
+            return out
+        except Exception:
+            _pallas_usable[width] = False
+    elif verdict:
+        # later batch sizes of a good width retrace/recompile — still guard
+        try:
+            return fingerprint32_pallas(mat, lens)
+        except Exception:
+            _pallas_usable[width] = False
+    return fingerprint32_device(mat, lens)

@@ -12,6 +12,8 @@ FarmHash (``ringpop_tpu.hashing``) or come from any uint32 source.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,28 +41,59 @@ def ring_lookup(tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array) -> 
     return owners[idx]
 
 
-def ring_lookup_n(tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array, n: int, num_servers: int) -> jax.Array:
-    """First ``n`` *unique* owners walking the ring upward per key.
-
-    Scans a bounded window of ``w`` consecutive tokens (w chosen so that
-    missing n distinct owners in w replica slots is vanishingly unlikely at
-    100 vnodes/server); returns int32[B, n] owner ids, -1 padded."""
-    w = max(4 * n, 16)
+@functools.partial(jax.jit, static_argnames=("n", "w"))
+def _lookup_n_window(tokens, owners, key_hashes, n: int, w: int):
+    """One windowed scan: first-``n``-unique owners within ``w`` consecutive
+    tokens from each key's start position, plus the per-key unique count
+    (for the exactness rescue in :func:`ring_lookup_n`)."""
     b = key_hashes.shape[0]
     start = jnp.searchsorted(tokens, key_hashes, side="left")
-    offs = (start[:, None] + jnp.arange(w)[None, :]) % tokens.shape[0]
+    pos = jnp.arange(w)
+    offs = (start[:, None] + pos[None, :]) % tokens.shape[0]
     cand = owners[offs].astype(jnp.int32)  # [B, w]
 
-    # first occurrence of each owner along the walk
-    eq = cand[:, :, None] == cand[:, None, :]  # [B, i, j]
-    prior = eq & (jnp.arange(w)[None, None, :] < jnp.arange(w)[None, :, None])
-    first_seen = ~prior.any(axis=2)
+    # first occurrence of each owner along the walk, via an O(w log w) sort:
+    # sort (owner, walk-pos) pairs; the head of each equal-owner run is the
+    # owner's first sighting, scattered back to walk position
+    comp = cand.astype(jnp.int64) * w + pos[None, :]
+    sc = jnp.sort(comp, axis=1)
+    sowner = sc // w
+    spos = (sc % w).astype(jnp.int32)
+    head = jnp.concatenate(
+        [jnp.ones((b, 1), bool), sowner[:, 1:] != sowner[:, :-1]], axis=1
+    )
+    b_idx = jnp.broadcast_to(jnp.arange(b)[:, None], cand.shape)
+    first_seen = jnp.zeros((b, w), bool).at[b_idx, spos].set(head)
 
     # rank among first-seen owners, jit-safe scatter into slot `rank`
     rank = jnp.cumsum(first_seen, axis=1) - 1
     take = first_seen & (rank < n)
     slot = jnp.where(take, rank, n)  # overflow slot n is sliced away
-    b_idx = jnp.broadcast_to(jnp.arange(b)[:, None], cand.shape)
     out = jnp.full((b, n + 1), -1, dtype=jnp.int32)
     out = out.at[b_idx, slot].set(jnp.where(take, cand, -1))
-    return out[:, :n]
+    return out[:, :n], first_seen.sum(axis=1)
+
+
+def ring_lookup_n(
+    tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array, n: int, num_servers: int
+) -> jax.Array:
+    """First ``n`` *unique* owners walking the ring upward per key — EXACT
+    (parity: ``hashring/rbtree.go:262-288`` LookupNUniqueAt + wraparound).
+
+    Returns int32[B, n] owner ids, -1 padded when fewer than ``n`` servers
+    exist.  Strategy: a windowed scan of ``w`` consecutive tokens (covers
+    virtually every key at 100 vnodes/server in one pass), then — iff any
+    key found fewer than ``min(n, num_servers)`` owners — the window doubles
+    and rescans until satisfied or the whole ring is covered.  Each window
+    size is a cached jit specialization; the doubling loop runs on the host,
+    so this helper is exact without data-dependent shapes inside jit."""
+    t = int(tokens.shape[0])
+    if t == 0:
+        return jnp.full((key_hashes.shape[0], n), -1, jnp.int32)
+    need = min(n, num_servers)
+    w = min(max(4 * n, 16), t)
+    while True:
+        out, found = _lookup_n_window(tokens, owners, key_hashes, n, w)
+        if w >= t or bool((found >= need).all()):
+            return out
+        w = min(2 * w, t)

@@ -72,6 +72,7 @@ from ringpop_tpu.sim.delta import (
 from ringpop_tpu.swim.member import (
     ALIVE,
     FAULTY,
+    KEY_STATE_BITS,
     SUSPECT,
     TOMBSTONE,
     is_detraction as _is_detraction,
@@ -321,8 +322,11 @@ def step(
         _key_of(state.base_inc, jnp.where(bfire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))),
         jnp.int32(-1),
     )
+    # seed at whichever candidate won the key merge: slot-fired rumors keep
+    # their first live learner; base-fired transitions (no learner set) seed
+    # at the first live node.  Ties keep the slot's learner.
+    seed_node = jnp.where(bfire_key > fire_key, first_live, seed_node)
     fire_key = jnp.maximum(fire_key, bfire_key)
-    seed_node = jnp.where(bfire_key > jnp.int32(-1), first_live, seed_node)
 
     # -- evictions (tombstone timer expired; memberlist.Evict analog) -------
     evicted = jnp.zeros((n,), bool).at[jnp.clip(subj, 0, n - 1)].max(fire_t) | bfire_t
@@ -576,7 +580,15 @@ def detection_fraction(
     min_status: int = FAULTY,
 ) -> jax.Array:
     """float[S]: fraction of live observers whose belief about each subject
-    has reached ``min_status`` (or the subject is evicted)."""
+    has reached ``min_status`` (or the subject is evicted).
+
+    Dispatches on problem size: the vectorized small path materializes
+    O(N·K·S); past ~2^28 elements the slot-walk path computes the same
+    per-observer first-learned-wins semantics from [N]-column ops (a 1M x
+    128 x 1000 query goes from ~500 GB of intermediates to ~2k column
+    reductions)."""
+    if state.learned.shape[0] * state.learned.shape[1] * len(subjects) > 2**28:
+        return _detection_fraction_large(state, subjects, faults, min_status)
     subjects = jnp.asarray(subjects, jnp.int32)
     bk = believed_key(state, subjects)
     detected = (bk < 0) | (_status_of(jnp.maximum(bk, 0)) >= min_status)
@@ -585,6 +597,59 @@ def detection_fraction(
     observer = up & ~is_subject
     num = (detected & observer[:, None]).sum(axis=0)
     return num / jnp.maximum(observer.sum(), 1)
+
+
+def _detection_fraction_large(
+    state: LifecycleState,
+    subjects,
+    faults: DeltaFaults = DeltaFaults(),
+    min_status: int = FAULTY,
+) -> jax.Array:
+    """Exact large-scale detection_fraction.
+
+    Per observer, belief about subject ``s`` is governed by the highest-key
+    source it knows: walk s's rumor slots in descending key order, counting
+    observers whose FIRST learned slot is each one (prefix exclusion over
+    [N] boolean columns); observers that learned none fall through to the
+    base.  Rumor/base metadata is [K]/scalars — only [N]-sized column ops
+    touch the device."""
+    n, k = state.learned.shape
+    subjects_np = np.asarray(subjects, np.int64)
+    r_subject = np.asarray(state.r_subject)
+    r_key = (np.asarray(state.r_inc, np.int64) << KEY_STATE_BITS) | np.asarray(
+        state.r_status, np.int64
+    )
+    active = r_subject >= 0
+    base_present = np.asarray(state.base_present)[subjects_np]
+    base_key = (np.asarray(state.base_inc, np.int64)[subjects_np] << KEY_STATE_BITS) | np.asarray(
+        state.base_status, np.int64
+    )[subjects_np]
+    base_status = np.asarray(state.base_status)[subjects_np]
+
+    up = faults.up if faults.up is not None else jnp.ones(n, bool)
+    is_subject = jnp.zeros(n, bool).at[jnp.asarray(subjects_np)].set(True)
+    obs = up & ~is_subject
+    obs_total = int(obs.sum())
+    frac = np.zeros(len(subjects_np), np.float64)
+    for si, s in enumerate(subjects_np):
+        slots = np.flatnonzero(active & (r_subject == s))
+        order = slots[np.argsort(-r_key[slots], kind="stable")]
+        remaining = obs  # observers not yet governed by a higher-key rumor
+        count = 0
+        for slot in order:
+            if base_present[si] and base_key[si] >= r_key[slot]:
+                break  # base outranks this and all lower slots for everyone
+            col = state.learned[:, int(slot)]
+            got = remaining & col
+            if int(r_key[slot] & (2**KEY_STATE_BITS - 1)) >= min_status:
+                count += int(got.sum())
+            remaining = remaining & ~col
+        # fall-through: governed by the base (absent subject counts as
+        # detected — the eviction end state)
+        if (not base_present[si]) or int(base_status[si]) >= min_status:
+            count += int(remaining.sum())
+        frac[si] = count / max(obs_total, 1)
+    return jnp.asarray(frac)
 
 
 def _run_block(params: LifecycleParams, state, faults, ticks: int):
@@ -620,9 +685,16 @@ class LifecycleSim:
         min_status: int = FAULTY,
         max_ticks: int = 5000,
         check_every: int = 8,
+        time_budget_s: Optional[float] = None,
     ):
         """Tick until every live observer believes every subject has reached
-        ``min_status``.  Returns (ticks_used, detected)."""
+        ``min_status``.  Returns (ticks_used, detected).  ``time_budget_s``
+        bounds wall-clock (benchmarks on an unexpectedly slow backend stop
+        at the budget and report partial progress instead of running away).
+        """
+        import time as _time
+
+        deadline = None if time_budget_s is None else _time.perf_counter() + time_budget_s
         subjects = jnp.asarray(list(subjects), jnp.int32)
         ticks = 0
         while ticks < max_ticks:
@@ -631,4 +703,6 @@ class LifecycleSim:
             frac = detection_fraction(self.state, subjects, faults, min_status)
             if bool((frac >= 1.0).all()):
                 return ticks, True
+            if deadline is not None and _time.perf_counter() > deadline:
+                break
         return ticks, False

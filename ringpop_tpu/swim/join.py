@@ -39,11 +39,14 @@ class JoinRequest:
     timeout: float = 0.0
 
     def to_wire(self) -> dict:
+        # the reference's Timeout is a Go time.Duration, which encoding/json
+        # marshals as INTEGER NANOSECONDS (join_sender.go:58-63) — keep that
+        # unit on the wire; this codec holds float seconds internally
         return {
             "app": self.app,
             "source": self.source,
             "incarnationNumber": self.incarnation,
-            "timeout": self.timeout,
+            "timeout": int(self.timeout * 1e9),
         }
 
     @classmethod
@@ -52,7 +55,7 @@ class JoinRequest:
             app=d.get("app", ""),
             source=d.get("source", ""),
             incarnation=int(d.get("incarnationNumber", 0)),
-            timeout=float(d.get("timeout", 0)),
+            timeout=float(d.get("timeout", 0)) / 1e9,
         )
 
 

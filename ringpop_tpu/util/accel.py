@@ -1,0 +1,84 @@
+"""Accelerator liveness probe shared by the benchmark entry points.
+
+This environment reaches the TPU through an ``axon`` tunnel that, when
+wedged, makes ``jax.devices()`` HANG indefinitely rather than raise
+(round-1 artifacts recorded a 124 timeout for exactly this).  Probing in a
+subprocess with a timeout is the only safe way to ask "is the accelerator
+usable?" before letting the current process initialize a backend.
+
+Reference analog: none — the Go reference talks TCP and cannot wedge this
+way; this is TPU-runtime plumbing the rebuild owns.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+
+def probe_accelerator(timeouts_s: Sequence[float] = (90.0, 240.0)) -> dict:
+    """Probe device init + one tiny computation in a subprocess.
+
+    Returns a diagnostic dict (JSON-serializable, embedded in bench
+    artifacts): ``{"alive": bool, "platform": str|None, "probe_s": float,
+    "reason": str}``.  Escalating timeouts: a cold axon tunnel can be
+    slow-but-alive, so a failed quick probe earns one patient retry.
+    """
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "jnp.ones((8, 8)).sum().block_until_ready();"
+        "print(d[0].platform)"
+    )
+    t0 = time.perf_counter()
+    reason = "ok"
+    platform: Optional[str] = None
+    alive = False
+    for i, timeout_s in enumerate(timeouts_s):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            reason = f"probe timeout after {timeout_s:.0f}s (attempt {i + 1})"
+            continue
+        if r.returncode == 0:
+            alive = True
+            platform = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else None
+            reason = "ok"
+            break
+        reason = f"probe rc={r.returncode}: {(r.stderr or '').strip()[-200:]}"
+    return {
+        "alive": alive,
+        "platform": platform,
+        "probe_s": round(time.perf_counter() - t0, 1),
+        "reason": reason,
+    }
+
+
+def ensure_live_backend(timeouts_s: Sequence[float] = (90.0, 240.0)) -> dict:
+    """Probe, then pin this process to CPU if the accelerator is dead.
+
+    Must run before anything initializes a jax backend.  Returns the probe
+    dict with a ``"fallback"`` key added (None when the accelerator is
+    live, else the reason the run fell back to CPU).
+    """
+    info = probe_accelerator(timeouts_s=timeouts_s)
+    if info["alive"]:
+        info["fallback"] = None
+    else:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already up — caller initialized earlier
+        info["fallback"] = info["reason"]
+    return info

@@ -1,0 +1,187 @@
+"""Shared machinery for the lifecycle-vs-fullview engine agreement study
+(VERDICT round-1 item 4) — used by ``tests/test_engine_agreement.py`` and
+runnable directly to print the raw distributions:
+
+    python -m tests.engine_agreement [--seeds 20] [--n 256]
+
+The lifecycle engine documents four approximations vs the exact fullview
+engine (``sim/lifecycle.py`` module docstring).  This harness measures, at
+identical params and fault schedules over many seeds:
+
+* detection latency (crash -> every live observer believes victim faulty);
+* refutation behavior (drop-rate-induced false suspicions refuted: how many
+  nodes ended with a bumped self-incarnation, and whether the cluster
+  returns to an all-alive converged view);
+* steady-state quiescence (no faults -> no rumors / no change records).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ringpop_tpu.sim import fullview, lifecycle
+from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.swim.member import ALIVE, FAULTY
+
+
+def make_faults(n, down=(), drop=0.0):
+    up = np.ones(n, bool)
+    for i in down:
+        up[i] = False
+    return DeltaFaults(up=jnp.asarray(up), drop_rate=drop)
+
+
+# -- fullview queries -------------------------------------------------------
+
+
+def fv_detected(sim: fullview.FullViewSim, victims, up) -> bool:
+    """Every live observer believes every victim >= FAULTY (or evicted)."""
+    status = np.asarray(sim.state.status)
+    present = np.asarray(sim.state.present)
+    observers = np.asarray(up).copy()
+    observers[list(victims)] = False
+    obs_idx = np.flatnonzero(observers)
+    sub = status[np.ix_(obs_idx, list(victims))]
+    gone = ~present[np.ix_(obs_idx, list(victims))]
+    return bool(((sub >= FAULTY) | gone).all())
+
+
+def fv_all_alive_converged(sim: fullview.FullViewSim) -> bool:
+    status = np.asarray(sim.state.status)
+    return bool((status == ALIVE).all()) and not bool(np.asarray(sim.state.has_change).any())
+
+
+def fv_refuted_count(sim: fullview.FullViewSim) -> int:
+    """Nodes whose self-incarnation advanced past the epoch (= refuted at
+    least once)."""
+    inc = np.asarray(sim.state.incarnation)
+    return int((np.diagonal(inc) > 0).sum())
+
+
+# -- lifecycle queries ------------------------------------------------------
+
+
+def lc_detected(sim: lifecycle.LifecycleSim, victims, faults) -> bool:
+    frac = lifecycle.detection_fraction(sim.state, list(victims), faults, FAULTY)
+    return bool((np.asarray(frac) >= 1.0).all())
+
+
+def lc_quiet_all_alive(sim: lifecycle.LifecycleSim) -> bool:
+    s = sim.state
+    no_rumors = bool((np.asarray(s.r_subject) < 0).all())
+    base_alive = bool((np.asarray(s.base_status) == ALIVE).all())
+    return no_rumors and base_alive
+
+
+def lc_refuted_count(sim: lifecycle.LifecycleSim) -> int:
+    return int((np.asarray(sim.state.self_inc) > 0).sum())
+
+
+# -- scenarios --------------------------------------------------------------
+
+
+def detection_latency(engine: str, n: int, seed: int, victims, suspect_ticks=15, max_ticks=400):
+    """Ticks until full detection of crashed victims, or max_ticks."""
+    faults = make_faults(n, down=victims)
+    if engine == "fullview":
+        sim = fullview.FullViewSim(n=n, seed=seed, suspect_ticks=suspect_ticks)
+        for t in range(1, max_ticks + 1):
+            sim.tick(faults)
+            if t % 2 == 0 and fv_detected(sim, victims, np.asarray(faults.up)):
+                return t
+        return max_ticks
+    else:
+        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed, suspect_ticks=suspect_ticks)
+        for t in range(1, max_ticks + 1):
+            sim.tick(faults)
+            if t % 2 == 0 and lc_detected(sim, victims, faults):
+                return t
+        return max_ticks
+
+
+def refutation_run(engine: str, n: int, seed: int, drop=0.10, noisy_ticks=60,
+                   quiet_ticks=300, suspect_ticks=8):
+    """Run with packet loss (false suspicions accumulate), then drop-free
+    until the cluster re-converges to all-alive.  Returns (refuted_count,
+    recovered: bool, recovery_ticks)."""
+    noisy = make_faults(n, drop=drop)
+    clean = make_faults(n)
+    if engine == "fullview":
+        sim = fullview.FullViewSim(n=n, seed=seed, suspect_ticks=suspect_ticks)
+        for _ in range(noisy_ticks):
+            sim.tick(noisy)
+        refuted_mid = fv_refuted_count(sim)
+        for t in range(1, quiet_ticks + 1):
+            sim.tick(clean)
+            if t % 4 == 0 and fv_all_alive_converged(sim):
+                return max(refuted_mid, fv_refuted_count(sim)), True, t
+        return max(refuted_mid, fv_refuted_count(sim)), False, quiet_ticks
+    else:
+        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed, suspect_ticks=suspect_ticks)
+        for _ in range(noisy_ticks):
+            sim.tick(noisy)
+        refuted_mid = lc_refuted_count(sim)
+        for t in range(1, quiet_ticks + 1):
+            sim.tick(clean)
+            if t % 4 == 0 and lc_quiet_all_alive(sim):
+                return max(refuted_mid, lc_refuted_count(sim)), True, t
+        return max(refuted_mid, lc_refuted_count(sim)), False, quiet_ticks
+
+
+def quiescence_run(engine: str, n: int, seed: int, ticks=60):
+    """No faults: returns True iff the engine stays fully quiet."""
+    faults = make_faults(n)
+    if engine == "fullview":
+        sim = fullview.FullViewSim(n=n, seed=seed)
+        for _ in range(ticks):
+            sim.tick(faults)
+        return fv_all_alive_converged(sim)
+    else:
+        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed)
+        for _ in range(ticks):
+            sim.tick(faults)
+        return lc_quiet_all_alive(sim)
+
+
+def collect(n=256, seeds=20, n_victims=3):
+    out = {"detect": {}, "refute": {}}
+    rng = np.random.default_rng(7)
+    victim_sets = [sorted(rng.choice(n, size=n_victims, replace=False).tolist()) for _ in range(seeds)]
+    for engine in ("fullview", "lifecycle"):
+        out["detect"][engine] = [
+            detection_latency(engine, n, 100 + s, victim_sets[s]) for s in range(seeds)
+        ]
+        out["refute"][engine] = [
+            refutation_run(engine, n, 200 + s) for s in range(seeds)
+        ]
+    return out
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=20)
+    ap.add_argument("--n", type=int, default=256)
+    args = ap.parse_args()
+    res = collect(n=args.n, seeds=args.seeds)
+    for scenario, by_engine in res.items():
+        for engine, vals in by_engine.items():
+            print(scenario, engine, json.dumps(vals))
+    for engine in ("fullview", "lifecycle"):
+        d = np.array(res["detect"][engine], float)
+        print(
+            f"{engine}: detect median={np.median(d):.0f} mean={d.mean():.1f} "
+            f"p90={np.percentile(d, 90):.0f}"
+        )
+        ref = res["refute"][engine]
+        counts = np.array([r[0] for r in ref], float)
+        rec = np.array([r[1] for r in ref])
+        rticks = np.array([r[2] for r in ref], float)
+        print(
+            f"{engine}: refuted mean={counts.mean():.1f} recovered={rec.mean():.2f} "
+            f"recovery median={np.median(rticks):.0f}"
+        )

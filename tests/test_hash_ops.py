@@ -40,6 +40,28 @@ def test_pallas_hash_bitexact_interpret():
     assert (got == want).all()
 
 
+def test_pallas_auto_falls_back_and_is_bitexact():
+    """fingerprint32_auto must yield correct hashes whether or not the
+    compiled Pallas kernel lowers on this backend (on CPU, non-interpret
+    pallas_call may or may not compile — either branch must be exact)."""
+    from ringpop_tpu.ops import hash_pallas
+
+    strings = _corpus(seed=4)
+    mat, lens = pack_strings(strings)
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    got = np.asarray(hash_pallas.fingerprint32_auto(mat, lens))
+    assert (got == want).all()
+    assert mat.shape[1] in hash_pallas._pallas_usable  # per-width verdict cached
+    # second call exercises the cached branch
+    got2 = np.asarray(hash_pallas.fingerprint32_auto(mat, lens))
+    assert (got2 == want).all()
+    # a forced-False width must silently take the jnp path
+    hash_pallas._pallas_usable[mat.shape[1]] = False
+    got3 = np.asarray(hash_pallas.fingerprint32_auto(mat, lens))
+    assert (got3 == want).all()
+    del hash_pallas._pallas_usable[mat.shape[1]]
+
+
 def test_device_hash_utf8_and_empty():
     strings = [b"", b"a", "key-éÅ".encode(), b"0123456789abcdef0123456789"]
     mat, lens = pack_strings(strings)

@@ -170,6 +170,27 @@ def test_packet_loss_still_converges():
     assert bool((status == ALIVE).all())
 
 
+def test_detection_fraction_large_path_matches_small():
+    """The slot-walk large-scale detection_fraction must agree exactly with
+    the vectorized O(N·K·S) path on rich mixed states: suspects in flight,
+    fired faulty transitions, folded bases, drop-induced refutations."""
+    from ringpop_tpu.sim.lifecycle import _detection_fraction_large, detection_fraction
+
+    n = 96
+    sim = LifecycleSim(n=n, k=24, seed=21, suspect_ticks=6, alloc_per_tick=8)
+    victims = [5, 40, 41, 77]
+    faults = make_faults(n, down=victims, drop=0.08)
+    subjects = victims + [0, 17, 60]  # dead + live subjects
+    for ticks in (4, 8, 12, 20, 40, 80, 160):
+        sim.run(4 if ticks <= 20 else ticks // 4, faults)
+        for min_status in (SUSPECT, FAULTY, TOMBSTONE):
+            small = np.asarray(detection_fraction(sim.state, subjects, faults, min_status))
+            large = np.asarray(
+                _detection_fraction_large(sim.state, subjects, faults, min_status)
+            )
+            assert np.allclose(small, large), (ticks, min_status, small, large)
+
+
 def test_crashed_node_revives_and_recovers():
     """Elastic recovery (SURVEY §5): a node detected faulty comes back up,
     learns it is believed faulty from the first exchange that reaches it,

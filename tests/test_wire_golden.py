@@ -1,0 +1,237 @@
+"""Golden wire-conformance tests (VERDICT round-1 item 5).
+
+The reference's tier-3 suite proves cross-implementation conformance by
+running a shared harness against real processes
+(``test/run-integration-tests:99-113``).  TChannel interop is out of scope
+here, so the achievable substitute is a recorded corpus: canonical JSON
+bodies hand-derived from the reference's serialization semantics
+(``swim/ping_sender.go:35-40``, ``ping_request_sender.go:35-41``,
+``ping_request_handler.go:26-30``, ``join_sender.go:58-63``,
+``join_handler.go:27-32``, ``member.go:135-167``, ``memberlist.go:106-128``)
+replayed through this implementation's codecs and live host-plane handlers
+in both directions.  These tests pin the wire schema independently of the
+encoder: if a codec key, state string, unit, or shim drifts, a frozen
+literal — not a round-trip identity — catches it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from ringpop_tpu.hashing import fingerprint32
+from ringpop_tpu.net import LocalNetwork
+from ringpop_tpu.swim.join import JoinRequest, JoinResponse, handle_join
+from ringpop_tpu.swim.member import Change, state_id
+from ringpop_tpu.swim.memberlist import Memberlist
+from ringpop_tpu.swim.ping import Ping, handle_ping
+from ringpop_tpu.swim.ping_request import PingRequest, PingResponse
+
+from tests.swim_utils import bootstrap_nodes, make_nodes
+
+CORPUS = json.loads(
+    (Path(__file__).parent / "golden" / "wire_corpus.json").read_text()
+)
+
+
+# -- Change codec: every state, both shims, both directions -----------------
+
+
+@pytest.mark.parametrize("case", CORPUS["changes"], ids=lambda c: c["name"])
+def test_change_decode_matches_golden(case):
+    c = Change.from_wire(case["wire"])
+    want = case["decoded"]
+    assert c.address == want["address"]
+    assert c.incarnation == want["incarnation"]
+    assert c.status == want["status"]
+    assert c.source == want["source"]
+    assert c.source_incarnation == want["source_incarnation"]
+    assert c.timestamp == want["timestamp"]
+
+
+@pytest.mark.parametrize("case", CORPUS["changes"], ids=lambda c: c["name"])
+def test_change_reencode_is_identical(case):
+    """Decode → encode must reproduce the reference body byte-for-byte as a
+    dict: the tombstone shim re-applies on the way out (member.go:159-167)
+    and unknown statuses pass through verbatim (member.go:124-127)."""
+    assert Change.from_wire(case["wire"]).to_wire() == case["wire"]
+
+
+def test_change_encode_from_fields_matches_golden():
+    """Construct from plain fields (no decode step) → golden body."""
+    case = next(c for c in CORPUS["changes"] if c["name"] == "tombstone_shimmed")
+    d = case["decoded"]
+    c = Change(
+        address=d["address"],
+        incarnation=d["incarnation"],
+        status=d["status"],
+        source=d["source"],
+        source_incarnation=d["source_incarnation"],
+        timestamp=d["timestamp"],
+    )
+    assert c.to_wire() == case["wire"]
+
+
+# -- message bodies ---------------------------------------------------------
+
+
+def test_ping_body_roundtrip():
+    wire = CORPUS["ping_request"]["wire"]
+    p = Ping.from_wire(wire)
+    assert p.source == wire["source"]
+    assert p.checksum == wire["checksum"]
+    assert p.source_incarnation == wire["sourceIncarnationNumber"]
+    assert p.to_wire() == wire
+
+
+def test_ping_req_bodies_roundtrip():
+    wire = CORPUS["ping_req_request"]["wire"]
+    pr = PingRequest.from_wire(wire)
+    assert pr.target == wire["target"]
+    assert pr.to_wire() == wire
+
+    rwire = CORPUS["ping_req_response"]["wire"]
+    res = PingResponse.from_wire(rwire)
+    assert res.ok is True and res.target == rwire["target"]
+    assert res.to_wire() == rwire
+
+
+def test_join_request_roundtrip_and_duration_unit():
+    """The reference's joinRequest.Timeout is a Go time.Duration: integer
+    nanoseconds on the wire (join_sender.go:58-63)."""
+    wire = CORPUS["join_request"]["wire"]
+    req = JoinRequest.from_wire(wire)
+    assert req.timeout == CORPUS["join_request"]["decoded_timeout_seconds"]
+    assert req.to_wire() == wire
+
+
+def test_join_response_roundtrip():
+    wire = CORPUS["join_response"]["wire"]
+    res = JoinResponse.from_wire(wire)
+    assert res.coordinator == wire["coordinator"]
+    assert res.checksum == wire["membershipChecksum"]
+    # tombstone shim inside a membership list lifts and re-applies
+    assert res.membership[1].status == state_id("tombstone")
+    assert res.to_wire() == wire
+
+
+# -- checksum canonical form ------------------------------------------------
+
+
+class _StubNode:
+    """Just enough node for a standalone Memberlist."""
+
+    address = "stub:0"
+
+    def emit(self, event):
+        pass
+
+    def handle_changes(self, changes):
+        pass
+
+    def stopped(self) -> bool:
+        return False
+
+    class rollup:
+        @staticmethod
+        def track_updates(changes):
+            pass
+
+
+@pytest.mark.parametrize("case", CORPUS["checksum_strings"], ids=lambda c: c["name"])
+def test_checksum_string_matches_golden(case):
+    ml = Memberlist(_StubNode())
+    for m in case["members"]:
+        status = state_id(m["status"])
+        if m["status"] == "tombstone":
+            # first-seen tombstones are refused (memberlist tombstone rule);
+            # arrive as faulty first, then lift via the wire shim
+            ml.update([Change(m["address"], m["incarnation"], state_id("faulty"))])
+            ml.update(
+                [
+                    Change.from_wire(
+                        {
+                            "address": m["address"],
+                            "incarnationNumber": m["incarnation"],
+                            "status": "faulty",
+                            "tombstone": True,
+                        }
+                    )
+                ]
+            )
+        else:
+            ml.update([Change(m["address"], m["incarnation"], status)])
+    assert ml.gen_checksum_string() == case["canonical"]
+    assert ml.compute_checksum() == case["farm32"]
+    assert fingerprint32(case["canonical"]) == case["farm32"]
+
+
+# -- live host-plane replay -------------------------------------------------
+
+
+def test_golden_ping_replays_through_live_handler():
+    """Feed the recorded reference ping body to a bootstrapped node's real
+    handler: the piggybacked change must apply and the response must carry
+    exactly the reference's response schema."""
+
+    async def run():
+        nodes = make_nodes(2)
+        await bootstrap_nodes(nodes)
+        node = nodes[0]
+        body = CORPUS["ping_request"]["wire"]
+        res = await handle_ping(node, body, {})
+        # response schema: the same `ping` struct (ping_sender.go:35-40)
+        assert set(res) == {"changes", "checksum", "source", "sourceIncarnationNumber"}
+        assert res["source"] == node.address
+        # the golden body's alive change was applied through the full
+        # update pipeline (first-seen applies wholesale)
+        m = node.memberlist.member("10.0.0.2:3000")
+        assert m is not None and m.status == state_id("alive")
+        assert m.incarnation == body["changes"][0]["incarnationNumber"]
+        for nd in nodes:
+            nd.destroy()
+
+    asyncio.run(run())
+
+
+def test_golden_join_replays_through_live_handler():
+    async def run():
+        nodes = make_nodes(2, app="testapp")
+        await bootstrap_nodes(nodes)
+        node = nodes[0]
+        res = await handle_join(node, CORPUS["join_request"]["wire"], {})
+        # response schema per join_handler.go:27-32
+        assert set(res) == {"app", "coordinator", "membership", "membershipChecksum"}
+        assert res["app"] == "testapp"
+        assert res["coordinator"] == node.address
+        addrs = {c["address"] for c in res["membership"]}
+        assert {n.address for n in nodes} <= addrs
+        for c in res["membership"]:
+            assert set(c) >= {
+                "source",
+                "sourceIncarnationNumber",
+                "address",
+                "incarnationNumber",
+                "status",
+                "timestamp",
+            }
+            assert isinstance(c["status"], str)
+        for nd in nodes:
+            nd.destroy()
+
+    asyncio.run(run())
+
+
+def test_golden_join_rejects_wrong_app():
+    async def run():
+        nodes = make_nodes(2, app="otherapp")
+        await bootstrap_nodes(nodes)
+        with pytest.raises(ValueError, match="different app"):
+            await handle_join(nodes[0], CORPUS["join_request"]["wire"], {})
+        for nd in nodes:
+            nd.destroy()
+
+    asyncio.run(run())
