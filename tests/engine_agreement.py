@@ -17,7 +17,23 @@ identical params and fault schedules over many seeds:
 
 from __future__ import annotations
 
+import os
+
+# direct `python -m tests.engine_agreement` runs bypass tests/conftest.py's
+# backend pinning; without it this environment initializes the axon platform,
+# which hangs when the TPU tunnel is down.  (A plain setdefault is not
+# enough: the container's sitecustomize re-exports JAX_PLATFORMS=axon at
+# interpreter startup.)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 import numpy as np
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialized (pytest: conftest pinned it)
 
 import jax.numpy as jnp
 
@@ -81,24 +97,42 @@ def lc_refuted_count(sim: lifecycle.LifecycleSim) -> int:
 
 # -- scenarios --------------------------------------------------------------
 
+# one sim instance per (engine, params) combination, state re-seeded per run:
+# re-instantiating per seed would recompile the jitted step each time
+_sim_cache: dict = {}
+
+
+def _get_sim(engine: str, n: int, seed: int, suspect_ticks: int):
+    key = (engine, n, suspect_ticks)
+    sim = _sim_cache.get(key)
+    if engine == "fullview":
+        if sim is None:
+            sim = _sim_cache[key] = fullview.FullViewSim(
+                n=n, seed=seed, suspect_ticks=suspect_ticks
+            )
+        sim.state = fullview.init_state(sim.params, seed=seed)
+    else:
+        if sim is None:
+            sim = _sim_cache[key] = lifecycle.LifecycleSim(
+                n=n, k=64, seed=seed, suspect_ticks=suspect_ticks
+            )
+        sim.state = lifecycle.init_state(sim.params, seed=seed)
+    return sim
+
 
 def detection_latency(engine: str, n: int, seed: int, victims, suspect_ticks=15, max_ticks=400):
     """Ticks until full detection of crashed victims, or max_ticks."""
     faults = make_faults(n, down=victims)
-    if engine == "fullview":
-        sim = fullview.FullViewSim(n=n, seed=seed, suspect_ticks=suspect_ticks)
-        for t in range(1, max_ticks + 1):
-            sim.tick(faults)
-            if t % 2 == 0 and fv_detected(sim, victims, np.asarray(faults.up)):
+    sim = _get_sim(engine, n, seed, suspect_ticks)
+    for t in range(1, max_ticks + 1):
+        sim.tick(faults)
+        if t % 2 == 0:
+            if engine == "fullview":
+                if fv_detected(sim, victims, np.asarray(faults.up)):
+                    return t
+            elif lc_detected(sim, victims, faults):
                 return t
-        return max_ticks
-    else:
-        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed, suspect_ticks=suspect_ticks)
-        for t in range(1, max_ticks + 1):
-            sim.tick(faults)
-            if t % 2 == 0 and lc_detected(sim, victims, faults):
-                return t
-        return max_ticks
+    return max_ticks
 
 
 def refutation_run(engine: str, n: int, seed: int, drop=0.10, noisy_ticks=60,
@@ -108,41 +142,26 @@ def refutation_run(engine: str, n: int, seed: int, drop=0.10, noisy_ticks=60,
     recovered: bool, recovery_ticks)."""
     noisy = make_faults(n, drop=drop)
     clean = make_faults(n)
-    if engine == "fullview":
-        sim = fullview.FullViewSim(n=n, seed=seed, suspect_ticks=suspect_ticks)
-        for _ in range(noisy_ticks):
-            sim.tick(noisy)
-        refuted_mid = fv_refuted_count(sim)
-        for t in range(1, quiet_ticks + 1):
-            sim.tick(clean)
-            if t % 4 == 0 and fv_all_alive_converged(sim):
-                return max(refuted_mid, fv_refuted_count(sim)), True, t
-        return max(refuted_mid, fv_refuted_count(sim)), False, quiet_ticks
-    else:
-        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed, suspect_ticks=suspect_ticks)
-        for _ in range(noisy_ticks):
-            sim.tick(noisy)
-        refuted_mid = lc_refuted_count(sim)
-        for t in range(1, quiet_ticks + 1):
-            sim.tick(clean)
-            if t % 4 == 0 and lc_quiet_all_alive(sim):
-                return max(refuted_mid, lc_refuted_count(sim)), True, t
-        return max(refuted_mid, lc_refuted_count(sim)), False, quiet_ticks
+    sim = _get_sim(engine, n, seed, suspect_ticks)
+    refuted = fv_refuted_count if engine == "fullview" else lc_refuted_count
+    settled = fv_all_alive_converged if engine == "fullview" else lc_quiet_all_alive
+    for _ in range(noisy_ticks):
+        sim.tick(noisy)
+    refuted_mid = refuted(sim)
+    for t in range(1, quiet_ticks + 1):
+        sim.tick(clean)
+        if t % 4 == 0 and settled(sim):
+            return max(refuted_mid, refuted(sim)), True, t
+    return max(refuted_mid, refuted(sim)), False, quiet_ticks
 
 
 def quiescence_run(engine: str, n: int, seed: int, ticks=60):
     """No faults: returns True iff the engine stays fully quiet."""
     faults = make_faults(n)
-    if engine == "fullview":
-        sim = fullview.FullViewSim(n=n, seed=seed)
-        for _ in range(ticks):
-            sim.tick(faults)
-        return fv_all_alive_converged(sim)
-    else:
-        sim = lifecycle.LifecycleSim(n=n, k=64, seed=seed)
-        for _ in range(ticks):
-            sim.tick(faults)
-        return lc_quiet_all_alive(sim)
+    sim = _get_sim(engine, n, seed, suspect_ticks=25)
+    for _ in range(ticks):
+        sim.tick(faults)
+    return fv_all_alive_converged(sim) if engine == "fullview" else lc_quiet_all_alive(sim)
 
 
 def collect(n=256, seeds=20, n_victims=3):
