@@ -53,6 +53,54 @@ def fingerprint32_many(strings: Iterable[str | bytes]) -> np.ndarray:
     return fingerprint32_batch(mat, lens).astype(np.uint32)
 
 
+def membership_checksum(entries: Sequence[str]) -> int:
+    """farm32 over sorted entries joined with trailing ';' — the membership
+    checksum canonical form (parity: ``swim/memberlist.go:106-128``).  The
+    native path sorts, joins, and hashes in one C++ call; the fallback builds
+    the same string in Python."""
+    if _use_native():
+        from ringpop_tpu import native
+
+        return native.membership_checksum(entries)
+    return fingerprint32("".join(s + ";" for s in sorted(entries)))
+
+
+def ring_lookup_n_batch(
+    tokens: np.ndarray,
+    owners: np.ndarray,
+    n_servers: int,
+    hashes: np.ndarray,
+    nwant: int,
+) -> np.ndarray:
+    """Exact batched N-owner ring walk -> int32[nkeys, nwant] server indices,
+    -1-padded (parity: ``hashring.go:271-301``).  Native C++ walk with a
+    per-owner stamp array; Python fallback does the same walk per key."""
+    if _use_native():
+        from ringpop_tpu import native
+
+        return native.ring_lookup_n_batch(tokens, owners, n_servers, hashes, nwant)
+    tokens32 = np.asarray(tokens, dtype=np.uint32)
+    owners32 = np.asarray(owners, dtype=np.uint32)
+    hashes32 = np.asarray(hashes, dtype=np.uint32)
+    nwant = max(nwant, 0)
+    out = np.full((hashes32.shape[0], nwant), -1, dtype=np.int32)
+    t = tokens32.shape[0]
+    if t == 0 or n_servers == 0 or nwant == 0:
+        return out
+    want = min(nwant, n_servers)
+    starts = np.searchsorted(tokens32, hashes32, side="left") % t
+    for k, start in enumerate(starts):
+        seen: set[int] = set()
+        for i in range(t):
+            owner = int(owners32[(start + i) % t])
+            if owner not in seen:
+                seen.add(owner)
+                out[k, len(seen) - 1] = owner
+                if len(seen) == want:
+                    break
+    return out
+
+
 def ring_tokens(servers: Sequence[str], replica_points: int) -> np.ndarray:
     """uint32[n_servers, replica_points] of farm32(addr + str(i)) — the
     hashring vnode tokens (parity: ``hashring.go:148-154``)."""
@@ -68,6 +116,8 @@ __all__ = [
     "fingerprint32",
     "fingerprint32_batch",
     "fingerprint32_many",
+    "membership_checksum",
     "pack_strings",
+    "ring_lookup_n_batch",
     "ring_tokens",
 ]

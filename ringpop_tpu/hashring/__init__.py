@@ -30,7 +30,12 @@ import numpy as np
 from ringpop_tpu import events as events_mod
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.events import EventEmitter, RingChangedEvent, RingChecksumEvent
-from ringpop_tpu.hashing import fingerprint32, fingerprint32_many, ring_tokens
+from ringpop_tpu.hashing import (
+    fingerprint32,
+    fingerprint32_many,
+    ring_lookup_n_batch,
+    ring_tokens,
+)
 
 
 class Configuration:
@@ -53,6 +58,8 @@ class HashRing:
         # (token << 32 | server_id) so equal tokens order by server id
         self._tokens = np.empty(0, dtype=np.uint64)
         self._owners = np.empty(0, dtype=np.int64)
+        self._tokens32 = np.empty(0, dtype=np.uint32)
+        self._owners32 = np.empty(0, dtype=np.uint32)
         self._server_list: list[str] = []  # index -> addr for _owners
         self._checksum = 0
         self.emitter = EventEmitter()
@@ -75,8 +82,14 @@ class HashRing:
             if self.hashfunc is fingerprint32:
                 toks = ring_tokens([server], self.replica_points)[0].astype(np.uint64)
             else:
+                # mask to 32 bits — the ring's token space (the same mask
+                # _hash_keys applies to key hashes; an unmasked 64-bit token
+                # array would truncate unsorted into the _tokens32 cache)
                 toks = np.array(
-                    [self.hashfunc(f"{server}{i}") for i in range(self.replica_points)],
+                    [
+                        self.hashfunc(f"{server}{i}") & 0xFFFFFFFF
+                        for i in range(self.replica_points)
+                    ],
                     dtype=np.uint64,
                 )
             self._server_tokens[server] = toks
@@ -89,6 +102,8 @@ class HashRing:
         if not servers:
             self._tokens = np.empty(0, dtype=np.uint64)
             self._owners = np.empty(0, dtype=np.int64)
+            self._tokens32 = np.empty(0, dtype=np.uint32)
+            self._owners32 = np.empty(0, dtype=np.uint32)
             return
         toks = np.concatenate([self._server_tokens[s] for s in servers])
         owners = np.repeat(np.arange(len(servers), dtype=np.int64), self.replica_points)
@@ -97,6 +112,18 @@ class HashRing:
         order = np.argsort(composite, kind="stable")
         self._tokens = toks[order]
         self._owners = owners[order]
+        # uint32 views cached once per rebuild for the batched native walks
+        self._tokens32 = np.ascontiguousarray(self._tokens, dtype=np.uint32)
+        self._owners32 = np.ascontiguousarray(self._owners, dtype=np.uint32)
+
+    def _hash_keys(self, keys: list[str]) -> np.ndarray:
+        """uint32 hashes of ``keys`` under this ring's hash function — batch
+        fast path for the default farm32, per-key call for a custom func."""
+        if self.hashfunc is fingerprint32:
+            return fingerprint32_many(keys)
+        return np.array(
+            [self.hashfunc(k) & 0xFFFFFFFF for k in keys], dtype=np.uint32
+        )
 
     def _compute_checksum(self) -> None:
         old = self._checksum
@@ -166,7 +193,7 @@ class HashRing:
         the device op (``ops/ring_ops.py`` ring_lookup_n) is tested against."""
         with self._lock:
             nservers = len(self._server_list)
-            if nservers == 0:
+            if nservers == 0 or n <= 0:
                 return []
             if n >= nservers:
                 # walk order from the key for determinism, all servers
@@ -184,13 +211,31 @@ class HashRing:
                         break
             return out
 
+    def lookup_n_batch(self, keys: list[str], n: int) -> list[list[str]]:
+        """Exact N-owner walk for many keys in one native call — the batched
+        preference-list path the replicator's fan-out uses (parity:
+        ``hashring.go:271-301``, batched).  Each row is ``lookup_n(key, n)``."""
+        with self._lock:
+            if not self._server_list or not keys or n <= 0:
+                return [[] for _ in keys]
+            rows = ring_lookup_n_batch(
+                self._tokens32,
+                self._owners32,
+                len(self._server_list),
+                self._hash_keys(keys),
+                n,
+            )
+            return [
+                [self._server_list[int(o)] for o in row if o >= 0] for row in rows
+            ]
+
     def lookup_batch(self, keys: list[str]) -> list[Optional[str]]:
         """Vectorized single-owner lookup for many keys at once — the batched
         fast path the rbtree could never offer."""
         with self._lock:
             if not self._server_list:
                 return [None] * len(keys)
-            hashes = fingerprint32_many(keys).astype(np.uint64)
+            hashes = self._hash_keys(keys).astype(np.uint64)
             idx = np.searchsorted(self._tokens, hashes, side="left")
             idx = np.where(idx == self._tokens.shape[0], 0, idx)
             owners = self._owners[idx]

@@ -76,9 +76,23 @@ def _try_load() -> Optional[ctypes.CDLL]:
         if not _build():
             return None
     try:
-        lib = ctypes.CDLL(_SO)
+        return _bind(ctypes.CDLL(_SO))
     except OSError:
         return None
+    except AttributeError:
+        # a cached .so from an older source revision can pass the mtime
+        # staleness check (same-second checkout, archive/copy tools that
+        # preserve mtimes) yet miss newer symbols — rebuild once, and fall
+        # back to the pure-Python backend rather than raise if that fails
+        if not _build():
+            return None
+        try:
+            return _bind(ctypes.CDLL(_SO))
+        except (OSError, AttributeError):
+            return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     u64p = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
     u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
@@ -88,6 +102,20 @@ def _try_load() -> Optional[ctypes.CDLL]:
     lib.rp_fingerprint32_batch.restype = None
     lib.rp_ring_tokens.argtypes = [u8p, u64p, ctypes.c_uint64, ctypes.c_uint32, u32p]
     lib.rp_ring_tokens.restype = None
+    i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    lib.rp_membership_checksum.argtypes = [u8p, u64p, ctypes.c_uint64]
+    lib.rp_membership_checksum.restype = ctypes.c_uint32
+    lib.rp_ring_lookup_n.argtypes = [
+        u32p,  # tokens (sorted)
+        u32p,  # owners
+        ctypes.c_uint64,  # ntokens
+        ctypes.c_uint32,  # n_servers
+        u32p,  # hashes
+        ctypes.c_uint64,  # nkeys
+        ctypes.c_uint32,  # nwant
+        i32p,  # out [nkeys, nwant]
+    ]
+    lib.rp_ring_lookup_n.restype = None
     return lib
 
 
@@ -129,3 +157,43 @@ def ring_tokens(servers: Sequence[str], replica_points: int) -> np.ndarray:
     if len(bs):
         lib.rp_ring_tokens(buf, offsets, len(bs), replica_points, out)
     return out.reshape(len(bs), replica_points)
+
+
+def membership_checksum(entries: Sequence[str | bytes]) -> int:
+    """farm32 over the canonical sorted-and-';'-joined member entries — one
+    native call replacing the host-side sort + join + hash (parity:
+    ``swim/memberlist.go:106-128``)."""
+    bs = [e.encode("utf-8") if isinstance(e, str) else e for e in entries]
+    lib = _load()
+    buf, offsets = _pack(bs)
+    return int(lib.rp_membership_checksum(buf, offsets, len(bs)))
+
+
+def ring_lookup_n_batch(
+    tokens: np.ndarray,
+    owners: np.ndarray,
+    n_servers: int,
+    hashes: np.ndarray,
+    nwant: int,
+) -> np.ndarray:
+    """Exact batched N-owner ring walk -> int32[nkeys, nwant] of server
+    indices, -1-padded when the ring has fewer than ``nwant`` servers
+    (parity: ``hashring.go:271-301``)."""
+    lib = _load()
+    nwant = max(nwant, 0)
+    tokens32 = np.ascontiguousarray(tokens, dtype=np.uint32)
+    owners32 = np.ascontiguousarray(owners, dtype=np.uint32)
+    hashes32 = np.ascontiguousarray(hashes, dtype=np.uint32)
+    out = np.empty((hashes32.shape[0], nwant), dtype=np.int32)
+    if hashes32.shape[0] and nwant:
+        lib.rp_ring_lookup_n(
+            tokens32,
+            owners32,
+            tokens32.shape[0],
+            n_servers,
+            hashes32,
+            hashes32.shape[0],
+            nwant,
+            out,
+        )
+    return out

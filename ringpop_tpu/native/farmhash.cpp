@@ -13,13 +13,21 @@
 //   rp_ring_tokens          — farm32(addr + decimal(i)) for every (server,
 //                             replica) pair: the hashring build hot path
 //                             (parity: hashring.go:148-154)
+//   rp_membership_checksum  — sort member entry strings, join with ';',
+//                             farm32 the canonical form: the membership
+//                             checksum hot path (parity: memberlist.go:106-128)
+//   rp_ring_lookup_n        — exact N-unique-owner ring walk for a batch of
+//                             key hashes (parity: hashring.go:271-301,
+//                             rbtree.go:262-288)
 //
 // Build: g++ -O3 -shared -fPIC -o _rpnative.so farmhash.cpp
 // (done lazily by ringpop_tpu/native/__init__.py)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <numeric>
 #include <vector>
 
 namespace {
@@ -178,6 +186,65 @@ void rp_ring_tokens(const uint8_t* buf, const uint64_t* offsets,
                             "%u", r);
       out[s * replica_points + r] = hash32(tmp.data(), len + d);
     }
+  }
+}
+
+// Membership checksum: entries are the unsorted per-member canonical strings
+// ("addr+status+incarnation", tombstones pre-filtered by the caller); this
+// sorts them lexicographically, joins each with a trailing ';', and returns
+// farm32 of the joined form — byte-identical to hashing the string that
+// memberlist.gen_checksum_string() builds (parity: memberlist.go:106-128).
+uint32_t rp_membership_checksum(const uint8_t* buf, const uint64_t* offsets,
+                                uint64_t n) {
+  std::vector<uint32_t> order(n);
+  std::iota(order.begin(), order.end(), 0u);
+  std::sort(order.begin(), order.end(), [&](uint32_t a, uint32_t b) {
+    uint64_t la = offsets[a + 1] - offsets[a];
+    uint64_t lb = offsets[b + 1] - offsets[b];
+    int c = std::memcmp(buf + offsets[a], buf + offsets[b],
+                        la < lb ? la : lb);
+    if (c != 0) return c < 0;
+    return la < lb;
+  });
+  uint64_t total = offsets[n] + n;  // all bytes + one ';' per entry
+  std::vector<uint8_t> joined;
+  joined.reserve(total);
+  for (uint64_t i = 0; i < n; i++) {
+    uint32_t e = order[i];
+    joined.insert(joined.end(), buf + offsets[e], buf + offsets[e + 1]);
+    joined.push_back(';');
+  }
+  return hash32(joined.data(), joined.size());
+}
+
+// Exact ring walk for a batch of precomputed key hashes: for each hash,
+// binary-search the first token >= hash, then walk forward (with wraparound)
+// collecting the first `nwant` distinct owners in ring order.  Owner indices
+// land in out[k * nwant + j]; rows are padded with -1 when the ring holds
+// fewer than nwant distinct servers.  A stamp array replaces a per-query
+// seen-set so the walk is allocation-free per key.
+void rp_ring_lookup_n(const uint32_t* tokens, const uint32_t* owners,
+                      uint64_t ntokens, uint32_t n_servers,
+                      const uint32_t* hashes, uint64_t nkeys, uint32_t nwant,
+                      int32_t* out) {
+  std::vector<uint64_t> stamp(n_servers, ~0ull);
+  for (uint64_t k = 0; k < nkeys; k++) {
+    int32_t* row = out + k * nwant;
+    uint32_t found = 0;
+    if (ntokens != 0 && n_servers != 0) {
+      const uint32_t* lb =
+          std::lower_bound(tokens, tokens + ntokens, hashes[k]);
+      uint64_t start = static_cast<uint64_t>(lb - tokens) % ntokens;
+      uint32_t want = nwant < n_servers ? nwant : n_servers;
+      for (uint64_t i = 0; i < ntokens && found < want; i++) {
+        uint32_t owner = owners[(start + i) % ntokens];
+        if (stamp[owner] != k) {
+          stamp[owner] = k;
+          row[found++] = static_cast<int32_t>(owner);
+        }
+      }
+    }
+    for (uint32_t j = found; j < nwant; j++) row[j] = -1;
   }
 }
 

@@ -15,7 +15,7 @@ from typing import Callable, Optional
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu import util
-from ringpop_tpu.hashing import fingerprint32
+from ringpop_tpu.hashing import membership_checksum
 from ringpop_tpu.swim import events as ev
 from ringpop_tpu.swim.member import (
     ALIVE,
@@ -80,20 +80,25 @@ class Memberlist:
 
     # -- checksum (parity: memberlist.go:83-128) ----------------------------
 
-    def gen_checksum_string(self) -> str:
-        """Exact reference canonical form: sorted ``addr+status+incarnation``
-        entries joined with ';' (trailing ';'), tombstones excluded to avoid
-        resurrecting them through full syncs."""
-        strs = sorted(
+    def _checksum_entries(self) -> list[str]:
+        """Unsorted per-member canonical entries ``addr+status+incarnation``,
+        tombstones excluded to avoid resurrecting them through full syncs."""
+        return [
             f"{m.address}{state_name(m.status)}{m.incarnation}"
             for m in self._members
             if m.status != TOMBSTONE
-        )
-        return "".join(s + ";" for s in strs)
+        ]
+
+    def gen_checksum_string(self) -> str:
+        """Exact reference canonical form: sorted entries joined with ';'
+        (trailing ';')."""
+        return "".join(s + ";" for s in sorted(self._checksum_entries()))
 
     def compute_checksum(self) -> int:
         old = self._checksum
-        self._checksum = fingerprint32(self.gen_checksum_string())
+        # one native sort+join+hash call over the per-member entries;
+        # bit-identical to fingerprint32(self.gen_checksum_string())
+        self._checksum = membership_checksum(self._checksum_entries())
         if self.node is not None:
             self.node.emit(
                 ev.ChecksumComputeEvent(checksum=self._checksum, old_checksum=old)

@@ -89,3 +89,137 @@ class TestDispatch:
             hashing.fingerprint32_many(strs),
             np.array([farm.fingerprint32(s) for s in strs], dtype=np.uint32),
         )
+
+
+class TestMembershipChecksum:
+    def test_matches_python_canonical_form(self):
+        rng = random.Random(5)
+        for n in (0, 1, 2, 7, 100):
+            entries = [
+                f"10.0.{rng.randint(0, 255)}.{rng.randint(0, 255)}:3000"
+                f"{rng.choice(['alive', 'suspect', 'faulty', 'leave'])}"
+                f"{rng.randint(1, 2**62)}"
+                for _ in range(n)
+            ]
+            expect = farm.fingerprint32("".join(s + ";" for s in sorted(entries)))
+            assert native.membership_checksum(entries) == expect, n
+
+    def test_sort_is_bytewise_and_prefix_aware(self):
+        # "a" < "a0" < "b": prefix entries must sort before their extensions
+        entries = ["b", "a0", "a", "a00"]
+        expect = farm.fingerprint32("".join(s + ";" for s in sorted(entries)))
+        assert native.membership_checksum(entries) == expect
+
+    def test_memberlist_uses_it(self):
+        # the memberlist checksum path and gen_checksum_string must agree
+        from ringpop_tpu.net.channel import LocalNetwork
+        from tests.swim_utils import make_node
+
+        node = make_node(LocalNetwork(), "10.0.0.1:3000")
+        ml = node.memberlist
+        for i in range(5):
+            ml.make_alive(f"10.0.0.{i + 2}:3000", 1000 + i)
+        assert ml.compute_checksum() == farm.fingerprint32(ml.gen_checksum_string())
+        node.destroy()
+
+
+class TestRingLookupNBatch:
+    def _ring(self, n_servers: int, rp: int):
+        from ringpop_tpu import hashring
+
+        ring = hashring.HashRing(replica_points=rp)
+        ring.add_remove_servers([f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_servers)], [])
+        return ring
+
+    def test_matches_host_walk(self):
+        rng = random.Random(6)
+        for n_servers, rp, nwant in [(1, 3, 1), (5, 3, 3), (16, 100, 4), (7, 1, 10)]:
+            ring = self._ring(n_servers, rp)
+            keys = _rand_strings(rng, 200, max_len=32)
+            got = ring.lookup_n_batch(keys, nwant)
+            for k, row in zip(keys, got):
+                assert row == ring.lookup_n(k, nwant), (n_servers, rp, nwant, k)
+
+    def test_empty_ring_and_empty_keys(self):
+        from ringpop_tpu import hashring
+
+        ring = hashring.HashRing(replica_points=3)
+        assert ring.lookup_n_batch(["k1", "k2"], 3) == [[], []]
+        ring.add_server("10.0.0.1:3000")
+        assert ring.lookup_n_batch([], 3) == []
+
+    def test_python_fallback_agrees(self, monkeypatch):
+        from ringpop_tpu import hashing
+
+        ring = self._ring(9, 7)
+        tokens, owners, servers = ring.token_arrays()
+        rng = random.Random(7)
+        keys = _rand_strings(rng, 100, max_len=24)
+        hashes = hashing.fingerprint32_many(keys)
+        nat = native.ring_lookup_n_batch(
+            tokens.astype(np.uint32), owners, len(servers), hashes, 3
+        )
+        monkeypatch.setattr(hashing, "_use_native", lambda: False)
+        py = hashing.ring_lookup_n_batch(
+            tokens.astype(np.uint32), owners, len(servers), hashes, 3
+        )
+        np.testing.assert_array_equal(nat, py)
+        for k, row in zip(keys, nat):
+            assert [servers[int(o)] for o in row if o >= 0] == ring.lookup_n(k, 3)
+
+    def test_custom_hashfunc_batch_agrees_with_walk(self):
+        # lookup_n_batch / lookup_batch must honor a non-default hash func
+        from ringpop_tpu import hashring
+
+        def crc_ish(s):
+            import zlib
+
+            return zlib.crc32(s.encode() if isinstance(s, str) else s)
+
+        ring = hashring.HashRing(hashfunc=crc_ish, replica_points=5)
+        ring.add_remove_servers([f"10.0.0.{i}:3000" for i in range(6)], [])
+        keys = [f"alpha-{i}" for i in range(50)]
+        got = ring.lookup_n_batch(keys, 2)
+        for k, row in zip(keys, got):
+            assert row == ring.lookup_n(k, 2), k
+        singles = ring.lookup_batch(keys)
+        for k, s in zip(keys, singles):
+            assert s == ring.lookup(k), k
+
+    def test_nwant_zero_consistent_everywhere(self):
+        from ringpop_tpu import hashing
+
+        ring = self._ring(4, 3)
+        tokens, owners, servers = ring.token_arrays()
+        hashes = np.array([1, 2**31, 2**32 - 1], dtype=np.uint32)
+        assert ring.lookup_n("k", 0) == []
+        assert ring.lookup_n_batch(["a", "b"], 0) == [[], []]
+        assert native.ring_lookup_n_batch(
+            tokens.astype(np.uint32), owners, len(servers), hashes, 0
+        ).shape == (3, 0)
+        import unittest.mock as mock
+
+        with mock.patch.object(hashing, "_use_native", lambda: False):
+            assert hashing.ring_lookup_n_batch(
+                tokens.astype(np.uint32), owners, len(servers), hashes, 0
+            ).shape == (3, 0)
+
+    def test_64bit_custom_hashfunc_tokens_masked(self):
+        # tokens from a >32-bit hash func must be masked into the 32-bit
+        # token space so the sorted uint32 cache stays sorted
+        from ringpop_tpu import hashring
+
+        def wide(s):
+            import hashlib
+
+            return int.from_bytes(hashlib.blake2b(
+                s.encode() if isinstance(s, str) else s, digest_size=8).digest(), "big")
+
+        ring = hashring.HashRing(hashfunc=wide, replica_points=9)
+        ring.add_remove_servers([f"10.1.0.{i}:3000" for i in range(7)], [])
+        tokens, _, _ = ring.token_arrays()
+        assert int(tokens.max()) <= 0xFFFFFFFF
+        assert (np.diff(tokens.astype(np.uint64)) >= 0).all()
+        keys = [f"k{i}" for i in range(40)]
+        for k, row in zip(keys, ring.lookup_n_batch(keys, 3)):
+            assert row == ring.lookup_n(k, 3), k
