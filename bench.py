@@ -12,26 +12,120 @@ dissemination axis) and batched ring lookup qps.
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...extras}
 
-The accelerator is probed in a subprocess first (a wedged axon tunnel HANGS
-jax device init rather than raising); on a dead probe the bench pins CPU and
-still runs the FULL 1M configs, recording the probe outcome and fallback
-reason in the JSON.  ``BENCH_FAST=1`` shrinks scales for CI smoke runs;
-``BENCH_PROFILE=dir`` captures a jax.profiler trace of the timed sections.
+The whole measurement runs in a CHILD subprocess so a dying accelerator
+cannot take the artifact with it: the parent probes the accelerator (a
+wedged axon tunnel HANGS jax device init rather than raising), launches the
+child on the live platform, and — if the child dies or stalls mid-run (the
+axon remote-compile service has been observed to drop AFTER a successful
+probe) — relaunches it pinned to CPU at the FULL 1M configs, recording the
+probe outcome and fallback reason in the JSON.  The driver always gets one
+JSON line, even if both attempts fail.  ``BENCH_FAST=1`` shrinks scales for
+CI smoke runs; ``BENCH_PROFILE=dir`` captures a jax.profiler trace of the
+timed sections.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 
 def main() -> None:
-    from ringpop_tpu.util.accel import ensure_live_backend
+    if os.environ.get("BENCH_CHILD"):
+        run_bench()
+        return
 
-    probe = ensure_live_backend()
+    from ringpop_tpu.util.accel import probe_accelerator
 
+    probe_timeouts = tuple(
+        float(t)
+        for t in os.environ.get("BENCH_PROBE_TIMEOUTS_S", "90,240").split(",")
+    )
+    probe = probe_accelerator(timeouts_s=probe_timeouts)
+    fallback_reason = None if probe["alive"] else probe["reason"]
+
+    attempt_plan = []
+    if probe["alive"]:
+        # inherit the environment's platform (axon/tpu); generous-but-bounded
+        # timeout so a mid-run wedge still leaves time for the CPU rerun
+        attempt_plan.append((None, float(os.environ.get("BENCH_ACCEL_TIMEOUT_S", "1500"))))
+    attempt_plan.append(("cpu", float(os.environ.get("BENCH_CPU_TIMEOUT_S", "2700"))))
+
+    failures = []
+    for platform_pin, timeout_s in attempt_plan:
+        env = dict(os.environ, BENCH_CHILD="1")
+        if platform_pin:
+            # BENCH_PIN makes the child call jax.config.update("jax_platforms")
+            # — the env var alone is NOT enough: this environment's axon site
+            # hook can init the axon client regardless of JAX_PLATFORMS, and
+            # hangs doing so when the TPU tunnel is down
+            env["JAX_PLATFORMS"] = platform_pin
+            env["BENCH_PIN"] = platform_pin
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            failures.append(f"{platform_pin or 'accel'}: timeout after {timeout_s:.0f}s")
+            continue
+        if r.stderr:
+            sys.stderr.write(r.stderr)
+        line = next(
+            (ln for ln in reversed(r.stdout.strip().splitlines()) if ln.startswith("{")),
+            None,
+        )
+        if r.returncode == 0 and line:
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError as e:
+                failures.append(f"{platform_pin or 'accel'}: bad child output: {e}")
+                continue
+            result["probe"] = probe
+            result["fallback_reason"] = (
+                fallback_reason
+                if result.get("platform") == "cpu" and probe["alive"] is False
+                else (failures[-1] if failures else fallback_reason)
+            )
+            print(json.dumps(result))
+            return
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        failures.append(
+            f"{platform_pin or 'accel'}: rc={r.returncode} {' | '.join(tail)[-300:]}"
+        )
+
+    # both attempts failed — still emit one diagnostic JSON line
+    print(
+        json.dumps(
+            {
+                "metric": "swim_lifecycle_detect",
+                "value": None,
+                "unit": "s",
+                "vs_baseline": 0.0,
+                "ok": False,
+                "probe": probe,
+                "failures": failures,
+            }
+        )
+    )
+
+
+def run_bench() -> None:
     import jax
+
+    pin = os.environ.get("BENCH_PIN")
+    if pin:
+        try:
+            jax.config.update("jax_platforms", pin)
+        except RuntimeError:
+            pass  # backend already initialized
+
     import numpy as np
 
     # persistent XLA compilation cache: the 1M-node lifecycle step is a big
@@ -161,7 +255,6 @@ def main() -> None:
         "delta_compile_s": round(delta_compile_s, 2),
         "ring_lookup_qps": round(ring_qps, 0),
         "platform": platform,
-        "probe": probe,
     }
     print(json.dumps(result))
 

@@ -380,7 +380,11 @@ def main(argv=None) -> int:
     results = []
     for name in names:
         t0 = time.perf_counter()
-        result = BENCHES[name](args.seed, full)
+        try:
+            result = BENCHES[name](args.seed, full)
+        except Exception as e:  # a dying accelerator mid-scenario must not
+            # take the remaining scenarios (host-plane ones need no jax)
+            result = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
         result.setdefault("bench", name)
         result["platform"] = platform
         result["full_scale"] = full

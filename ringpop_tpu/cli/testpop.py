@@ -37,6 +37,13 @@ def parse_args(argv=None):
     p.add_argument("--faulty-period", type=float, default=0.0, help="seconds (0=default 24h)")
     p.add_argument("--tombstone-period", type=float, default=0.0, help="seconds (0=default 60s)")
     p.add_argument("--join-timeout", type=float, default=0.0, help="seconds per join attempt")
+    p.add_argument(
+        "--wire",
+        choices=["json", "msgpack"],
+        default=None,
+        help="frame codec to SEND (receivers auto-detect; default json or "
+        "$RINGPOP_TPU_WIRE)",
+    )
     return p.parse_args(argv)
 
 
@@ -52,7 +59,7 @@ async def amain(args) -> int:
         stats = UDPStatsd(args.stats_udp)
 
     host, port = args.listen.rsplit(":", 1)
-    channel = TCPChannel(app=args.app)
+    channel = TCPChannel(app=args.app, codec=args.wire)
     await channel.listen(host, int(port))
     print(f"testpop listening on {channel.hostport}", flush=True)
 

@@ -22,6 +22,7 @@ server) order, deterministically.
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Callable, Iterable, Optional
 
@@ -60,6 +61,8 @@ class HashRing:
         self._owners = np.empty(0, dtype=np.int64)
         self._tokens32 = np.empty(0, dtype=np.uint32)
         self._owners32 = np.empty(0, dtype=np.uint32)
+        self._tokens_list: list[int] = []
+        self._owners_list: list[int] = []
         self._server_list: list[str] = []  # index -> addr for _owners
         self._checksum = 0
         self.emitter = EventEmitter()
@@ -104,6 +107,8 @@ class HashRing:
             self._owners = np.empty(0, dtype=np.int64)
             self._tokens32 = np.empty(0, dtype=np.uint32)
             self._owners32 = np.empty(0, dtype=np.uint32)
+            self._tokens_list = []
+            self._owners_list = []
             return
         toks = np.concatenate([self._server_tokens[s] for s in servers])
         owners = np.repeat(np.arange(len(servers), dtype=np.int64), self.replica_points)
@@ -112,9 +117,13 @@ class HashRing:
         order = np.argsort(composite, kind="stable")
         self._tokens = toks[order]
         self._owners = owners[order]
-        # uint32 views cached once per rebuild for the batched native walks
+        # uint32 views cached once per rebuild for the batched native walks,
+        # plus plain-int lists for the bisect single-key fast path (python
+        # ints compare ~30x faster than numpy scalars under bisect)
         self._tokens32 = np.ascontiguousarray(self._tokens, dtype=np.uint32)
         self._owners32 = np.ascontiguousarray(self._owners, dtype=np.uint32)
+        self._tokens_list = self._tokens.tolist()
+        self._owners_list = self._owners.tolist()
 
     def _hash_keys(self, keys: list[str]) -> np.ndarray:
         """uint32 hashes of ``keys`` under this ring's hash function — batch
@@ -195,6 +204,15 @@ class HashRing:
             nservers = len(self._server_list)
             if nservers == 0 or n <= 0:
                 return []
+            if n == 1:
+                # single-owner fast path: the first token >= h owns the key,
+                # no uniqueness walk needed (the app data-path hot call,
+                # SURVEY §3.4)
+                toks = self._tokens_list
+                idx = bisect.bisect_left(toks, h)
+                if idx == len(toks):
+                    idx = 0
+                return [self._server_list[self._owners_list[idx]]]
             if n >= nservers:
                 # walk order from the key for determinism, all servers
                 n = nservers
@@ -218,6 +236,8 @@ class HashRing:
         with self._lock:
             if not self._server_list or not keys or n <= 0:
                 return [[] for _ in keys]
+            # clamp like lookup_n does — the output buffer is [nkeys, n]
+            n = min(n, len(self._server_list))
             rows = ring_lookup_n_batch(
                 self._tokens32,
                 self._owners32,

@@ -287,103 +287,43 @@ class Ringpop(Interface):
     # -- event -> stats + ring sync (parity: ringpop.go:385-563) ------------
 
     def handle_event(self, event) -> None:
-        e = event
-        if isinstance(e, swim_ev.MemberlistChangesReceivedEvent):
-            self.stat_incr("changes.apply", len(e.changes))
-        elif isinstance(e, swim_ev.MemberlistChangesAppliedEvent):
-            self.stat_incr("changes.apply", 0)  # applied count below
-            self.stat_gauge("num-members", e.num_members)
-            self.stat_incr("membership-set.alive", 0)
-            for change in e.changes:
-                self.stat_incr(f"membership-update.{state_name(change.status)}")
-            self.stat_gauge("checksum", e.new_checksum)
-            self.stat_incr("membership.checksum-computed")
-            self._handle_changes(e.changes)
-        elif isinstance(e, swim_ev.FullSyncEvent):
-            self.stat_incr("full-sync")
-        elif isinstance(e, swim_ev.StartReverseFullSyncEvent):
-            self.stat_incr("full-sync.reverse")
-        elif isinstance(e, swim_ev.OmitReverseFullSyncEvent):
-            self.stat_incr("full-sync.reverse.omitted")
-        elif isinstance(e, swim_ev.MaxPAdjustedEvent):
-            self.stat_gauge("max-piggyback", e.new_pcount)
-        elif isinstance(e, swim_ev.JoinReceiveEvent):
-            self.stat_incr("join.recv")
-        elif isinstance(e, swim_ev.JoinCompleteEvent):
-            self.stat_incr("join.complete")
-            self.stat_timing("join", e.duration)
-            self.stat_incr("join.succeeded")
-        elif isinstance(e, swim_ev.JoinFailedEvent):
-            self.stat_incr("join.failed")
-        elif isinstance(e, swim_ev.JoinTriesUpdateEvent):
-            self.stat_gauge("join.retries", e.retries)
-        elif isinstance(e, swim_ev.PingSendEvent):
-            self.stat_incr("ping.send")
-        elif isinstance(e, swim_ev.PingSendCompleteEvent):
-            self.stat_timing("ping", e.duration)
-        elif isinstance(e, swim_ev.PingReceiveEvent):
-            self.stat_incr("ping.recv")
-        elif isinstance(e, swim_ev.PingRequestsSendEvent):
-            self.stat_incr("ping-req.send", len(e.peers))
-        elif isinstance(e, swim_ev.PingRequestsSendCompleteEvent):
-            self.stat_timing("ping-req", e.duration)
-        elif isinstance(e, swim_ev.PingRequestSendErrorEvent):
-            self.stat_incr("ping-req.err")
-        elif isinstance(e, swim_ev.PingRequestReceiveEvent):
-            self.stat_incr("ping-req.recv")
-        elif isinstance(e, swim_ev.PingRequestPingEvent):
-            self.stat_timing("ping-req.ping", e.duration)
-        elif isinstance(e, swim_ev.ProtocolDelayComputeEvent):
-            self.stat_timing("protocol.delay", e.duration)
-        elif isinstance(e, swim_ev.ProtocolFrequencyEvent):
-            self.stat_timing("protocol.frequency", e.duration)
-        elif isinstance(e, swim_ev.ChecksumComputeEvent):
-            self.stat_timing("compute-checksum", e.duration)
-            self.stat_gauge("checksum", e.checksum)
-        elif isinstance(e, swim_ev.ChangesCalculatedEvent):
-            self.stat_gauge("changes.disseminate", len(e.changes))
-        elif isinstance(e, swim_ev.ChangeFilteredEvent):
-            self.stat_incr("filtered-change")
-        elif isinstance(e, swim_ev.RefuteUpdateEvent):
-            self.stat_incr("refuted-update")
-        elif isinstance(e, swim_ev.RequestBeforeReadyEvent):
-            self.stat_incr("not-ready.ping" if "ping" in e.endpoint else "not-ready.ping-req")
-        elif isinstance(e, swim_ev.DiscoHealEvent):
-            self.stat_incr("heal.triggered")
-        elif isinstance(e, swim_ev.AttemptHealEvent):
-            self.stat_incr("heal.attempt")
-        elif isinstance(e, facade_ev.RingChecksumEvent):
-            self.stat_incr("ring.checksum-computed")
-        elif isinstance(e, facade_ev.RingChangedEvent):
-            self.stat_incr("ring.changed")
-            for _ in e.servers_added:
-                self.stat_incr("ring.server-added")
-            for _ in e.servers_removed:
-                self.stat_incr("ring.server-removed")
-        elif isinstance(e, fwd_ev.RequestForwardedEvent):
-            self.stat_incr("requestProxy.egress")
-        elif isinstance(e, fwd_ev.InflightRequestsChangedEvent):
-            self.stat_gauge("requestProxy.inflight", e.inflight)
-        elif isinstance(e, fwd_ev.InflightRequestsMiscountEvent):
-            self.stat_incr(f"requestProxy.miscount.{e.operation}")
-        elif isinstance(e, fwd_ev.SuccessEvent):
-            self.stat_incr("requestProxy.send.success")
-        elif isinstance(e, fwd_ev.FailedEvent):
-            self.stat_incr("requestProxy.send.error")
-        elif isinstance(e, fwd_ev.MaxRetriesEvent):
-            self.stat_incr("requestProxy.retry.failed")
-        elif isinstance(e, fwd_ev.RetryAttemptEvent):
-            self.stat_incr("requestProxy.retry.attempted")
-        elif isinstance(e, fwd_ev.RetryAbortEvent):
-            self.stat_incr("requestProxy.retry.aborted")
-        elif isinstance(e, fwd_ev.RetrySuccessEvent):
-            self.stat_incr("requestProxy.retry.succeeded")
-        elif isinstance(e, fwd_ev.RerouteEvent):
-            self.stat_incr("requestProxy.retry.reroute.remote")
+        # dict dispatch on the exact event type (the events are flat
+        # dataclasses, never subclassed) — the reference's 60-stat switch
+        # (ringpop.go:385-548) as one table lookup instead of ~40 isinstance
+        # probes per event; this runs 3-4x per forwarded request
+        fn = _EVENT_STATS.get(type(event))
+        if fn is not None:
+            fn(self, event)
 
         # relay everything to facade listeners (async dispatch in the
         # reference, ringpop.go:297-301; synchronous relay here)
-        self.emitter.emit(e)
+        self.emitter.emit(event)
+
+    def _on_changes_applied(self, e) -> None:
+        self.stat_incr("changes.apply", 0)  # applied count below
+        self.stat_gauge("num-members", e.num_members)
+        self.stat_incr("membership-set.alive", 0)
+        for change in e.changes:
+            self.stat_incr(f"membership-update.{state_name(change.status)}")
+        self.stat_gauge("checksum", e.new_checksum)
+        self.stat_incr("membership.checksum-computed")
+        self._handle_changes(e.changes)
+
+    def _on_join_complete(self, e) -> None:
+        self.stat_incr("join.complete")
+        self.stat_timing("join", e.duration)
+        self.stat_incr("join.succeeded")
+
+    def _on_checksum_computed(self, e) -> None:
+        self.stat_timing("compute-checksum", e.duration)
+        self.stat_gauge("checksum", e.checksum)
+
+    def _on_ring_changed(self, e) -> None:
+        self.stat_incr("ring.changed")
+        for _ in e.servers_added:
+            self.stat_incr("ring.server-added")
+        for _ in e.servers_removed:
+            self.stat_incr("ring.server-removed")
 
     def _handle_changes(self, changes) -> None:
         """Membership → ring sync (parity: ``ringpop.go:550-563``)."""
@@ -446,3 +386,50 @@ def new(app: str, channel, options: Optional[Options] = None, **kw) -> Ringpop:
     if options is None and kw:
         options = Options(**kw)
     return Ringpop(app, channel, options)
+
+
+# event type -> stats action for Ringpop.handle_event (parity with the
+# reference's per-event switch, ringpop.go:385-548)
+_EVENT_STATS = {
+    swim_ev.MemberlistChangesReceivedEvent: lambda rp, e: rp.stat_incr("changes.apply", len(e.changes)),
+    swim_ev.MemberlistChangesAppliedEvent: Ringpop._on_changes_applied,
+    swim_ev.FullSyncEvent: lambda rp, e: rp.stat_incr("full-sync"),
+    swim_ev.StartReverseFullSyncEvent: lambda rp, e: rp.stat_incr("full-sync.reverse"),
+    swim_ev.OmitReverseFullSyncEvent: lambda rp, e: rp.stat_incr("full-sync.reverse.omitted"),
+    swim_ev.MaxPAdjustedEvent: lambda rp, e: rp.stat_gauge("max-piggyback", e.new_pcount),
+    swim_ev.JoinReceiveEvent: lambda rp, e: rp.stat_incr("join.recv"),
+    swim_ev.JoinCompleteEvent: Ringpop._on_join_complete,
+    swim_ev.JoinFailedEvent: lambda rp, e: rp.stat_incr("join.failed"),
+    swim_ev.JoinTriesUpdateEvent: lambda rp, e: rp.stat_gauge("join.retries", e.retries),
+    swim_ev.PingSendEvent: lambda rp, e: rp.stat_incr("ping.send"),
+    swim_ev.PingSendCompleteEvent: lambda rp, e: rp.stat_timing("ping", e.duration),
+    swim_ev.PingReceiveEvent: lambda rp, e: rp.stat_incr("ping.recv"),
+    swim_ev.PingRequestsSendEvent: lambda rp, e: rp.stat_incr("ping-req.send", len(e.peers)),
+    swim_ev.PingRequestsSendCompleteEvent: lambda rp, e: rp.stat_timing("ping-req", e.duration),
+    swim_ev.PingRequestSendErrorEvent: lambda rp, e: rp.stat_incr("ping-req.err"),
+    swim_ev.PingRequestReceiveEvent: lambda rp, e: rp.stat_incr("ping-req.recv"),
+    swim_ev.PingRequestPingEvent: lambda rp, e: rp.stat_timing("ping-req.ping", e.duration),
+    swim_ev.ProtocolDelayComputeEvent: lambda rp, e: rp.stat_timing("protocol.delay", e.duration),
+    swim_ev.ProtocolFrequencyEvent: lambda rp, e: rp.stat_timing("protocol.frequency", e.duration),
+    swim_ev.ChecksumComputeEvent: Ringpop._on_checksum_computed,
+    swim_ev.ChangesCalculatedEvent: lambda rp, e: rp.stat_gauge("changes.disseminate", len(e.changes)),
+    swim_ev.ChangeFilteredEvent: lambda rp, e: rp.stat_incr("filtered-change"),
+    swim_ev.RefuteUpdateEvent: lambda rp, e: rp.stat_incr("refuted-update"),
+    swim_ev.RequestBeforeReadyEvent: lambda rp, e: rp.stat_incr(
+        "not-ready.ping" if "ping" in e.endpoint else "not-ready.ping-req"
+    ),
+    swim_ev.DiscoHealEvent: lambda rp, e: rp.stat_incr("heal.triggered"),
+    swim_ev.AttemptHealEvent: lambda rp, e: rp.stat_incr("heal.attempt"),
+    facade_ev.RingChecksumEvent: lambda rp, e: rp.stat_incr("ring.checksum-computed"),
+    facade_ev.RingChangedEvent: Ringpop._on_ring_changed,
+    fwd_ev.RequestForwardedEvent: lambda rp, e: rp.stat_incr("requestProxy.egress"),
+    fwd_ev.InflightRequestsChangedEvent: lambda rp, e: rp.stat_gauge("requestProxy.inflight", e.inflight),
+    fwd_ev.InflightRequestsMiscountEvent: lambda rp, e: rp.stat_incr(f"requestProxy.miscount.{e.operation}"),
+    fwd_ev.SuccessEvent: lambda rp, e: rp.stat_incr("requestProxy.send.success"),
+    fwd_ev.FailedEvent: lambda rp, e: rp.stat_incr("requestProxy.send.error"),
+    fwd_ev.MaxRetriesEvent: lambda rp, e: rp.stat_incr("requestProxy.retry.failed"),
+    fwd_ev.RetryAttemptEvent: lambda rp, e: rp.stat_incr("requestProxy.retry.attempted"),
+    fwd_ev.RetryAbortEvent: lambda rp, e: rp.stat_incr("requestProxy.retry.aborted"),
+    fwd_ev.RetrySuccessEvent: lambda rp, e: rp.stat_incr("requestProxy.retry.succeeded"),
+    fwd_ev.RerouteEvent: lambda rp, e: rp.stat_incr("requestProxy.retry.reroute.remote"),
+}

@@ -184,3 +184,156 @@ def test_tcp_two_node_swim_cluster():
             await ch.close()
 
     run(main())
+
+
+def test_tcp_msgpack_codec_rpc_and_interop():
+    """msgpack frames work end-to-end, and mixed-codec peers interoperate
+    (each side sends its codec; readers auto-detect per frame)."""
+
+    async def main():
+        server = TCPChannel(app="t", codec="msgpack")
+        await server.listen()
+
+        async def echo(body, headers):
+            return {"echo": body, "headers": headers}
+
+        server.register("svc", "/echo", echo)
+
+        mp_client = TCPChannel(app="t", codec="msgpack")
+        res = await mp_client.call(
+            server.hostport, "svc", "/echo", {"x": 1, "s": "é", "b": [1, 2]},
+            headers={"h": "v"}, timeout=2.0,
+        )
+        assert res == {"echo": {"x": 1, "s": "é", "b": [1, 2]}, "headers": {"h": "v"}}
+
+        # json client -> msgpack server: request is a JSON line, response
+        # comes back msgpack-framed; both ends auto-detect
+        json_client = TCPChannel(app="t", codec="json")
+        res = await json_client.call(
+            server.hostport, "svc", "/echo", {"y": 2}, timeout=2.0
+        )
+        assert res["echo"] == {"y": 2}
+
+        # msgpack client -> json server
+        json_server = TCPChannel(app="t", codec="json")
+        await json_server.listen()
+        json_server.register("svc", "/echo", echo)
+        res = await mp_client.call(json_server.hostport, "svc", "/echo", {"z": 3}, timeout=2.0)
+        assert res["echo"] == {"z": 3}
+
+        # remote handler errors still surface through msgpack framing
+        with pytest.raises(CallError, match="no handler"):
+            await mp_client.call(server.hostport, "svc", "/nope", {}, timeout=2.0)
+
+        for ch in (server, json_server, mp_client, json_client):
+            await ch.close()
+
+    run(main())
+
+
+def test_tcp_msgpack_swim_cluster_converges():
+    """A SWIM cluster whose every channel speaks msgpack converges — the
+    whole protocol payload schema round-trips through the binary codec."""
+
+    async def main():
+        channels = [TCPChannel(app="mp-test", codec="msgpack") for _ in range(3)]
+        for ch in channels:
+            await ch.listen()
+        nodes = [
+            Node("mp-test", ch.hostport, ch, NodeOptions(clock=MockClock(1e6), seed=i))
+            for i, ch in enumerate(channels)
+        ]
+        hosts = [n.address for n in nodes]
+
+        async def boot(node):
+            await node.bootstrap(BootstrapOptions(discover_provider=hosts, join_timeout=2.0))
+            node.gossip.stop()
+            node.healer.stop()
+
+        await asyncio.gather(*(boot(n) for n in nodes))
+        for _ in range(8):
+            for n in nodes:
+                await n.gossip.protocol_period()
+        assert len({n.memberlist.checksum() for n in nodes}) == 1
+        for n in nodes:
+            assert n.memberlist.count_reachable_members() == 3
+        for n in nodes:
+            n.destroy()
+        for ch in channels:
+            await ch.close()
+
+    run(main())
+
+
+def test_tcp_msgpack_unencodable_error_still_answers():
+    """A handler error whose message carries surrogateescape bytes (the case
+    JSON's ensure_ascii handles) must not hang a msgpack-codec caller: the
+    server falls back to a JSON error frame instead of dropping the reply."""
+
+    async def main():
+        server = TCPChannel(app="t", codec="msgpack")
+        await server.listen()
+
+        async def bad(body, headers):
+            raise OSError("bad path: " + b"caf\xe9".decode("utf-8", "surrogateescape"))
+
+        server.register("svc", "/bad", bad)
+        client = TCPChannel(app="t", codec="msgpack")
+        with pytest.raises(CallError):
+            await client.call(server.hostport, "svc", "/bad", {}, timeout=2.0)
+        # and the connection survives for the next (well-formed) call
+        server.register("svc", "/ok", lambda b, h: {"ok": True})
+        res = await client.call(server.hostport, "svc", "/ok", {}, timeout=2.0)
+        assert res == {"ok": True}
+        await server.close()
+        await client.close()
+
+    run(main())
+
+
+def test_tcp_reader_survives_garbage_frames():
+    """Scalar msgpack payloads, oversized length prefixes, and empty JSON
+    frames must not crash the reader: garbage breaks only its own
+    connection, and '{}' gets a normal 'no handler' error reply."""
+    import struct
+
+    async def main():
+        server = TCPChannel(app="t")
+        await server.listen()
+        server.register("svc", "/ok", lambda b, h: {"ok": True})
+        host, port = server.hostport.rsplit(":", 1)
+
+        # msgpack frame that unpacks to a scalar -> clean connection drop
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write(b"\xc1" + struct.pack(">I", 1) + b"\x05")
+        await w.drain()
+        assert await r.read(64) == b""  # server closed, no crash
+        w.close()
+
+        # oversized length prefix -> clean drop, nothing buffered
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write(b"\xc1" + struct.pack(">I", 0xFFFFFFFF))
+        await w.drain()
+        w.write_eof()
+        assert await r.read(64) == b""
+        w.close()
+
+        # a bare '{}' JSON frame is a real (malformed) request: it must get
+        # an error REPLY, not be silently swallowed
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write(b"{}\n")
+        await w.drain()
+        line = await asyncio.wait_for(r.readline(), timeout=2.0)
+        import json as _json
+
+        res = _json.loads(line)
+        assert res["ok"] is False and "no handler" in res["err"]
+        w.close()
+
+        # server still healthy for real clients
+        client = TCPChannel(app="t")
+        assert await client.call(server.hostport, "svc", "/ok", {}, timeout=2.0) == {"ok": True}
+        await server.close()
+        await client.close()
+
+    run(main())
