@@ -89,6 +89,16 @@ class LookupNEvent:
 
 
 @dataclass
+class LookupNBatchEvent:
+    """One batched preference-list computation (``lookup_n_batch``):
+    ``duration`` covers the whole batch of ``n_keys`` keys."""
+
+    n_keys: int = 0
+    n: int = 0
+    duration: float = 0.0
+
+
+@dataclass
 class Ready:
     pass
 

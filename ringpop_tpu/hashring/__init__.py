@@ -209,6 +209,8 @@ class HashRing:
                 # no uniqueness walk needed (the app data-path hot call,
                 # SURVEY §3.4)
                 toks = self._tokens_list
+                if not toks:  # servers with replica_points=0 -> no tokens
+                    return []
                 idx = bisect.bisect_left(toks, h)
                 if idx == len(toks):
                     idx = 0
@@ -253,7 +255,7 @@ class HashRing:
         """Vectorized single-owner lookup for many keys at once — the batched
         fast path the rbtree could never offer."""
         with self._lock:
-            if not self._server_list:
+            if not self._server_list or not self._tokens.shape[0]:
                 return [None] * len(keys)
             hashes = self._hash_keys(keys).astype(np.uint64)
             idx = np.searchsorted(self._tokens, hashes, side="left")

@@ -50,7 +50,12 @@ _encode_frame = json.JSONEncoder(separators=(",", ":")).encode
 
 
 def _frame_bytes(frame: dict) -> bytes:
-    return _encode_frame(frame).encode("ascii") + b"\n"
+    data = _encode_frame(frame).encode("ascii") + b"\n"
+    if len(data) > MAX_FRAME_BYTES:
+        # fail fast at the SENDER with the actual cause — the receiver would
+        # otherwise just drop the connection with a generic close
+        raise ValueError(f"frame of {len(data)} bytes exceeds MAX_FRAME_BYTES")
+    return data
 
 
 # -- wire codecs -------------------------------------------------------------
@@ -79,6 +84,8 @@ except ImportError:  # pragma: no cover - baked into this image, but optional
 
 def _msgpack_frame_bytes(frame: dict) -> bytes:
     payload = _msgpack.packb(frame, use_bin_type=True)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES")
     return _MSGPACK_MAGIC + len(payload).to_bytes(4, "big") + payload
 
 
@@ -304,9 +311,14 @@ class TCPChannel(BaseChannel):
         except Exception as e:
             # an unencodable handler result (or error string with surrogate
             # bytes under msgpack) must still produce a response — the JSON
-            # encoder with ensure_ascii handles any str; never hang the caller
+            # encoder with ensure_ascii handles any str; never hang the caller.
+            # The id itself may be the unencodable part (a msgpack peer can
+            # send bytes ids): only pass through JSON-safe ids.
+            rid = res.get("id")
+            if not isinstance(rid, (str, int, float)):
+                rid = None
             payload = _frame_bytes(
-                {"id": res.get("id"), "kind": "res", "ok": False,
+                {"id": rid, "kind": "res", "ok": False,
                  "err": f"response encode failed: {type(e).__name__}"}
             )
         try:

@@ -102,8 +102,13 @@ class Replicator:
         (parity: ``replicator.go:170-191`` groupReplicas)."""
         keys_by_dest: dict[str, list[str]] = {}
         dests: list[str] = []
-        for key in keys:
-            for dest in self.sender.lookup_n(key, n):
+        batch = getattr(self.sender, "lookup_n_batch", None)
+        if batch is not None and len(keys) > 1:
+            rows = batch(keys, n)  # one native ring walk for all keys
+        else:
+            rows = [self.sender.lookup_n(key, n) for key in keys]
+        for key, row in zip(keys, rows):
+            for dest in row:
                 if dest not in keys_by_dest:
                     dests.append(dest)
                 keys_by_dest.setdefault(dest, []).append(key)

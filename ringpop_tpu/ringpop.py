@@ -228,6 +228,20 @@ class Ringpop(Interface):
         self.emitter.emit(facade_ev.LookupNEvent(key, n, duration))
         return dests
 
+    def lookup_n_batch(self, keys: list[str], n: int) -> list[list[str]]:
+        """Preference lists for many keys in one native ring walk — the
+        batched path the replicator's multi-key fan-out uses."""
+        if not self.ready():
+            raise NotBootstrappedError()
+        t0 = _time.perf_counter()
+        rows = self.ring.lookup_n_batch(keys, n)
+        duration = _time.perf_counter() - t0
+        # distinct stat: this sample covers the whole batch — mixing it into
+        # the per-key "lookupn" timer would corrupt that distribution
+        self.stat_timing("lookupn-batch", duration)
+        self.emitter.emit(facade_ev.LookupNBatchEvent(len(keys), n, duration))
+        return rows
+
     # -- keyed routing (parity: ringpop.go:687-723) -------------------------
 
     async def handle_or_forward(

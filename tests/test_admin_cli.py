@@ -1,0 +1,101 @@
+"""ringpop-admin CLI against a live TCP cluster.
+
+The reference's admin surface is driven by external tooling over the wire
+(``swim/handlers.go:63-82``); these tests exercise ours the same way — the
+CLI builds its own channel and talks to real listening nodes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ringpop_tpu.net import TCPChannel
+from ringpop_tpu.ringpop import Ringpop
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _cli(argv) -> tuple[int, list[dict]]:
+    """Run the CLI main() in a worker thread (it owns its own event loop),
+    capturing its stdout JSON lines."""
+    import contextlib
+    import io
+
+    from ringpop_tpu.cli import admin
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = admin.main(argv)
+    lines = [json.loads(ln) for ln in buf.getvalue().splitlines() if ln.strip()]
+    return rc, lines
+
+
+def test_admin_cli_commands():
+    async def main():
+        chans = [TCPChannel(app="admin-test") for _ in range(2)]
+        for ch in chans:
+            await ch.listen()
+        rps = [Ringpop("admin-test", ch) for ch in chans]
+        hosts = [ch.hostport for ch in chans]
+        await asyncio.gather(*(rp.bootstrap(discover_provider=hosts) for rp in rps))
+        target = hosts[0]
+
+        def drive():
+            rc, out = _cli(["health", target])
+            assert rc == 0 and out[0]["ok"] is True
+
+            rc, out = _cli(["status", target])
+            assert rc == 0
+            assert out[0]["state"] == "ready"
+            assert len(out[0]["membership"]["members"]) == 2
+
+            rc, out = _cli(["members", target])
+            assert rc == 0
+            addrs = {row["address"] for row in out[:-1]}
+            assert addrs == set(hosts)
+            assert out[-1]["checksum"] == rps[0].node.memberlist.checksum()
+
+            rc, out = _cli(["lookup", target, "some-key"])
+            assert rc == 0 and out[0]["dest"] in hosts
+
+            rc, out = _cli(["gossip", target, "tick"])
+            assert rc == 0
+
+            rc, out = _cli(["reap", target])
+            assert rc == 0
+
+            # unreachable target -> rc 1 + structured error
+            rc, out = _cli(["--timeout", "0.5", "health", "127.0.0.1:1"])
+            assert rc == 1 and out[0]["ok"] is False
+
+        # the CLI runs its own event loop; give it a worker thread while
+        # this loop keeps serving the nodes
+        await asyncio.to_thread(drive)
+
+        for rp in rps:
+            rp.destroy()
+        for ch in chans:
+            await ch.close()
+
+    run(main())
+
+
+def test_admin_cli_msgpack_wire():
+    async def main():
+        ch = TCPChannel(app="admin-test", codec="msgpack")
+        await ch.listen()
+        rp = Ringpop("admin-test", ch)
+        await rp.bootstrap(discover_provider=[ch.hostport])
+
+        def drive():
+            rc, out = _cli(["--wire", "msgpack", "health", ch.hostport])
+            assert rc == 0 and out[0]["ok"] is True
+
+        await asyncio.to_thread(drive)
+        rp.destroy()
+        await ch.close()
+
+    run(main())

@@ -126,3 +126,18 @@ def test_token_arrays_snapshot():
     assert toks.shape == owners.shape == (40,)
     assert list(toks) == sorted(toks)
     assert len(slist) == 4
+
+
+def test_zero_replica_points_lookup_paths():
+    """replica_points=0 leaves a server set with no tokens: every lookup
+    flavor must return empty/None, not crash (regression: the n==1 bisect
+    fast path indexed into the empty owner list)."""
+    from ringpop_tpu.hashring import HashRing
+
+    ring = HashRing(replica_points=0)
+    ring.add_server("10.0.0.1:3000")
+    assert ring.lookup("k") is None
+    assert ring.lookup_n("k", 1) == []
+    assert ring.lookup_n("k", 3) == []
+    assert ring.lookup_n_batch(["k"], 2) == [[]]
+    assert ring.lookup_batch(["k", "k2"]) == [None, None]

@@ -337,3 +337,29 @@ def test_tcp_reader_survives_garbage_frames():
         await client.close()
 
     run(main())
+
+
+def test_tcp_oversized_frame_fails_fast_at_sender():
+    """A frame over MAX_FRAME_BYTES raises at the SENDER with the actual
+    cause (request -> CallError; response -> JSON error reply), instead of a
+    silent receiver-side connection drop."""
+    from ringpop_tpu.net.channel import MAX_FRAME_BYTES
+
+    big = "x" * (MAX_FRAME_BYTES + 1024)
+
+    async def main():
+        server = TCPChannel(app="t")
+        await server.listen()
+        server.register("svc", "/big", lambda b, h: {"blob": big})
+        client = TCPChannel(app="t")
+
+        with pytest.raises(CallError, match="exceeds MAX_FRAME_BYTES"):
+            await client.call(server.hostport, "svc", "/echo", {"blob": big}, timeout=5.0)
+
+        with pytest.raises(CallError, match="response encode failed"):
+            await client.call(server.hostport, "svc", "/big", {}, timeout=5.0)
+
+        await server.close()
+        await client.close()
+
+    run(main())
