@@ -1,0 +1,179 @@
+"""Randomized property tests for the override lattice.
+
+The whole design rests on one claim (README "two planes, one semantics
+core"): the reference's update rules (`member.go:79-128,178-187`,
+`memberlist.go:310-390`) form a lattice whose join is ``max`` over
+``pack_key(incarnation, state)``, so the host plane's sequential fold and
+the sim planes' vectorized maxes compute the same member states.  These
+tests pin that claim with seeded random sweeps instead of hand-picked
+tables (the tables live in test_member.py).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ringpop_tpu import util
+from ringpop_tpu.net.channel import LocalNetwork
+from ringpop_tpu.swim.member import (
+    ALIVE,
+    FAULTY,
+    LEAVE,
+    SUSPECT,
+    TOMBSTONE,
+    Change,
+    key_incarnation,
+    key_state,
+    overrides,
+    pack_key,
+)
+from tests.swim_utils import make_node
+
+STATES = [ALIVE, SUSPECT, FAULTY, LEAVE, TOMBSTONE]
+
+
+def _rand_pairs(rng: random.Random, n: int, max_inc: int = 1 << 27):
+    return [(rng.randrange(max_inc), rng.choice(STATES)) for _ in range(n)]
+
+
+def test_pack_key_is_order_embedding():
+    """pack_key(a) > pack_key(b)  <=>  overrides(a, b), over random pairs —
+    the property that lets array engines replace the reference's branching
+    comparison with one integer max."""
+    rng = random.Random(11)
+    pairs = _rand_pairs(rng, 400)
+    for inc_a, st_a in pairs[:200]:
+        for inc_b, st_b in rng.sample(pairs, 20):
+            assert (pack_key(inc_a, st_a) > pack_key(inc_b, st_b)) == bool(
+                overrides(inc_a, st_a, inc_b, st_b)
+            ), (inc_a, st_a, inc_b, st_b)
+
+
+def test_pack_key_roundtrip_and_array_parity():
+    rng = random.Random(12)
+    incs = np.array([p[0] for p in _rand_pairs(rng, 1000)], dtype=np.int32)
+    sts = np.array([rng.choice(STATES) for _ in range(1000)], dtype=np.int32)
+    keys = pack_key(incs, sts)
+    np.testing.assert_array_equal(key_incarnation(keys), incs)
+    np.testing.assert_array_equal(key_state(keys), sts)
+    # scalar and array forms agree elementwise
+    for i in range(0, 1000, 97):
+        assert int(keys[i]) == pack_key(int(incs[i]), int(sts[i]))
+
+
+def test_overrides_scalar_vs_array_elementwise():
+    rng = random.Random(13)
+    a = _rand_pairs(rng, 500)
+    b = _rand_pairs(rng, 500)
+    inc_a = np.array([x[0] for x in a]); st_a = np.array([x[1] for x in a])
+    inc_b = np.array([x[0] for x in b]); st_b = np.array([x[1] for x in b])
+    vec = overrides(inc_a, st_a, inc_b, st_b)
+    for i in range(500):
+        assert bool(vec[i]) == bool(overrides(a[i][0], a[i][1], b[i][0], b[i][1]))
+
+
+def test_update_fold_equals_lattice_max():
+    """Applying a random change sequence about a NON-local member through
+    the full memberlist.update pipeline ends at exactly the pack_key max of
+    the sequence — order-independence of the consistency core.
+
+    Tombstone-first prefixes are skipped by the pipeline (first-seen
+    tombstone refusal, ``memberlist.py:168-170``), so the expected fold
+    starts at the first non-tombstone change (exactly the reference's
+    re-import guard) and joins everything after it.
+    """
+    rng = random.Random(14)
+    for trial in range(60):
+        node = make_node(LocalNetwork(), "10.9.9.9:3000")
+        try:
+            seq = _rand_pairs(rng, rng.randint(1, 12), max_inc=1000)
+            order = list(seq)
+            rng.shuffle(order)
+            subject = "10.0.0.1:3000"
+            for inc, st in order:
+                node.memberlist.update(
+                    [Change(source="t", source_incarnation=1,
+                            address=subject, incarnation=inc, status=st)]
+                )
+            member = node.memberlist.member(subject)
+            # expected: fold with first-seen seeding + override joins,
+            # skipping the tombstone-first refusals
+            expect = None
+            for inc, st in order:
+                if expect is None:
+                    if st != TOMBSTONE:
+                        expect = (inc, st)
+                elif pack_key(inc, st) > pack_key(*expect):
+                    expect = (inc, st)
+            if expect is None:
+                assert member is None, "all-tombstone sequence created a member"
+            else:
+                assert member is not None
+                assert (member.incarnation, member.status) == expect, (
+                    trial, order, (member.incarnation, member.status), expect
+                )
+        finally:
+            node.destroy()
+
+
+def test_refutation_wins_once_clock_advances():
+    """A detraction echoing any incarnation the local node could have issued
+    (i.e. <= its clock, which has since advanced) is refuted by a
+    reincarnation that strictly OVERRIDES it (parity:
+    ``memberlist.go:337-354``) — the liveness half of the protocol.
+
+    Incarnations are wall-clock ms precisely so this holds without
+    coordination: a real detraction carries an incarnation the subject
+    issued earlier, so by refutation time now-ms exceeds it."""
+    rng = random.Random(15)
+    for _ in range(40):
+        node = make_node(LocalNetwork(), "10.9.9.9:3000")
+        try:
+            node.memberlist.reincarnate()
+            inc0 = node.memberlist.member(node.address).incarnation
+            node.clock.advance(rng.randint(1, 5000) / 1000.0)
+            now = util.now_ms(node.clock)
+            detraction_inc = rng.randint(inc0, now - 1)
+            st = rng.choice([SUSPECT, FAULTY, TOMBSTONE])
+            node.memberlist.update(
+                [Change(source="t", source_incarnation=1, address=node.address,
+                        incarnation=detraction_inc, status=st)]
+            )
+            me = node.memberlist.member(node.address)
+            assert me.status == ALIVE
+            assert pack_key(me.incarnation, me.status) > pack_key(detraction_inc, st), (
+                "refutation does not override the detraction",
+                (me.incarnation, me.status), (detraction_inc, st),
+            )
+        finally:
+            node.destroy()
+
+
+def test_same_millisecond_detraction_is_reference_faithful():
+    """Reference-faithful edge: a detraction at incarnation == now-ms draws
+    a refutation at the SAME incarnation, whose Alive does not override the
+    detraction (precedence Alive < Suspect at equal incarnation) — exactly
+    the reference's behavior (``memberlist.go:337-354`` uses raw
+    nowInMillis).  Convergence then relies on the clock advancing before
+    the next gossip redelivery, at which point refutation wins (the test
+    above).  Pinned so a future 'fix' here knows it would diverge from the
+    reference wire behavior."""
+    node = make_node(LocalNetwork(), "10.9.9.9:3000")
+    try:
+        node.memberlist.reincarnate()
+        now = util.now_ms(node.clock)
+        node.memberlist.update(
+            [Change(source="t", source_incarnation=1, address=node.address,
+                    incarnation=now, status=SUSPECT)]
+        )
+        me = node.memberlist.member(node.address)
+        # the refutation applied Alive@now locally, which ties (and loses
+        # to) Suspect@now under the override order — locally the node still
+        # believes itself Alive; remotely the suspect claim survives this ms
+        assert me.status == ALIVE
+        assert me.incarnation == now
+        assert not pack_key(me.incarnation, me.status) > pack_key(now, SUSPECT)
+    finally:
+        node.destroy()
