@@ -34,10 +34,17 @@ def free_port() -> int:
 
 
 class ProcessCluster:
-    def __init__(self, n: int, suspect_period: float = 1.0, app: str = "testpop"):
+    def __init__(
+        self,
+        n: int,
+        suspect_period: float = 1.0,
+        app: str = "testpop",
+        wire: Optional[str] = None,
+    ):
         self.n = n
         self.app = app
         self.suspect_period = suspect_period
+        self.wire = wire
         self.hosts = [f"127.0.0.1:{free_port()}" for _ in range(n)]
         self.procs: dict[str, subprocess.Popen] = {}
         self._tmpdir = tempfile.mkdtemp(prefix="ringpop-itest-")
@@ -65,7 +72,8 @@ class ProcessCluster:
                     str(self.suspect_period),
                     "--join-timeout",
                     "1.0",
-                ],
+                ]
+                + (["--wire", self.wire] if self.wire else []),
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,

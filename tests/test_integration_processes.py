@@ -81,3 +81,47 @@ def test_five_process_cluster_and_reap():
             await cluster.shutdown()
 
     run(main())
+
+
+def test_graceful_leave_and_rejoin_over_the_wire():
+    """Tier-3 leave/rejoin scenario (reference it-tests; handlers
+    swim/handlers.go:140-148): /admin/member/leave marks the node Leave
+    cluster-wide; /admin/member/join reincarnates it back to alive."""
+
+    async def main():
+        cluster = ProcessCluster(3, suspect_period=1.0)
+        cluster.start()
+        try:
+            await cluster.wait_converged(expect_members=3, timeout=45)
+            leaver, observer = cluster.hosts[2], cluster.hosts[0]
+            client = await cluster.client()
+
+            await client.call(leaver, "ringpop", "/admin/member/leave", {}, timeout=2.0)
+            await cluster.wait_member_status(observer, leaver, "leave", timeout=45)
+
+            await client.call(leaver, "ringpop", "/admin/member/join", {}, timeout=2.0)
+            await cluster.wait_member_status(observer, leaver, "alive", timeout=45)
+            await cluster.wait_converged(timeout=45)
+        finally:
+            await cluster.shutdown()
+
+    run(main())
+
+
+def test_msgpack_wire_process_cluster():
+    """A whole process cluster speaking the binary codec (testpop --wire
+    msgpack) converges and serves admin RPCs — tier-3 coverage for the
+    msgpack framing, including a json-codec client talking to it."""
+
+    async def main():
+        cluster = ProcessCluster(3, wire="msgpack")
+        cluster.start()
+        try:
+            # the harness client speaks json; receivers auto-detect
+            stats = await cluster.wait_converged(expect_members=3, timeout=45)
+            for s in stats.values():
+                assert all(m["status"] == "alive" for m in s["membership"]["members"])
+        finally:
+            await cluster.shutdown()
+
+    run(main())
