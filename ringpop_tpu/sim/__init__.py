@@ -17,6 +17,14 @@ protocol period at once:
   state for K in-flight changes over a converged base — runs 1M+ nodes on
   one chip and shards over a mesh for more.
 
+* :mod:`ringpop_tpu.sim.lifecycle` — O(N·K) full failure-detection engine
+  (probe → suspect → deadline → faulty → tombstone → evict + refutation).
+
+* :mod:`ringpop_tpu.sim.montecarlo` — whole clusters vmapped over a
+  replica axis: B seeded replicas as ONE compiled program ([B, N, K]
+  arrays) for detection-latency distributions and parameter studies;
+  replica b is bit-identical to ``LifecycleSim(seed=seeds[b])``.
+
 Fault injection is first-class: partition group arrays, per-edge drop
 probability, process-liveness masks — plain arrays applied to the message
 exchange step (BASELINE.json's 5% loss / 30% partition configs).
@@ -24,5 +32,16 @@ exchange step (BASELINE.json's 5% loss / 30% partition configs).
 
 from ringpop_tpu.sim.fullview import FullViewSim, FullViewParams
 from ringpop_tpu.sim.delta import DeltaSim, DeltaParams
+from ringpop_tpu.sim.lifecycle import LifecycleSim, LifecycleParams
+from ringpop_tpu.sim.montecarlo import MonteCarlo, detection_latency_distribution
 
-__all__ = ["FullViewSim", "FullViewParams", "DeltaSim", "DeltaParams"]
+__all__ = [
+    "FullViewSim",
+    "FullViewParams",
+    "DeltaSim",
+    "DeltaParams",
+    "LifecycleSim",
+    "LifecycleParams",
+    "MonteCarlo",
+    "detection_latency_distribution",
+]

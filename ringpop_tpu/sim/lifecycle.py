@@ -134,6 +134,13 @@ class LifecycleParams:
 
 
 def init_state(params: LifecycleParams, seed: int = 0) -> LifecycleState:
+    return init_state_from_key(params, jax.random.PRNGKey(seed))
+
+
+def init_state_from_key(params: LifecycleParams, key) -> LifecycleState:
+    """Key-taking init variant — vmappable over a batch of PRNG keys (the
+    Monte-Carlo sweep in ``sim/montecarlo.py`` builds replica batches this
+    way)."""
     n, k = params.n, params.k
     return LifecycleState(
         r_subject=jnp.full((k,), -1, jnp.int32),
@@ -149,7 +156,7 @@ def init_state(params: LifecycleParams, seed: int = 0) -> LifecycleState:
         base_deadline=jnp.full((n,), NO_DEADLINE, jnp.int32),
         self_inc=jnp.zeros((n,), jnp.int32),
         tick=jnp.asarray(0, jnp.int32),
-        key=jax.random.PRNGKey(seed),
+        key=key,
     )
 
 

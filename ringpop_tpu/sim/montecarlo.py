@@ -1,0 +1,158 @@
+"""Monte-Carlo protocol studies: whole simulated clusters vmapped over a
+replica axis.
+
+The reference answers "what is the detection-latency distribution?" by
+running processes repeatedly (its integration suite runs cluster sizes
+1..10 one at a time); our engine-agreement tests did the same with one
+`LifecycleSim` per seed.  On an accelerator that's leaving the machine
+idle: one `jax.vmap` over the replica axis turns B independent clusters
+into ONE compiled program whose arrays are `[B, N, K]` — the natural
+TPU-first shape for parameter studies (same step function, zero
+per-replica Python).
+
+Semantics are exactly `LifecycleSim`: replica b of
+`MonteCarlo.run_until_detected` with seeds[b] == s produces tick-for-tick
+the state `LifecycleSim(seed=s)` produces (pinned by
+`tests/test_montecarlo.py`).
+
+Reference analogs: failure detection `swim/node.go:470-513`; the suspicion
+timeout sweep scenario (BASELINE `sweep100k`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.sim.lifecycle import (
+    FAULTY,
+    LifecycleParams,
+    detection_fraction,
+    init_state_from_key,
+    step,
+)
+
+
+def init_replicas(params: LifecycleParams, seeds: Sequence[int]):
+    """Batched state pytree: every array gains a leading replica axis B.
+
+    Keys are built with ``jax.random.PRNGKey(seed)`` per seed (host loop, B
+    is small) so replica b's stream is EXACTLY ``LifecycleSim(seed=...)``'s
+    for any seed Python accepts — a uint32 cast would silently wrap seeds
+    >= 2**32 and break the bit-identical contract."""
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    return jax.vmap(lambda k: init_state_from_key(params, k))(keys)
+
+
+def _mc_block(params: LifecycleParams, states, faults: DeltaFaults, ticks: int):
+    vstep = jax.vmap(lambda s: step(params, s, faults))
+    return jax.lax.fori_loop(0, ticks, lambda _, s: vstep(s), states)
+
+
+class MonteCarlo:
+    """B lockstep cluster replicas differing only in PRNG seed.
+
+    >>> mc = MonteCarlo(LifecycleParams(n=512, k=32), seeds=range(32))
+    >>> ticks, detected = mc.run_until_detected(victims=[3, 99], faults=f)
+    >>> np.median(ticks[detected])   # detection-latency distribution
+    """
+
+    def __init__(self, params: LifecycleParams, seeds: Sequence[int]):
+        self.params = params
+        self.seeds = list(seeds)
+        self.states = init_replicas(params, self.seeds)
+        self._block = jax.jit(
+            functools.partial(_mc_block, self.params), static_argnames="ticks"
+        )
+
+    def _frac(self, subjects, faults: DeltaFaults, min_status: int) -> np.ndarray:
+        """Detection fractions per replica -> float[B, S].
+
+        Deliberately a host loop over replicas, NOT jit+vmap: the detection
+        query runs once per check interval (off the hot stepping path), and
+        ``detection_fraction``'s large-problem branch is host-side numpy —
+        it cannot trace, and a vmapped small path would materialize
+        O(B·N·K·S).  Per-replica calls keep exactly ``LifecycleSim``'s
+        behavior at every scale."""
+        rows = []
+        for b in range(self.n_replicas):
+            one = jax.tree.map(lambda x: x[b], self.states)
+            rows.append(np.asarray(detection_fraction(one, subjects, faults, min_status)))
+        return np.stack(rows)
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.seeds)
+
+    def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()):
+        self.states = self._block(self.states, faults, ticks=ticks)
+        return self.states
+
+    def run_until_detected(
+        self,
+        victims: Sequence[int],
+        faults: DeltaFaults = DeltaFaults(),
+        min_status: int = FAULTY,
+        max_ticks: int = 2048,
+        check_every: int = 8,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Advance all replicas in lockstep until each has every live
+        observer believing every victim >= ``min_status``.
+
+        Returns ``(first_detected_tick[B], detected[B])`` — the tick count
+        (multiple of ``check_every``, like ``LifecycleSim``'s) at which each
+        replica first measured full detection, and whether it did within
+        ``max_ticks``.  Replicas that finish early keep stepping (lockstep
+        is what makes this one program); their recorded tick is frozen.
+        """
+        subjects = jnp.asarray(list(victims), jnp.int32)
+        b = self.n_replicas
+        first_tick = np.full(b, -1, np.int64)
+        ticks = 0
+        while ticks < max_ticks:
+            self.states = self._block(self.states, faults, ticks=check_every)
+            ticks += check_every
+            frac = self._frac(subjects, faults, min_status)
+            done = (frac >= 1.0).all(axis=1)
+            first_tick = np.where((first_tick < 0) & done, ticks, first_tick)
+            if (first_tick >= 0).all():
+                break
+        detected = first_tick >= 0
+        return first_tick, detected
+
+
+def detection_latency_distribution(
+    n: int,
+    seeds: Sequence[int],
+    victims: Sequence[int],
+    k: int = 32,
+    suspect_ticks: Optional[int] = None,
+    max_ticks: int = 2048,
+    check_every: int = 8,
+) -> dict:
+    """One-call study: crash ``victims`` in B seeded replicas of an n-node
+    cluster and return the detection-latency distribution (in ticks and in
+    simulated seconds at the 200 ms protocol period)."""
+    kw = {} if suspect_ticks is None else {"suspect_ticks": suspect_ticks}
+    params = LifecycleParams(n=n, k=k, **kw)
+    up = np.ones(n, bool)
+    up[np.asarray(list(victims), np.int64)] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    mc = MonteCarlo(params, seeds)
+    ticks, detected = mc.run_until_detected(
+        victims, faults, max_ticks=max_ticks, check_every=check_every
+    )
+    det = ticks[detected].astype(float)
+    return {
+        "n_replicas": mc.n_replicas,
+        "detected": int(detected.sum()),
+        "ticks_median": float(np.median(det)) if det.size else None,
+        "ticks_p90": float(np.percentile(det, 90)) if det.size else None,
+        "ticks_max": float(det.max()) if det.size else None,
+        "sim_s_median": float(np.median(det) * 0.2) if det.size else None,
+    }
