@@ -1,0 +1,47 @@
+"""Example: a Monte-Carlo protocol study on the sim plane.
+
+Question a ringpop operator actually asks: "if two nodes crash in a
+1024-node cluster, how long until every live member knows?"  The reference
+answers by running process clusters repeatedly; here B seeded replicas of
+the whole cluster run as ONE compiled program (`[B, N, K]` arrays,
+``ringpop_tpu/sim/montecarlo.py``), so the distribution comes from a single
+sweep — and the same code scales the study to accelerator-sized clusters.
+
+    python examples/montecarlo_study.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if not os.environ.get("KEEP_PLATFORM"):
+    # this example is CPU-sized; pin before backend init (see PERF.md)
+    jax.config.update("jax_platforms", "cpu")
+
+from ringpop_tpu.sim import detection_latency_distribution
+
+
+def main():
+    n, crashes, replicas = 1024, 2, 16
+    victims = [7, 613]
+    print(f"crashing {crashes} of {n} nodes across {replicas} seeded replicas...")
+    out = detection_latency_distribution(
+        n=n,
+        seeds=range(replicas),
+        victims=victims,
+        k=32,
+        max_ticks=1024,
+    )
+    print(f"replicas detected: {out['detected']}/{out['n_replicas']}")
+    print(
+        f"detection latency: median {out['ticks_median']:.0f} ticks "
+        f"({out['sim_s_median']:.1f}s of simulated time at 200ms periods), "
+        f"p90 {out['ticks_p90']:.0f}, max {out['ticks_max']:.0f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -161,6 +161,35 @@ def bench_loss1k(seed: int, full: bool) -> dict:
     }
 
 
+def bench_montecarlo(seed: int, full: bool) -> dict:
+    """Detection-latency DISTRIBUTION in one compiled program: B seeded
+    cluster replicas vmapped over a replica axis (``sim/montecarlo.py``) —
+    the study the reference's integration suite would need B process-cluster
+    runs for."""
+    import numpy as np
+
+    from ringpop_tpu.sim.montecarlo import detection_latency_distribution
+
+    n = 4096 if full else 512
+    b = 32 if full else 8
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=4, replace=False).tolist())
+    out = detection_latency_distribution(
+        n=n, seeds=range(seed, seed + b), victims=victims, k=32, max_ticks=1024
+    )
+    return {
+        "metric": f"mc_detection_distribution_n{n}_x{b}",
+        # -1 sentinel keeps the value numeric when no replica detected
+        "value": -1.0 if out["ticks_median"] is None else out["ticks_median"],
+        "unit": "ticks_median",
+        "ticks_p90": out["ticks_p90"],
+        "ticks_max": out["ticks_max"],
+        "sim_s_median": out["sim_s_median"],
+        "replicas": out["n_replicas"],
+        "all_detected": out["detected"] == out["n_replicas"],
+    }
+
+
 def bench_sweep100k(seed: int, full: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -303,7 +332,7 @@ def bench_forward_qps(seed: int, full: bool) -> dict:
     from ringpop_tpu.net import TCPChannel
     from ringpop_tpu.ringpop import Ringpop
 
-    n_req = 2000 if full else 500
+    n_req = 5000 if full else 500  # per rep; short reps are noise-dominated
 
     async def run():
         chans = [TCPChannel(app="fwd") for _ in range(3)]
@@ -318,32 +347,54 @@ def bench_forward_qps(seed: int, full: bool) -> dict:
             handled, res = await rps[0].handle_or_forward(f"key-{i}", {"i": i}, "fwd", "/op")
             return handled
 
-        # warm
-        await asyncio.gather(*(one(i) for i in range(32)))
-        t0 = time.perf_counter()
-        results = await asyncio.gather(*(one(i) for i in range(n_req)))
-        elapsed = time.perf_counter() - t0
-        local = sum(1 for h in results if h)
+        # Measurement shape matters on one core: a single gather of all
+        # n_req tasks queues thousands of concurrent callbacks (worse cache
+        # behavior, slow first reps as the interpreter specializes), which
+        # measured anywhere from 9k to 22k req/s run to run.  Sequential
+        # waves of 500 in-flight requests with one warm rep, median of
+        # five, is reproducible within ~10%.
+        wave = 500
+        waves = max(1, n_req // wave)
+        # Warmup on this container is long and variable (measured reps can
+        # keep climbing past 20k requests when the process ran big sims
+        # first — interpreter specialization + allocator state); discard
+        # four full reps and report the median of five, WITH the sorted rep
+        # list so consumers see the spread instead of trusting one number.
+        reps, warm_reps = 5, 4
+        qps, local, total = [], 0, 0
+        for rep in range(warm_reps + reps):
+            t0 = time.perf_counter()
+            done = 0
+            for w in range(waves):
+                base = (rep * waves + w) * wave
+                results = await asyncio.gather(*(one(base + i) for i in range(wave)))
+                done += len(results)
+                local += sum(1 for h in results if h) if rep >= warm_reps else 0
+            if rep >= warm_reps:
+                qps.append(done / (time.perf_counter() - t0))
+                total += done
         for rp in rps:
             rp.destroy()
         for ch in chans:
             await ch.close()
-        return elapsed, local
+        return sorted(qps), local, total
 
-    elapsed, local = asyncio.run(run())
+    qps, local, total = asyncio.run(run())
     return {
         "metric": "forward_keyed_qps_3node",
-        "value": round(n_req / elapsed, 0),
+        "value": round(qps[len(qps) // 2], 0),
         "unit": "req_per_s",
-        "n_requests": n_req,
+        "qps_reps": [round(q) for q in qps],
+        "n_requests": total,
         "handled_locally": local,
-        "forwarded": n_req - local,
+        "forwarded": total - local,
     }
 
 
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
+    "montecarlo": bench_montecarlo,
     "sweep100k": bench_sweep100k,
     "partition1m": bench_partition1m,
     "ring1m": bench_ring1m,

@@ -140,6 +140,7 @@ def detection_latency_distribution(
     simulated seconds at the 200 ms protocol period)."""
     kw = {} if suspect_ticks is None else {"suspect_ticks": suspect_ticks}
     params = LifecycleParams(n=n, k=k, **kw)
+    tick_s = params.tick_ms / 1000.0
     up = np.ones(n, bool)
     up[np.asarray(list(victims), np.int64)] = False
     faults = DeltaFaults(up=jnp.asarray(up))
@@ -154,5 +155,5 @@ def detection_latency_distribution(
         "ticks_median": float(np.median(det)) if det.size else None,
         "ticks_p90": float(np.percentile(det, 90)) if det.size else None,
         "ticks_max": float(det.max()) if det.size else None,
-        "sim_s_median": float(np.median(det) * 0.2) if det.size else None,
+        "sim_s_median": float(np.median(det) * tick_s) if det.size else None,
     }
