@@ -36,6 +36,9 @@ def main():
         max_ticks=1024,
     )
     print(f"replicas detected: {out['detected']}/{out['n_replicas']}")
+    if out["ticks_median"] is None:
+        print("no replica reached full detection within the tick budget")
+        return
     print(
         f"detection latency: median {out['ticks_median']:.0f} ticks "
         f"({out['sim_s_median']:.1f}s of simulated time at 200ms periods), "

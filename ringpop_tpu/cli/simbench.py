@@ -228,7 +228,6 @@ def bench_partition1m(seed: int, full: bool) -> dict:
     from ringpop_tpu.sim.delta import (
         DeltaFaults,
         DeltaParams,
-        DeltaSim,
         init_state,
         run_until_converged,
     )
@@ -348,19 +347,17 @@ def bench_forward_qps(seed: int, full: bool) -> dict:
             return handled
 
         # Measurement shape matters on one core: a single gather of all
-        # n_req tasks queues thousands of concurrent callbacks (worse cache
-        # behavior, slow first reps as the interpreter specializes), which
-        # measured anywhere from 9k to 22k req/s run to run.  Sequential
-        # waves of 500 in-flight requests with one warm rep, median of
-        # five, is reproducible within ~10%.
+        # n_req tasks queues thousands of concurrent callbacks at once and
+        # measured anywhere from 9k to 22k req/s run to run.  Instead:
+        # sequential waves of 500 in-flight requests; discard several full
+        # warm reps (warmup is long and variable — interpreter
+        # specialization + allocator state can keep reps climbing past 20k
+        # requests); report the median of the measured reps WITH the sorted
+        # rep list so consumers see the spread, not one lucky number.
+        # Smoke mode shrinks the protocol so `--only forward` stays fast.
         wave = 500
         waves = max(1, n_req // wave)
-        # Warmup on this container is long and variable (measured reps can
-        # keep climbing past 20k requests when the process ran big sims
-        # first — interpreter specialization + allocator state); discard
-        # four full reps and report the median of five, WITH the sorted rep
-        # list so consumers see the spread instead of trusting one number.
-        reps, warm_reps = 5, 4
+        reps, warm_reps = (5, 4) if full else (3, 1)
         qps, local, total = [], 0, 0
         for rep in range(warm_reps + reps):
             t0 = time.perf_counter()

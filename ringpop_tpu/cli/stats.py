@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import socket
 import time
-from typing import Optional, TextIO
+from typing import TextIO
 
 from ringpop_tpu.options import StatsReporter
 

@@ -11,7 +11,6 @@ reroute enabled a moved-but-consistent destination is chased
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.forward import events as ev

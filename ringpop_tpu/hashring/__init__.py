@@ -28,7 +28,6 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
-from ringpop_tpu import events as events_mod
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.events import EventEmitter, RingChangedEvent, RingChecksumEvent
 from ringpop_tpu.hashing import (

@@ -28,8 +28,6 @@ from ringpop_tpu.ops.hash_ops import (
     _MIX5,
     _MIXC,
     _C1,
-    _C2,
-    _fmix,
     _hash_0_4,
     _hash_13_24,
     _hash_5_12,

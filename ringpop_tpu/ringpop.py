@@ -10,7 +10,6 @@ translates membership changes into ring add/removes
 
 from __future__ import annotations
 
-import asyncio
 import enum
 import time as _time
 from typing import Optional

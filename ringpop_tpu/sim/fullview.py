@@ -37,7 +37,7 @@ key ops fuse into a handful of XLA kernels.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import NamedTuple, Optional
 
 import jax

@@ -18,7 +18,6 @@ import random
 from typing import Optional
 
 from ringpop_tpu import logging as logging_mod
-from ringpop_tpu import util
 from ringpop_tpu.swim import events as ev
 from ringpop_tpu.swim.member import FAULTY, SUSPECT, Change
 from ringpop_tpu.swim.join import send_join_request

@@ -21,8 +21,8 @@ representation exact.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import Any, Optional
+from dataclasses import dataclass
+from typing import Any
 
 from ringpop_tpu import util
 

@@ -11,7 +11,7 @@ core.  Checksum is farm32 over the reference's exact canonical string
 from __future__ import annotations
 
 import random
-from typing import Callable, Optional
+from typing import Optional
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu import util

@@ -12,7 +12,7 @@ plane's deadline arrays.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu import util

@@ -9,7 +9,7 @@ from __future__ import annotations
 import random
 import re
 import time as _time
-from typing import Iterable, Mapping, Optional, Sequence, TypeVar
+from typing import Iterable, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 
