@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration bench simbench native lint clean
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean
 
 all: native test
 
@@ -25,6 +25,11 @@ test-slow:
 test-integration:
 	$(PY) -m pytest tests/test_integration_processes.py -q
 
+# real-hardware smoke suite (own process: tests/ pins CPU at conftest import;
+# auto-skips when the axon tunnel is down)
+test-accel:
+	$(PY) -m pytest tests_accel/ -q
+
 # headline benchmark — one JSON line (1M-node convergence on an accelerator)
 bench:
 	$(PY) bench.py
@@ -38,7 +43,7 @@ native:
 	$(PY) -c "from ringpop_tpu import native; assert native._build(), 'g++ build failed'; print('native hash core built')"
 
 lint:
-	$(PY) -m compileall -q ringpop_tpu tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q ringpop_tpu tests tests_accel bench.py __graft_entry__.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

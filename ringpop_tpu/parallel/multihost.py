@@ -1,0 +1,100 @@
+"""Multi-host (DCN) mesh construction for the sim plane.
+
+The reference scales across machines by pointing more TChannel processes at
+each other (SURVEY §2.8); the sim plane scales across TPU hosts with
+``jax.distributed`` + one global mesh spanning every process's local chips.
+Nothing in the engines branches on host count — the same jitted ``step``
+from ``sim/delta.py`` / ``sim/lifecycle.py`` runs on the mesh built here
+unchanged; only mesh construction differs from the single-host path in
+``parallel/mesh.py``.
+
+Axis layout (the one decision that matters — PERF.md "Multi-host (DCN)
+design"): the **node axis spans hosts**, because its per-tick collectives
+are the cyclic ``jnp.roll`` exchanges — nearest-neighbor permutes that
+cross the host boundary (DCN) only at slice edges, once per tick — while
+the **rumor axis stays inside a host** where its row-gathers/all-to-alls
+ride ICI.  ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` packs
+devices so exactly that holds: the outer (DCN) factor multiplies the node
+axis, the inner (ICI) factors fill rumor first.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotently initialize the JAX distributed runtime.
+
+    Args default from the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) so a launcher can export
+    them and every rank calls ``init_distributed()`` bare.  Returns True
+    when the distributed client is (now) up, False when running
+    single-process with no coordinator configured — single-process callers
+    can then fall back to :func:`ringpop_tpu.parallel.mesh.make_mesh`.
+    """
+    if jax.distributed.is_initialized():  # already up
+        return True
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+    if not coordinator_address:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_multihost_mesh(rumor_shards: Optional[int] = None) -> Mesh:
+    """Global 2D ("node", "rumor") mesh over every device in the job.
+
+    The DCN granule is the TPU slice when the runtime reports more than one
+    (real multi-slice jobs — ICI spans hosts *within* a slice, so that is
+    the true fast-interconnect domain), else the process (e.g. the
+    multi-process CPU fabric used to validate this path without a pod).
+    The rumor axis never leaves a granule: it is carved entirely out of the
+    per-granule (ICI) device block, so its all-to-alls stay on fast
+    interconnect and only the node axis pays DCN latency.  ``rumor_shards``
+    defaults to 2 when a granule holds an even number of chips (matching
+    :func:`ringpop_tpu.parallel.mesh.make_mesh`'s default), else 1.
+    """
+    devices = jax.devices()
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    slice_is_granule = n_slices > 1
+    n_granules = n_slices if slice_is_granule else jax.process_count()
+    per_granule = len(devices) // n_granules
+    if rumor_shards is None:
+        rumor_shards = 2 if per_granule % 2 == 0 and per_granule > 1 else 1
+    if per_granule % rumor_shards:
+        raise ValueError(
+            f"rumor_shards={rumor_shards} must divide per-granule device count "
+            f"{per_granule} (the rumor axis must not cross DCN)"
+        )
+    if n_granules == 1:
+        dev_array = np.asarray(devices).reshape(len(devices) // rumor_shards, rumor_shards)
+        return Mesh(dev_array, axis_names=("node", "rumor"))
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_granule // rumor_shards, rumor_shards),  # ICI block per granule
+        dcn_mesh_shape=(n_granules, 1),  # granules multiply the node axis only
+        devices=devices,
+        process_is_granule=not slice_is_granule,
+    )
+    return Mesh(dev_array, axis_names=("node", "rumor"))

@@ -1,0 +1,54 @@
+"""Worker process for the multi-host mesh test (see test_multihost.py).
+
+One rank of a 2-process jax.distributed job: 4 virtual CPU devices per
+process form a global 8-device ("node" x "rumor") mesh; runs one sharded
+delta step and one sharded lifecycle step over cross-process (gloo)
+collectives.  Argv: <process_id> <coordinator_port>.
+"""
+
+import functools
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    from ringpop_tpu.parallel.multihost import init_distributed, make_multihost_mesh
+
+    assert init_distributed(), "coordinator env vars set above"
+    assert len(jax.devices()) == 8, jax.devices()
+
+    mesh = make_multihost_mesh()
+    assert mesh.shape == {"node": 4, "rumor": 2}, mesh.shape
+    # the rumor axis must not cross DCN: both devices in each rumor row
+    # belong to one process
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1, "rumor axis crossed hosts"
+
+    from ringpop_tpu.parallel.mesh import delta_shardings
+    from ringpop_tpu.sim.delta import DeltaParams, init_state, step
+
+    params = DeltaParams(n=64, k=16)
+    sh = delta_shardings(mesh)
+    state = jax.jit(lambda: init_state(params, seed=0), out_shardings=sh)()
+    out = jax.jit(functools.partial(step, params), in_shardings=(sh,), out_shardings=sh)(state)
+    jax.block_until_ready(out)
+    assert int(out.tick) == 1
+    # dissemination progressed globally (the roll exchange crossed processes)
+    assert int(out.learned.sum()) > int(state.learned.sum())
+    print(f"rank {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -191,6 +191,70 @@ def test_detection_fraction_large_path_matches_small():
             assert np.allclose(small, large), (ticks, min_status, small, large)
 
 
+def test_detection_complete_matches_fraction():
+    """The on-device boolean check (the one run_until_detected jits into its
+    while_loop) must agree with ``(detection_fraction >= 1).all()`` on the
+    same rich mixed states the large-path test uses — including the
+    all-detected end state and base-only (no-slot) subjects."""
+    from ringpop_tpu.sim.lifecycle import detection_complete, detection_fraction
+
+    n = 96
+    sim = LifecycleSim(n=n, k=24, seed=33, suspect_ticks=6, alloc_per_tick=8)
+    victims = [5, 40, 41, 77]
+    faults = make_faults(n, down=victims, drop=0.08)
+    subject_sets = ([5], victims, victims + [0, 17, 60])
+    checked_true = 0
+    for _ in range(40):
+        sim.run(8, faults)
+        for subjects in subject_sets:
+            for min_status in (SUSPECT, FAULTY, TOMBSTONE):
+                frac = np.asarray(
+                    detection_fraction(sim.state, subjects, faults, min_status)
+                )
+                want = bool((frac >= 1.0).all())
+                got = bool(detection_complete(sim.state, subjects, faults, min_status))
+                assert got == want, (subjects, min_status, frac)
+                checked_true += want
+    assert checked_true > 0, "never reached a detected state — test too weak"
+
+
+def test_detection_complete_no_live_observers_is_false():
+    """With zero live observers the fraction is 0/1 per subject, so the
+    on-device check must report incomplete — a cluster with nobody left to
+    observe never 'detects' anything."""
+    from ringpop_tpu.sim.lifecycle import detection_complete
+
+    n = 16
+    sim = LifecycleSim(n=n, k=8, seed=1, suspect_ticks=4)
+    everyone = make_faults(n, down=list(range(n)))
+    sim.run(4, everyone)
+    assert not bool(detection_complete(sim.state, [3], everyone))
+
+
+def test_run_until_detected_device_loop_matches_host_check():
+    """The jitted while_loop runner must stop at the same (check_every-
+    granular) tick the per-block host check would."""
+    n = 64
+    faults = make_faults(n, down=[7])
+    a = LifecycleSim(n=n, k=16, seed=3, suspect_ticks=5)
+    ticks_dev, ok_dev = a.run_until_detected(
+        [7], faults, max_ticks=600, check_every=8, blocks_per_dispatch=4
+    )
+    from ringpop_tpu.sim.lifecycle import detection_complete
+
+    b = LifecycleSim(n=n, k=16, seed=3, suspect_ticks=5)
+    ticks_host = 0
+    ok_host = False
+    while ticks_host < 600:
+        b.run(8, faults)
+        ticks_host += 8
+        if bool(detection_complete(b.state, [7], faults)):
+            ok_host = True
+            break
+    assert ok_dev and ok_host
+    assert ticks_dev == ticks_host
+
+
 def test_crashed_node_revives_and_recovers():
     """Elastic recovery (SURVEY §5): a node detected faulty comes back up,
     learns it is believed faulty from the first exchange that reaches it,

@@ -90,3 +90,21 @@ def test_huge_seed_matches_sequential_key():
     for b, s in enumerate(seeds):
         expect = jax.random.PRNGKey(s)
         np.testing.assert_array_equal(np.asarray(batched.key[b]), np.asarray(expect))
+
+
+def test_detection_fractions_matches_per_replica():
+    """The introspection API (partial progress per replica) must agree with
+    per-replica detection_fraction on the equivalent single sims."""
+    from ringpop_tpu.sim.lifecycle import detection_fraction
+
+    params = LifecycleParams(n=N, k=K)
+    faults = _faults()
+    mc = MonteCarlo(params, SEEDS)
+    mc.run(16, faults)
+    got = mc.detection_fractions(VICTIMS, faults)
+    assert got.shape == (len(SEEDS), len(VICTIMS))
+    for b, seed in enumerate(SEEDS):
+        sim = LifecycleSim(n=N, k=K, seed=seed)
+        sim.run(16, faults)
+        want = np.asarray(detection_fraction(sim.state, VICTIMS, faults))
+        np.testing.assert_allclose(got[b], want, err_msg=str(seed))

@@ -1,0 +1,66 @@
+"""Multi-host mesh path: the same jitted sim step over a mesh spanning OS
+processes, with real cross-process collectives.
+
+The reference's multi-machine story is N TChannel processes over TCP
+(SURVEY §2.8, ``test/run-integration-tests``); the sim plane's is one
+global mesh over ``jax.distributed``.  A real pod isn't available here, so
+the strongest honest proof is two actual OS processes, each owning 4
+virtual CPU devices, joined through the distributed runtime — the exact
+code path (init_distributed → make_multihost_mesh → sharded step) a
+multi-host TPU job runs, with the collectives crossing a process boundary
+for real (gloo instead of DCN).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from ringpop_tpu.parallel.multihost import make_multihost_mesh
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_single_host_mesh_shape():
+    # in-process path: one host (this test process) → plain 2D mesh over
+    # the virtual 8-device CPU backend, rumor axis defaulting to 2
+    mesh = make_multihost_mesh()
+    assert mesh.shape == {"node": 4, "rumor": 2}
+    assert mesh.axis_names == ("node", "rumor")
+
+
+@pytest.mark.slow
+def test_two_process_mesh_runs_sharded_step():
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(WORKER)))
+    env.pop("JAX_PLATFORMS", None)  # worker pins its own
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(rank), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+        assert "OK" in out

@@ -1,0 +1,35 @@
+"""Accelerator (real-TPU) test suite — lives OUTSIDE tests/ on purpose.
+
+tests/conftest.py pins the whole pytest process to the CPU backend before
+jax initializes (the virtual 8-device mesh recipe), so hardware tests
+cannot share that process.  This suite runs via ``make test-accel`` in its
+own process, probes the axon tunnel in a SUBPROCESS first (a wedged tunnel
+hangs jax init rather than raising — see ringpop_tpu/util/accel.py), and
+skips everything cleanly when no live accelerator is reachable.
+"""
+
+import pytest
+
+from ringpop_tpu.util.accel import probe_accelerator
+
+_PROBE = None
+
+
+def _probe():
+    global _PROBE
+    if _PROBE is None:
+        _PROBE = probe_accelerator(timeouts_s=(90.0,))
+    return _PROBE
+
+
+def pytest_collection_modifyitems(config, items):
+    probe = _probe()
+    if probe["alive"] and probe.get("platform") not in ("cpu", None):
+        return
+    if probe["alive"]:
+        reason = f"backend is {probe.get('platform')!r}, not an accelerator"
+    else:
+        reason = f"no live accelerator: {probe['reason']}"
+    skip = pytest.mark.skip(reason=reason)
+    for item in items:
+        item.add_marker(skip)

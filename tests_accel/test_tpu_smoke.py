@@ -1,0 +1,111 @@
+"""Real-hardware smoke suite: the on-device kernels compiled for the TPU.
+
+The CPU suite already proves bit-exactness of every kernel under the CPU
+backend (and the Pallas kernel under interpret mode); what it cannot prove
+is that Mosaic/XLA:TPU actually lowers them.  These tests close that gap —
+they are the accelerator analog of the reference's micro-benches
+(``hashring/hashring_test.go:332``, ``rbtree_test.go:640-672``) plus one
+flagship-model step on hardware.
+
+Runs via ``make test-accel``; auto-skips when the axon tunnel is down.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ringpop_tpu.hashing.farm import fingerprint32, pack_strings  # noqa: E402
+from ringpop_tpu.ops.hash_ops import fingerprint32_device, keyed_owner_lookup  # noqa: E402
+from ringpop_tpu.ops.hash_pallas import fingerprint32_pallas  # noqa: E402
+from ringpop_tpu.ops.ring_ops import build_ring_tokens, ring_lookup, ring_lookup_n  # noqa: E402
+
+
+def _corpus(seed=0):
+    rng = np.random.default_rng(seed)
+    strings = []
+    for length in list(range(0, 26)) + [30, 41, 61, 99, 120, 127]:
+        for _ in range(3):
+            strings.append(bytes(rng.integers(0, 256, size=length, dtype=np.uint8)))
+    strings += [f"10.3.{i % 256}.{i % 40}:31{i % 100:02d}#{i}".encode() for i in range(512)]
+    return strings
+
+
+def test_device_hash_bitexact_on_accel():
+    strings = _corpus(seed=11)
+    mat, lens = pack_strings(strings)
+    got = np.asarray(fingerprint32_device(mat, lens))
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_pallas_hash_compiled_bitexact():
+    # interpret=False: this is the actual Mosaic lowering the advisor flagged
+    # as unproven in round 1
+    strings = _corpus(seed=12)
+    mat, lens = pack_strings(strings)
+    got = np.asarray(fingerprint32_pallas(mat, lens, interpret=False))
+    want = np.array([fingerprint32(s) for s in strings], dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_keyed_owner_lookup_matches_host_ring():
+    from ringpop_tpu.hashring import HashRing
+
+    servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(64)]
+    ring = HashRing(replica_points=10)
+    for s in servers:
+        ring.add_server(s)
+    keys = [f"user:{i}" for i in range(2000)]
+    mat, lens = pack_strings([k.encode() for k in keys])
+    tokens, owners = build_ring_tokens(servers, 10)
+    got = np.asarray(keyed_owner_lookup(tokens, owners, jnp.asarray(mat), jnp.asarray(lens)))
+    want = [ring.lookup(k) for k in keys]
+    assert [servers[i] for i in got] == want
+
+
+def test_ring_lookup_n_exact_on_accel():
+    from ringpop_tpu.hashring import HashRing
+
+    servers = [f"10.1.{i // 256}.{i % 256}:3000" for i in range(48)]
+    ring = HashRing(replica_points=3)  # sparse ring stresses the rescan path
+    for s in servers:
+        ring.add_server(s)
+    keys = [f"acct:{i}" for i in range(500)]
+    hashes = jnp.asarray(
+        np.array([fingerprint32(k.encode()) for k in keys], dtype=np.uint32)
+    )
+    tokens, owners = build_ring_tokens(servers, 3)
+    got = np.asarray(ring_lookup_n(tokens, owners, hashes, 5, len(servers)))
+    for row, k in zip(got, keys):
+        want = ring.lookup_n(k, 5)
+        assert [servers[i] for i in row if i >= 0] == want
+
+
+def test_ring_lookup_throughput_sane():
+    servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(1024)]
+    tokens, owners = build_ring_tokens(servers, 100)
+    hashes = jnp.asarray(
+        np.random.default_rng(0).integers(0, 2**32, size=200_000, dtype=np.uint32)
+    )
+    out = ring_lookup(tokens, owners, hashes)
+    jax.block_until_ready(out)
+    o = np.asarray(out)
+    assert o.min() >= 0 and o.max() < len(servers)
+
+
+def test_lifecycle_step_on_accel():
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    n = 4096
+    sim = lifecycle.LifecycleSim(n=n, k=64, seed=0, suspect_ticks=4)
+    up = np.ones(n, bool)
+    up[7] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    ticks, ok = sim.run_until_detected(
+        np.array([7]), faults, max_ticks=256, check_every=16
+    )
+    assert ok, f"victim never detected within {ticks} ticks"
+    assert ticks <= 256
