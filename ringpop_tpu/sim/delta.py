@@ -195,6 +195,44 @@ def converged_fraction(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -
     return state.learned.mean()
 
 
+def converged(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Array:
+    """bool scalar, on-device: have all rumors reached every live node?
+    (Dead rows are vacuously done — a fused masked reduce, no dynamic
+    shapes, so it can sit inside a jitted loop.)"""
+    if faults.up is None:
+        return state.learned.all()
+    return (state.learned | ~faults.up[:, None]).all()
+
+
+@functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
+def _run_until_converged_device(
+    params: DeltaParams,
+    state: DeltaState,
+    faults: DeltaFaults,
+    *,
+    block_ticks: int,
+    max_blocks: jax.Array,
+):
+    """Blocks + convergence test + early exit in ONE dispatch (same shape
+    of fix as the lifecycle engine's ``_run_until_detected_device``: the
+    old host loop paid a dispatch round-trip and — with a fault mask — a
+    dynamically-shaped boolean-index gather + readback per check, which
+    dominated wall-clock through the TPU tunnel)."""
+
+    def cond(carry):
+        _, blocks, done = carry
+        return (~done) & (blocks < max_blocks)
+
+    def body(carry):
+        s, blocks, _ = carry
+        s = jax.lax.fori_loop(0, block_ticks, lambda _, st: step(params, st, faults), s)
+        return s, blocks + jnp.int32(1), converged(s, faults)
+
+    return jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.asarray(False))
+    )
+
+
 def run_until_converged(
     params: DeltaParams,
     state: DeltaState,
@@ -202,28 +240,14 @@ def run_until_converged(
     max_ticks: int = 10_000,
     check_every: int = 8,
 ):
-    """Run jitted blocks of ticks until all rumors reach all live nodes.
-    Returns (state, ticks_used, converged)."""
-
-    @jax.jit
-    def block(s):
-        def body(_, s):
-            return step(params, s, faults)
-
-        return jax.lax.fori_loop(0, check_every, body, s)
-
-    up = faults.up
-    ticks = 0
-    while ticks < max_ticks:
-        state = block(state)
-        ticks += check_every
-        if up is not None:
-            done = bool(state.learned[up].all())
-        else:
-            done = bool(state.learned.all())
-        if done:
-            return state, ticks, True
-    return state, ticks, False
+    """Run blocks of ticks until all rumors reach all live nodes, testing
+    every ``check_every`` ticks on-device.  Returns (state, ticks_used,
+    converged)."""
+    max_blocks = -(-max_ticks // check_every)
+    state, blocks, done = _run_until_converged_device(
+        params, state, faults, block_ticks=check_every, max_blocks=jnp.int32(max_blocks)
+    )
+    return state, int(blocks) * check_every, bool(done)
 
 
 class DeltaSim:

@@ -855,14 +855,15 @@ class LifecycleSim:
                 block_ticks=check_every,
                 max_blocks=jnp.int32(max_blocks),
             )
+            n_blocks = int(blocks)  # blocking readback — completes the dispatch
             now = _time.perf_counter()
-            ticks += int(blocks) * check_every
+            ticks += n_blocks * check_every
             if bool(done):
                 return ticks, True
             if deadline is not None:
                 if now > deadline:
                     break
-                per_block = (now - t0) / max(int(blocks), 1)
+                per_block = (now - t0) / max(n_blocks, 1)
                 bpd = max(
                     1,
                     min(blocks_per_dispatch, int((deadline - now) / max(per_block, 1e-9))),
