@@ -219,6 +219,15 @@ def run_bench() -> None:
     jax.block_until_ready(life.state.learned)
     life_s = time.perf_counter() - t0
 
+    # -- secondary: order-invariant view checksum at headline scale ---------
+    # (SURVEY §7 hard-part #5: the sim-plane checksum is a sum of mixed
+    # member hashes — no sort, O(N·K), one jit)
+    cs = lifecycle.view_checksums(life.state, faults)
+    jax.block_until_ready(cs)  # compile
+    t_cs = time.perf_counter()
+    jax.block_until_ready(lifecycle.view_checksums(life.state, faults))
+    checksum_s = time.perf_counter() - t_cs
+
     # -- secondary: delta rumor convergence ---------------------------------
     sim = DeltaSim(n=n_delta, k=k_delta, seed=0)
     t_c1 = time.perf_counter()
@@ -281,6 +290,7 @@ def run_bench() -> None:
         "delta_vs_baseline": round(baseline_s / delta_s, 2) if delta_s > 0 else 0.0,
         "delta_compile_s": round(delta_compile_s, 2),
         "ring_lookup_qps": round(ring_qps, 0),
+        "view_checksum_s": round(checksum_s, 4),
         "platform": platform,
     }
     print(json.dumps(result))

@@ -695,11 +695,8 @@ def detection_complete(
     ~90% of wall-clock in the HOST-side per-subject detection walk between
     device blocks (~2k tunnel dispatches per check at S=1000).
     """
-    n, k = state.learned.shape
+    n, _ = state.learned.shape
     subjects = jnp.asarray(subjects, jnp.int32)
-
-    active = state.r_subject >= 0
-    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
 
     base_bad = state.base_present & (state.base_status < min_status)  # [N]
     base_key = jnp.where(
@@ -711,8 +708,42 @@ def detection_complete(
     obs = up & ~is_subject
     has_obs = obs.any()
 
-    # slots sorted by (subject asc, key desc); free slots pushed past the end
-    # (lexsort, last key primary — int32-safe: rkey >= -1 so -rkey can't wrap)
+    def finalize(anybad, s, m, fin):
+        bad_any = (obs & (m >= 0) & (_status_of(jnp.maximum(m, 0)) < min_status)).any()
+        return anybad.at[jnp.where(fin, s, n)].set(
+            jnp.where(fin, bad_any, False), mode="drop"
+        )
+
+    anybad = _walk_subject_slots(state, base_key, jnp.zeros(n, bool), finalize)
+    not_detected = jnp.where(
+        _slot_covered(state), anybad, base_bad
+    )[subjects]
+    return has_obs & ~not_detected.any()
+
+
+def _slot_covered(state: LifecycleState) -> jax.Array:
+    """bool[N]: which subject ids have at least one in-flight rumor slot."""
+    n = state.learned.shape[0]
+    active = state.r_subject >= 0
+    return jnp.zeros(n, bool).at[
+        jnp.where(active, state.r_subject, n)
+    ].set(True, mode="drop")
+
+
+def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
+    """The shared O(N·K) per-subject slot walk under ``detection_complete``
+    and ``view_checksums``: iterate the K rumor slots sorted by (subject
+    asc, key desc) — free slots pushed past the end; the lexsort is
+    int32-safe because rkey >= -1 so -rkey can't wrap — maintaining each
+    node's max learned key ``best``; at every step call ``finalize(carry,
+    s, m, fin)`` where ``m[N] = max(best, base_key[s])`` is the per-node
+    governing key for clamped subject id ``s`` and ``fin`` marks the
+    subject's last slot (callbacks must gate their update on ``fin``).
+    Returns the final carry.  Subjects with no in-flight slot never reach
+    ``finalize`` — callers handle them via :func:`_slot_covered`."""
+    n, k = state.learned.shape
+    active = state.r_subject >= 0
+    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
     subj_or_sentinel = jnp.where(active, state.r_subject, jnp.int32(n))
     order = jnp.lexsort((-rkey, subj_or_sentinel))
     sorted_subj = subj_or_sentinel[order]
@@ -722,34 +753,105 @@ def detection_complete(
     )
     learned_sorted = state.learned.T[order]  # [K, N], rows contiguous per slot
 
-    def body(j, carry):
-        best, anybad = carry
+    def body(j, c):
+        best, carry = c
         s = sorted_subj[j]
         valid = s < n
         best = jnp.where(
             learned_sorted[j] & valid, jnp.maximum(best, sorted_key[j]), best
         )
-        # finalize at the subject's last slot: fold in the base, reduce
         m = jnp.maximum(best, base_key[jnp.minimum(s, n - 1)])
-        bad_any = (obs & (m >= 0) & (_status_of(jnp.maximum(m, 0)) < min_status)).any()
         fin = is_last[j] & valid
-        anybad = anybad.at[jnp.where(fin, s, n)].set(
-            jnp.where(fin, bad_any, False), mode="drop"
-        )
+        carry = finalize(carry, jnp.minimum(s, n - 1), m, fin)
         best = jnp.where(fin, jnp.int32(-1), best)
-        return best, anybad
+        return best, carry
 
     best0 = jnp.full(n, -1, jnp.int32)
-    _, anybad = jax.lax.fori_loop(0, k, body, (best0, jnp.zeros(n, bool)))
+    _, carry = jax.lax.fori_loop(0, k, body, (best0, carry0))
+    return carry
 
-    # subjects with no active slot are governed by the base alone
-    slot_covered = jnp.zeros(n, bool).at[
-        jnp.where(active, state.r_subject, n)
-    ].set(True, mode="drop")
-    not_detected = jnp.where(
-        slot_covered[subjects], anybad[subjects], base_bad[subjects]
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32: a full-avalanche integer mixer (public-domain
+    constants).  Used for the order-invariant view checksum below — NOT the
+    wire-compat farm32 (which needs the host's canonical sorted-string
+    encoding, ``memberlist.go:106-128``)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EB_CA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2_AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+@jax.jit
+def view_checksums(
+    state: LifecycleState, faults: DeltaFaults = DeltaFaults()
+) -> jax.Array:
+    """uint32[N], fully ON-DEVICE: an order-invariant checksum of each
+    node's membership view — the sim-plane analog of the reference's
+    memberlist checksum (SURVEY §7 hard-part #5: the canonical
+    sorted-string farm32 is hostile to TPU, so the sim uses a
+    sum-of-mixed-member-hashes that is order-invariant BY CONSTRUCTION
+    and needs no sort; the host plane keeps the exact farm32 encoding for
+    wire compat, ``swim/memberlist.py``).
+
+    Semantics: node i's view of subject s is ``believed_key`` (lattice
+    max of base and learned rumors); its checksum is the wrapping uint32
+    sum of ``mix32(mix32(s) ^ governing_key)`` over every subject present
+    in its view — tombstoned members excluded exactly as the reference
+    excludes them (``memberlist.go:106-128``).  Two nodes agree on their
+    views iff their checksums agree (up to hash collision).  Cost is
+    O(N·K) via the same sorted slot walk as :func:`detection_complete` —
+    subjects with no in-flight rumor contribute one shared scalar term.
+
+    ``faults`` is accepted for signature symmetry with the other queries;
+    a node's own checksum is defined whether or not it is up (the
+    reference's memberlist exists on a stopped node too).
+    """
+    n, k = state.learned.shape
+    del faults
+
+    active = state.r_subject >= 0
+    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+    base_key = jnp.where(
+        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+    )  # [N] indexed by subject id
+
+    def member_term(subject, key):
+        """Contribution of (subject, governing key) — zero when absent or
+        tombstoned (checksum exclusion per the reference)."""
+        include = (key >= 0) & (_status_of(jnp.maximum(key, 0)) != TOMBSTONE)
+        h = _mix32(_mix32(subject.astype(jnp.uint32)) ^ key.astype(jnp.uint32))
+        return jnp.where(include, h, jnp.uint32(0))
+
+    def finalize(acc, s, m, fin):
+        return acc + jnp.where(fin, member_term(s, m), jnp.uint32(0))
+
+    acc = _walk_subject_slots(state, base_key, jnp.zeros(n, jnp.uint32), finalize)
+
+    # subjects with no in-flight rumor are identical in every view: one
+    # shared scalar term
+    i_all = jnp.arange(n, dtype=jnp.int32)
+    base_terms = jnp.where(
+        ~_slot_covered(state), member_term(i_all, base_key), jnp.uint32(0)
     )
-    return has_obs & ~not_detected.any()
+    return acc + base_terms.sum(dtype=jnp.uint32)
+
+
+@jax.jit
+def checksums_converged(
+    state: LifecycleState, faults: DeltaFaults = DeltaFaults()
+) -> jax.Array:
+    """bool scalar, on-device: do all LIVE nodes' view checksums agree?
+    The reference's convergence criterion for protocol tests
+    (``swim/test_utils.go:164-199`` ticks until no changes remain and all
+    checksums agree)."""
+    cs = view_checksums(state, faults)
+    up = faults.up if faults.up is not None else jnp.ones(cs.shape[0], bool)
+    first_live = jnp.argmax(up)
+    return (jnp.where(up, cs, cs[first_live]) == cs[first_live]).all() & up.any()
 
 
 def _run_block(params: LifecycleParams, state, faults, ticks: int):

@@ -218,6 +218,55 @@ def test_detection_complete_matches_fraction():
     assert checked_true > 0, "never reached a detected state — test too weak"
 
 
+def test_view_checksums_match_bruteforce_and_converge():
+    """The O(N·K) slot-walk view checksum must equal the brute-force
+    believed_key-based sum at every state, diverge across nodes while
+    rumors are in flight, and agree across live nodes at quiescence —
+    the reference's all-checksums-agree convergence criterion
+    (swim/test_utils.go:164-199)."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.lifecycle import (
+        TOMBSTONE as TS,
+        _mix32,
+        _status_of,
+        believed_key,
+        checksums_converged,
+        view_checksums,
+    )
+
+    def brute(state):
+        n = state.learned.shape[0]
+        bk = believed_key(state, list(range(n)))  # [N, S=N]
+        include = (bk >= 0) & (_status_of(jnp.maximum(bk, 0)) != TS)
+        subj = jnp.arange(n, dtype=jnp.uint32)[None, :]
+        h = _mix32(_mix32(subj) ^ bk.astype(jnp.uint32))
+        return np.asarray(jnp.where(include, h, jnp.uint32(0)).sum(axis=1, dtype=jnp.uint32))
+
+    n = 72
+    victims = [5, 40, 41]
+    faults = make_faults(n, down=victims, drop=0.05)
+    sim = LifecycleSim(n=n, k=20, seed=7, suspect_ticks=5, alloc_per_tick=8)
+    saw_divergence = False
+    for _ in range(30):
+        sim.run(6, faults)
+        got = np.asarray(view_checksums(sim.state, faults))
+        np.testing.assert_array_equal(got, brute(sim.state))
+        live = np.asarray(faults.up)
+        saw_divergence |= len(np.unique(got[live])) > 1
+    assert saw_divergence, "checksums never diverged mid-protocol — test too weak"
+    # run to quiescence: all victims detected and rumors folded
+    sim.run_until_detected(victims, faults, max_ticks=2000, check_every=16)
+    for _ in range(60):
+        sim.run(8, faults)
+        if bool(checksums_converged(sim.state, faults)):
+            break
+    assert bool(checksums_converged(sim.state, faults))
+    got = np.asarray(view_checksums(sim.state, faults))
+    live = np.asarray(faults.up)
+    assert len(np.unique(got[live])) == 1
+
+
 def test_detection_complete_no_live_observers_is_false():
     """With zero live observers the fraction is 0/1 per subject, so the
     on-device check must report incomplete — a cluster with nobody left to
