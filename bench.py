@@ -215,6 +215,7 @@ def run_bench() -> None:
         max_ticks=4096,
         check_every=check_every,
         time_budget_s=float(os.environ.get("BENCH_TIME_BUDGET_S", "900")),
+        blocks_per_dispatch=8,
     )
     jax.block_until_ready(life.state.learned)
     life_s = time.perf_counter() - t0

@@ -109,3 +109,21 @@ def test_lifecycle_step_on_accel():
     )
     assert ok, f"victim never detected within {ticks} ticks"
     assert ticks <= 256
+    # on-device convergence queries compile and agree on hardware too
+    assert bool(lifecycle.detection_complete(sim.state, [7], faults))
+    # run on until in-flight rumors fold, then every live view must agree
+    for _ in range(40):
+        if bool(lifecycle.checksums_converged(sim.state, faults)):
+            break
+        sim.run(16, faults)
+    assert bool(lifecycle.checksums_converged(sim.state, faults))
+    cs = np.asarray(lifecycle.view_checksums(sim.state, faults))
+    assert len(np.unique(cs[up])) == 1
+
+
+def test_delta_convergence_on_accel():
+    from ringpop_tpu.sim.delta import DeltaSim
+
+    sim = DeltaSim(n=50_000, k=64, seed=0)
+    ticks, ok = sim.run_until_converged(max_ticks=1024)
+    assert ok and ticks <= 1024
