@@ -61,6 +61,67 @@ def load_state(path: str, cls: Type[T]) -> T:
         return cls(**{f: jnp.asarray(data[f]) for f in cls._fields})
 
 
+# -- orbax backend (optional): async, non-blocking saves ---------------------
+
+
+def save_state_orbax(path: str, state, wait: bool = False):
+    """Checkpoint via orbax's AsyncCheckpointer: the device→host transfer
+    happens synchronously but serialization/IO proceed in a background
+    thread, so a long-running sim can keep stepping while the snapshot
+    writes (the npz path above blocks ~seconds at 100k+ nodes).  With
+    ``wait=True`` the write is completed and the checkpointer closed
+    before returning (returns None).  Otherwise returns the live
+    checkpointer — the caller owns it: call ``.wait_until_finished()``
+    then ``.close()`` when done.  ``path`` must be a directory path
+    (orbax layout), absolute or relative."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    ckptr.save(
+        os.path.abspath(path),
+        args=ocp.args.StandardSave({f: v for f, v in zip(state._fields, state)}),
+        force=True,
+    )
+    if wait:
+        ckptr.wait_until_finished()
+        ckptr.close()
+        return None
+    return ckptr
+
+
+def load_state_orbax(path: str, cls: Type[T], example: T) -> T:
+    """Restore a :func:`save_state_orbax` checkpoint into ``cls``, using
+    ``example`` (any state of the right shapes/dtypes, e.g. a fresh
+    ``init_state``) as the abstract restore target.  Validation is
+    structural: the stored tree must match ``cls``'s field names (orbax
+    raises) and each array's shape/dtype (checked explicitly below)."""
+    import os
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    target = {
+        f: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+        for f, v in zip(example._fields, example)
+    }
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        data = ckptr.restore(os.path.abspath(path), args=ocp.args.StandardRestore(target))
+    # NOT dead code: this orbax version's StandardRestore was observed to
+    # restore a checkpoint whose shapes differ from the target without
+    # raising (tests/test_snapshot.py::test_orbax_shape_mismatch_raises
+    # fails "DID NOT RAISE" without this loop) — validate explicitly.
+    for f, want in target.items():
+        got = data[f]
+        if np.shape(got) != want.shape or got.dtype != want.dtype:
+            raise ValueError(
+                f"{path}: field {f!r} is {np.shape(got)}/{np.asarray(got).dtype}, "
+                f"expected {want.shape}/{want.dtype} — wrong engine config?"
+            )
+    return cls(**data)
+
+
 # -- host-plane membership export/import -------------------------------------
 
 
