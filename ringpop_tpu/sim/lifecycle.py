@@ -858,6 +858,42 @@ def _run_block(params: LifecycleParams, state, faults, ticks: int):
     return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
 
 
+@functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
+def _run_until_converged_device(
+    params: LifecycleParams,
+    state: LifecycleState,
+    faults: DeltaFaults,
+    *,
+    block_ticks: int,
+    max_blocks: jax.Array,
+):
+    """Blocks + convergence test + early exit in one dispatch (the
+    lifecycle analog of ``delta._run_until_converged_device``).
+    Convergence mirrors the reference's ``waitForConvergence``: NO changes
+    remain in flight (no active rumor slots) AND all live checksums agree
+    (``swim/test_utils.go:164-199`` — it ticks until the disseminators are
+    empty and the checksums match).  Returns (state, blocks_run,
+    converged)."""
+
+    def quiescent(s):
+        return ~(s.r_subject >= 0).any() & checksums_converged(s, faults)
+
+    def cond(carry):
+        _, blocks, done = carry
+        return (~done) & (blocks < max_blocks)
+
+    def body(carry):
+        s, blocks, _ = carry
+        s = _run_block(params, s, faults, block_ticks)
+        return s, blocks + jnp.int32(1), quiescent(s)
+
+    # seed the flag with the current state so an already-quiescent cluster
+    # reports 0 blocks instead of stepping once
+    return jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), quiescent(state))
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("params", "min_status", "block_ticks")
 )
@@ -912,6 +948,37 @@ class LifecycleSim:
     def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
         self.state = self._block(self.state, faults, ticks=ticks)
         return self.state
+
+    def run_until_converged(
+        self,
+        faults: DeltaFaults = DeltaFaults(),
+        max_ticks: int = 5000,
+        check_every: int = 8,
+        blocks_per_dispatch: int = 4,
+    ):
+        """Tick until every live node's view checksum agrees — the
+        reference's convergence criterion for protocol tests
+        (``swim/test_utils.go:164-199``), run on-device with early exit.
+        Returns (ticks_used, converged); 0 ticks if already quiescent (the
+        check itself runs even with a zero/exhausted budget, without
+        stepping)."""
+        ticks = 0
+        while True:
+            max_blocks = min(
+                blocks_per_dispatch, max(0, (max_ticks - ticks) // check_every)
+            )
+            self.state, blocks, done = _run_until_converged_device(
+                self.params,
+                self.state,
+                faults,
+                block_ticks=check_every,
+                max_blocks=jnp.int32(max_blocks),
+            )
+            ticks += int(blocks) * check_every
+            if bool(done):
+                return ticks, True
+            if max_blocks == 0 or ticks + check_every > max_ticks:
+                return ticks, False
 
     def run_until_detected(
         self,

@@ -64,44 +64,58 @@ def load_state(path: str, cls: Type[T]) -> T:
 # -- orbax backend (optional): async, non-blocking saves ---------------------
 
 
-def save_state_orbax(path: str, state, wait: bool = False):
+def save_state_orbax(path: str, state, wait: bool = False, checkpointer=None):
     """Checkpoint via orbax's AsyncCheckpointer: the device→host transfer
     happens synchronously but serialization/IO proceed in a background
     thread, so a long-running sim can keep stepping while the snapshot
-    writes (the npz path above blocks ~seconds at 100k+ nodes).  With
-    ``wait=True`` the write is completed and the checkpointer closed
-    before returning (returns None).  Otherwise returns the live
-    checkpointer — the caller owns it: call ``.wait_until_finished()``
-    then ``.close()`` when done.  ``path`` must be a directory path
-    (orbax layout), absolute or relative."""
+    writes (the npz path above blocks ~seconds at 100k+ nodes).
+
+    Pass ``checkpointer`` to reuse one AsyncCheckpointer across periodic
+    snapshots (orbax's intended pattern); the caller then owns its
+    lifecycle.  Without it, one is constructed here: with ``wait=True``
+    the write completes and the checkpointer closes before returning
+    (returns None); otherwise the returned checkpointer is the caller's to
+    ``.wait_until_finished()`` and ``.close()``.  Construction never leaks
+    on failure.  ``path`` must be a directory path (orbax layout)."""
     import os
 
     import orbax.checkpoint as ocp
 
-    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-    ckptr.save(
-        os.path.abspath(path),
-        args=ocp.args.StandardSave({f: v for f, v in zip(state._fields, state)}),
-        force=True,
+    own = checkpointer is None
+    ckptr = checkpointer if checkpointer is not None else ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler()
     )
-    if wait:
-        ckptr.wait_until_finished()
+    try:
+        ckptr.save(
+            os.path.abspath(path),
+            args=ocp.args.StandardSave(state._asdict()),
+            force=True,
+        )
+        if wait:
+            ckptr.wait_until_finished()
+    except BaseException:
+        if own:
+            ckptr.close()
+        raise
+    if wait and own:
         ckptr.close()
         return None
     return ckptr
 
 
-def load_state_orbax(path: str, cls: Type[T], example: T) -> T:
-    """Restore a :func:`save_state_orbax` checkpoint into ``cls``, using
-    ``example`` (any state of the right shapes/dtypes, e.g. a fresh
+def load_state_orbax(path: str, example: T) -> T:
+    """Restore a :func:`save_state_orbax` checkpoint into ``type(example)``,
+    using ``example`` (any state of the right shapes/dtypes, e.g. a fresh
     ``init_state``) as the abstract restore target.  Validation is
-    structural: the stored tree must match ``cls``'s field names (orbax
+    structural: the stored tree must match the example's field names (orbax
     raises) and each array's shape/dtype (checked explicitly below)."""
     import os
 
     import jax
+    import jax.numpy as jnp
     import orbax.checkpoint as ocp
 
+    cls = type(example)
     target = {
         f: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
         for f, v in zip(example._fields, example)
@@ -119,7 +133,9 @@ def load_state_orbax(path: str, cls: Type[T], example: T) -> T:
                 f"{path}: field {f!r} is {np.shape(got)}/{np.asarray(got).dtype}, "
                 f"expected {want.shape}/{want.dtype} — wrong engine config?"
             )
-    return cls(**data)
+    # orbax restores sharding-less targets as np.ndarray; convert so the
+    # result behaves like every other state (e.g. .at[] updates)
+    return cls(**{f: jnp.asarray(v) for f, v in data.items()})
 
 
 # -- host-plane membership export/import -------------------------------------

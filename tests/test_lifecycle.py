@@ -267,6 +267,42 @@ def test_view_checksums_match_bruteforce_and_converge():
     assert len(np.unique(got[live])) == 1
 
 
+def test_run_until_converged_quiescence():
+    """The checksum-convergence runner: 0 ticks on an already-quiescent
+    cluster; after a crash it runs until every live view agrees (which
+    implies the victim was detected and the rumors folded)."""
+    from ringpop_tpu.sim.lifecycle import detection_complete
+
+    sim = LifecycleSim(n=48, k=12, seed=2, suspect_ticks=5)
+    ticks, ok = sim.run_until_converged()
+    assert ok and ticks == 0
+
+    # crash a node and let the protocol notice (a suspicion allocates);
+    # then convergence = rumors drained + all live views agree, which for a
+    # dead victim implies detection happened along the way (the reference's
+    # tests likewise act first, then waitForConvergence)
+    faults = make_faults(48, down=[9])
+    warm = 0
+    while not bool((np.asarray(sim.state.r_subject) >= 0).any()):
+        sim.run(2, faults)
+        warm += 2
+        assert warm < 100, "no suspicion ever allocated"
+    # zero budget: the check runs but the sim must not advance
+    t_before = int(sim.state.tick)
+    zticks, zok = sim.run_until_converged(faults, max_ticks=0)
+    assert zticks == 0 and not zok and int(sim.state.tick) == t_before
+
+    ticks, ok = sim.run_until_converged(faults, max_ticks=2000, check_every=8)
+    assert ok and ticks > 0
+    assert not (np.asarray(sim.state.r_subject) >= 0).any()
+    # quiescence may legitimately land while the victim is still only
+    # Suspect in every view (faulty timer pending on the base); full
+    # detection still follows
+    dticks, dok = sim.run_until_detected([9], faults, max_ticks=2000, check_every=8)
+    assert dok
+    assert bool(detection_complete(sim.state, [9], faults))
+
+
 def test_detection_complete_no_live_observers_is_false():
     """With zero live observers the fraction is 0/1 per subject, so the
     on-device check must report incomplete — a cluster with nobody left to

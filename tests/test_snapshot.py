@@ -76,7 +76,7 @@ def test_orbax_async_roundtrip_bitexact(tmp_path):
     ckptr.close()
 
     example = lifecycle.init_state(params, seed=0)
-    resumed = load_state_orbax(path, lifecycle.LifecycleState, example)
+    resumed = load_state_orbax(path, example)
     assert _trees_equal(resumed, snap)
     for _ in range(5):
         resumed = lifecycle.step(params, resumed)
@@ -93,7 +93,7 @@ def test_orbax_shape_mismatch_raises(tmp_path):
     save_state_orbax(path, state, wait=True)
     wrong = lifecycle.init_state(lifecycle.LifecycleParams(n=32, k=8), seed=0)
     with pytest.raises(ValueError, match="wrong engine config"):
-        load_state_orbax(path, lifecycle.LifecycleState, wrong)
+        load_state_orbax(path, wrong)
 
 
 def test_type_and_field_validation(tmp_path):
