@@ -150,6 +150,12 @@ def bench_loss1k(seed: int, full: bool) -> dict:
     t0 = time.perf_counter()
     ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
     elapsed = time.perf_counter() - t0
+    # continue to full quiescence: rumors drained + every live view
+    # checksum agrees (the reference's waitForConvergence criterion) —
+    # only meaningful when detection actually completed
+    conv_ticks, conv_ok = (
+        sim.run_until_converged(faults, max_ticks=4000) if ok else (None, False)
+    )
     return {
         "metric": "lifecycle_1k_5pct_loss_detection",
         "value": round(elapsed, 3),
@@ -158,6 +164,8 @@ def bench_loss1k(seed: int, full: bool) -> dict:
         "sim_seconds": round(ticks * sim.params.tick_ms / 1000, 1),
         "detected": ok,
         "n_victims": len(victims),
+        "quiescence_ticks_after_detect": conv_ticks,
+        "quiesced": conv_ok,
     }
 
 

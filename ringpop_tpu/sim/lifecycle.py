@@ -858,6 +858,24 @@ def _run_block(params: LifecycleParams, state, faults, ticks: int):
     return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
 
 
+def _until_loop(params, state, faults, block_ticks, max_blocks, pred):
+    """Shared chunked-dispatch machinery for the device runners below:
+    while_loop of up-to-``max_blocks`` blocks with ``pred`` tested between
+    blocks AND on entry (an already-satisfied predicate reports 0 blocks
+    without stepping).  ``pred(state) -> bool scalar`` must be jit-safe."""
+
+    def cond(carry):
+        _, blocks, done = carry
+        return (~done) & (blocks < max_blocks)
+
+    def body(carry):
+        s, blocks, _ = carry
+        s = _run_block(params, s, faults, block_ticks)
+        return s, blocks + jnp.int32(1), pred(s)
+
+    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), pred(state)))
+
+
 @functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
 def _run_until_converged_device(
     params: LifecycleParams,
@@ -878,20 +896,7 @@ def _run_until_converged_device(
     def quiescent(s):
         return ~(s.r_subject >= 0).any() & checksums_converged(s, faults)
 
-    def cond(carry):
-        _, blocks, done = carry
-        return (~done) & (blocks < max_blocks)
-
-    def body(carry):
-        s, blocks, _ = carry
-        s = _run_block(params, s, faults, block_ticks)
-        return s, blocks + jnp.int32(1), quiescent(s)
-
-    # seed the flag with the current state so an already-quiescent cluster
-    # reports 0 blocks instead of stepping once
-    return jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), quiescent(state))
-    )
+    return _until_loop(params, state, faults, block_ticks, max_blocks, quiescent)
 
 
 @functools.partial(
@@ -909,22 +914,14 @@ def _run_until_detected_device(
 ):
     """Up to ``max_blocks`` blocks of ``block_ticks`` ticks with the
     detection test INSIDE the jitted loop — one dispatch, one readback.
-    Returns (state, blocks_run, detected).  ``max_blocks`` is traced (not
-    static) so varying final-chunk sizes reuse one compilation."""
+    Returns (state, blocks_run, detected); 0 blocks when the subjects are
+    already detected on entry.  ``max_blocks`` is traced (not static) so
+    varying final-chunk sizes reuse one compilation."""
 
-    def cond(carry):
-        _, blocks, done = carry
-        return (~done) & (blocks < max_blocks)
+    def detected(s):
+        return detection_complete(s, subjects, faults, min_status)
 
-    def body(carry):
-        s, blocks, _ = carry
-        s = _run_block(params, s, faults, block_ticks)
-        done = detection_complete(s, subjects, faults, min_status)
-        return s, blocks + jnp.int32(1), done
-
-    return jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.asarray(False))
-    )
+    return _until_loop(params, state, faults, block_ticks, max_blocks, detected)
 
 
 class LifecycleSim:
