@@ -1,0 +1,72 @@
+"""Example: one full failure-detection study on the sim plane.
+
+The lifecycle engine's workflow end-to-end, CPU-sized (runs in seconds):
+
+1. crash 1% of a 4096-node simulated cluster;
+2. run until every live observer believes every victim faulty — the
+   detection loop and its test run on-device (one dispatch per few blocks);
+3. keep running until quiescence: no rumors in flight and every live
+   node's order-invariant view checksum agrees (the reference's
+   waitForConvergence criterion, ``swim/test_utils.go:164-199``);
+4. snapshot the converged cluster and prove the restore is bit-exact —
+   a capability the soft-state reference cannot offer.
+
+    python examples/failure_study.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if not os.environ.get("KEEP_PLATFORM"):
+    # this example is CPU-sized; pin before backend init (see PERF.md)
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from ringpop_tpu.sim import lifecycle
+from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.sim.snapshot import load_state, save_state
+
+
+def main():
+    n = 4096
+    sim = lifecycle.LifecycleSim(n=n, k=64, seed=0, suspect_ticks=25)
+
+    rng = np.random.default_rng(0)
+    victims = np.sort(rng.choice(n, size=n // 100, replace=False))
+    up = np.ones(n, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jax.numpy.asarray(up), drop_rate=0.02)
+    print(f"crashing {len(victims)} of {n} nodes (2% packet loss)...")
+
+    ticks, ok = sim.run_until_detected(victims, faults, max_ticks=2000, check_every=16)
+    sim_s = ticks * sim.params.tick_ms / 1000
+    print(f"  detected by every live observer: {ok} after {ticks} ticks "
+          f"({sim_s:.1f}s of simulated protocol time)")
+
+    q_ticks, q_ok = sim.run_until_converged(faults, max_ticks=2000, check_every=16)
+    print(f"  quiescent (rumors drained, all live view checksums agree): "
+          f"{q_ok} after {q_ticks} more ticks")
+
+    if q_ok:
+        cs = np.asarray(lifecycle.view_checksums(sim.state, faults))
+        print(f"  shared live-view checksum: 0x{cs[up][0]:08x}")
+    else:
+        print("  (no shared checksum — convergence budget exhausted)")
+
+    path = "/tmp/failure_study_snapshot.npz"
+    save_state(path, sim.state)
+    resumed = load_state(path, lifecycle.LifecycleState)
+    same = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(resumed, sim.state)
+    )
+    print(f"  snapshot -> restore bit-exact: {same} ({path})")
+
+
+if __name__ == "__main__":
+    main()

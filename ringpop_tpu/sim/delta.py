@@ -204,6 +204,26 @@ def converged(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Arr
     return (state.learned | ~faults.up[:, None]).all()
 
 
+def until_loop(run_block, state, max_blocks, pred):
+    """Shared chunked-dispatch machinery for every engine's device runner
+    (delta here; lifecycle's detected/converged runners import it):
+    while_loop of up-to-``max_blocks`` blocks (``run_block(state) ->
+    state``) with ``pred(state) -> bool scalar`` tested between blocks AND
+    on entry — an already-satisfied predicate reports 0 blocks without
+    stepping.  Both callbacks must be jit-safe."""
+
+    def cond(carry):
+        _, blocks, done = carry
+        return (~done) & (blocks < max_blocks)
+
+    def body(carry):
+        s, blocks, _ = carry
+        s = run_block(s)
+        return s, blocks + jnp.int32(1), pred(s)
+
+    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), pred(state)))
+
+
 @functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
 def _run_until_converged_device(
     params: DeltaParams,
@@ -219,18 +239,12 @@ def _run_until_converged_device(
     dynamically-shaped boolean-index gather + readback per check, which
     dominated wall-clock through the TPU tunnel)."""
 
-    def cond(carry):
-        _, blocks, done = carry
-        return (~done) & (blocks < max_blocks)
+    def run_block(s):
+        return jax.lax.fori_loop(
+            0, block_ticks, lambda _, st: step(params, st, faults), s
+        )
 
-    def body(carry):
-        s, blocks, _ = carry
-        s = jax.lax.fori_loop(0, block_ticks, lambda _, st: step(params, st, faults), s)
-        return s, blocks + jnp.int32(1), converged(s, faults)
-
-    return jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.asarray(False))
-    )
+    return until_loop(run_block, state, max_blocks, lambda s: converged(s, faults))
 
 
 def run_until_converged(

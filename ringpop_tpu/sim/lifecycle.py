@@ -68,6 +68,7 @@ from ringpop_tpu.sim.delta import (
     DeltaFaults,
     pair_connected as _pair_connected,
     resolve_max_p,
+    until_loop,
 )
 from ringpop_tpu.swim.member import (
     ALIVE,
@@ -858,24 +859,6 @@ def _run_block(params: LifecycleParams, state, faults, ticks: int):
     return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
 
 
-def _until_loop(params, state, faults, block_ticks, max_blocks, pred):
-    """Shared chunked-dispatch machinery for the device runners below:
-    while_loop of up-to-``max_blocks`` blocks with ``pred`` tested between
-    blocks AND on entry (an already-satisfied predicate reports 0 blocks
-    without stepping).  ``pred(state) -> bool scalar`` must be jit-safe."""
-
-    def cond(carry):
-        _, blocks, done = carry
-        return (~done) & (blocks < max_blocks)
-
-    def body(carry):
-        s, blocks, _ = carry
-        s = _run_block(params, s, faults, block_ticks)
-        return s, blocks + jnp.int32(1), pred(s)
-
-    return jax.lax.while_loop(cond, body, (state, jnp.int32(0), pred(state)))
-
-
 @functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
 def _run_until_converged_device(
     params: LifecycleParams,
@@ -896,7 +879,9 @@ def _run_until_converged_device(
     def quiescent(s):
         return ~(s.r_subject >= 0).any() & checksums_converged(s, faults)
 
-    return _until_loop(params, state, faults, block_ticks, max_blocks, quiescent)
+    return until_loop(
+        lambda s: _run_block(params, s, faults, block_ticks), state, max_blocks, quiescent
+    )
 
 
 @functools.partial(
@@ -921,7 +906,9 @@ def _run_until_detected_device(
     def detected(s):
         return detection_complete(s, subjects, faults, min_status)
 
-    return _until_loop(params, state, faults, block_ticks, max_blocks, detected)
+    return until_loop(
+        lambda s: _run_block(params, s, faults, block_ticks), state, max_blocks, detected
+    )
 
 
 class LifecycleSim:
@@ -1009,8 +996,11 @@ class LifecycleSim:
         bpd = 1 if deadline is not None else blocks_per_dispatch
         subjects = jnp.asarray(list(subjects), jnp.int32)
         ticks = 0
-        while ticks < max_ticks:
-            max_blocks = min(bpd, max(1, (max_ticks - ticks) // check_every))
+        while True:
+            # a zero/exhausted budget still dispatches once with 0 blocks:
+            # the entry check runs without stepping, so an already-detected
+            # state reports (0, True) instead of a false negative
+            max_blocks = min(bpd, max(0, (max_ticks - ticks) // check_every))
             t0 = _time.perf_counter()
             self.state, blocks, done = _run_until_detected_device(
                 self.params,
@@ -1026,12 +1016,13 @@ class LifecycleSim:
             ticks += n_blocks * check_every
             if bool(done):
                 return ticks, True
+            if max_blocks == 0 or ticks + check_every > max_ticks:
+                return ticks, False
             if deadline is not None:
                 if now > deadline:
-                    break
+                    return ticks, False
                 per_block = (now - t0) / max(n_blocks, 1)
                 bpd = max(
                     1,
                     min(blocks_per_dispatch, int((deadline - now) / max(per_block, 1e-9))),
                 )
-        return ticks, False

@@ -76,6 +76,11 @@ def _mc_run_until_device(
     the pattern 1M-bench profiling showed costing ~90% of wall-clock.
     Returns (states, first_block[B] (-1 = never), blocks_run)."""
 
+    def vdone(states):
+        return jax.vmap(
+            lambda s: detection_complete(s, subjects, faults, min_status)
+        )(states)
+
     def cond(carry):
         _, blocks, first = carry
         return (first < 0).any() & (blocks < max_blocks)
@@ -83,17 +88,15 @@ def _mc_run_until_device(
     def body(carry):
         states, blocks, first = carry
         states = _mc_block(params, states, faults, block_ticks)
-        done = jax.vmap(
-            lambda s: detection_complete(s, subjects, faults, min_status)
-        )(states)
         blocks = blocks + jnp.int32(1)
-        first = jnp.where((first < 0) & done, blocks, first)
+        first = jnp.where((first < 0) & vdone(states), blocks, first)
         return states, blocks, first
 
+    # entry check keeps tick-for-tick equivalence with LifecycleSim's
+    # runner, which reports 0 ticks on an already-detected state
     b = jax.tree.leaves(states)[0].shape[0]
-    return jax.lax.while_loop(
-        cond, body, (states, jnp.int32(0), jnp.full(b, -1, jnp.int32))
-    )
+    first0 = jnp.where(vdone(states), jnp.int32(0), jnp.int32(-1))
+    return jax.lax.while_loop(cond, body, (states, jnp.int32(0), first0))
 
 
 class MonteCarlo:

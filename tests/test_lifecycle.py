@@ -301,6 +301,11 @@ def test_run_until_converged_quiescence():
     dticks, dok = sim.run_until_detected([9], faults, max_ticks=2000, check_every=8)
     assert dok
     assert bool(detection_complete(sim.state, [9], faults))
+    # already-detected: the entry check answers truthfully without
+    # stepping, even on a zero budget
+    t_before = int(sim.state.tick)
+    again = sim.run_until_detected([9], faults, max_ticks=0, check_every=8)
+    assert again == (0, True) and int(sim.state.tick) == t_before
 
 
 def test_detection_complete_no_live_observers_is_false():
