@@ -33,6 +33,51 @@ import sys
 import time
 
 
+def _watcher_capture() -> dict | None:
+    """A TPU bench result captured earlier by the round's tunnel watcher.
+
+    The axon tunnel is alive only in windows; a watcher loop probes all
+    round and runs the full bench the moment the chip revives, saving the
+    JSON (with a capture timestamp) to ``.tpu_bench_result.json``.  When
+    the driver's own run lands in a dead window and falls back to CPU,
+    that capture rides along under this clearly-labelled key — auxiliary
+    evidence of on-chip behavior, never a substitute for the ``platform``
+    field of the current run."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tpu_bench_result.json")
+    try:
+        with open(path) as f:
+            cap = json.load(f)
+    except (OSError, ValueError):
+        # ValueError covers JSONDecodeError AND UnicodeDecodeError from a
+        # torn concurrent write by the watcher — never crash the artifact
+        return None
+    if not (isinstance(cap, dict) and "result" in cap):
+        return None
+    # staleness guards: a capture from an older round (different code, or
+    # simply old) must not read as evidence for the current tree
+    try:
+        cap["age_hours"] = round((time.time() - os.path.getmtime(path)) / 3600.0, 1)
+    except OSError:
+        cap["age_hours"] = None
+    try:
+        head = subprocess.run(
+            ["git", "-C", os.path.dirname(path), "rev-parse", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        head = None
+    cap["git_head_now"] = head
+    cap["same_code"] = (
+        bool(head) and cap.get("git_head") == head if cap.get("git_head") else None
+    )
+    cap["stale"] = bool(cap["age_hours"] is not None and cap["age_hours"] > 20.0) or (
+        cap["same_code"] is False
+    )
+    return cap
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD"):
         run_bench()
@@ -40,9 +85,12 @@ def main() -> None:
 
     from ringpop_tpu.util.accel import probe_accelerator
 
+    # one quick + one patient attempt (a cold tunnel can be slow-but-alive).
+    # Continuous probing is the round watcher's job (see _watcher_capture);
+    # burning 330s here, as the round-2 artifact did, buys nothing.
     probe_timeouts = tuple(
         float(t)
-        for t in os.environ.get("BENCH_PROBE_TIMEOUTS_S", "90,240").split(",")
+        for t in os.environ.get("BENCH_PROBE_TIMEOUTS_S", "75,150").split(",")
     )
     probe = probe_accelerator(timeouts_s=probe_timeouts)
     fallback_reason = None if probe["alive"] else probe["reason"]
@@ -93,6 +141,8 @@ def main() -> None:
                 if result.get("platform") == "cpu" and probe["alive"] is False
                 else (failures[-1] if failures else fallback_reason)
             )
+            if result.get("platform") == "cpu":
+                result["tpu_watcher_capture"] = _watcher_capture()
             print(json.dumps(result))
             return
         tail = (r.stderr or "").strip().splitlines()[-3:]
@@ -100,17 +150,21 @@ def main() -> None:
             f"{platform_pin or 'accel'}: rc={r.returncode} {' | '.join(tail)[-300:]}"
         )
 
-    # both attempts failed — still emit one diagnostic JSON line
+    # both attempts failed — still emit one diagnostic JSON line.
+    # vs_baseline is null (not 0.0): null means "no comparable number",
+    # and this is the one path where a watcher capture may be the only
+    # on-chip evidence, so it rides along here too.
     print(
         json.dumps(
             {
                 "metric": "swim_lifecycle_detect",
                 "value": None,
                 "unit": "s",
-                "vs_baseline": 0.0,
+                "vs_baseline": None,
                 "ok": False,
                 "probe": probe,
                 "failures": failures,
+                "tpu_watcher_capture": _watcher_capture(),
             }
         )
     )
@@ -261,8 +315,11 @@ def run_bench() -> None:
     def _qps_loop(tokens, owners, hashes):
         def body(i, acc):
             out = ring_lookup(tokens, owners, hashes + i.astype(hashes.dtype))
-            return acc + out.sum()
-        return jax.lax.fori_loop(0, 10, body, jax.numpy.int32(0))
+            # uint32 accumulation END TO END: the sum only defeats dead-code
+            # elimination, and 1M owner indices (mean ~2048) overflow int32
+            # inside the reduction itself, so cast before summing
+            return acc + out.astype(jax.numpy.uint32).sum()
+        return jax.lax.fori_loop(0, 10, body, jax.numpy.uint32(0))
 
     jax.block_until_ready(_qps_loop(tokens, owners, hashes))  # compile
     t_r = time.perf_counter()
@@ -270,11 +327,19 @@ def run_bench() -> None:
     ring_qps = batch * 10 / (time.perf_counter() - t_r)
 
     baseline_s = 60.0  # BASELINE.json north star
+    baseline_n = 1_000_000
+    # vs_baseline is only honest when the metric's scale matches the
+    # baseline's (1M nodes): a 100k detection time divided into the 1M
+    # target would *shrink* at true scale.  At mismatched scale the ratio
+    # moves to vs_baseline_at_reduced_scale and vs_baseline is null.
+    at_scale = n_life == baseline_n
+    ratio = round(baseline_s / life_s, 2) if life_s > 0 else 0.0
     result = {
         "metric": f"swim_lifecycle_detect_n{n_life}",
         "value": round(life_s, 4),
         "unit": "s",
-        "vs_baseline": round(baseline_s / life_s, 2) if life_s > 0 else 0.0,
+        "vs_baseline": ratio if at_scale else None,
+        "vs_baseline_at_reduced_scale": None if at_scale else ratio,
         "detected": life_ok,
         "ticks": life_ticks,
         "sim_time_s": round(life_ticks * 0.2, 1),  # 200ms protocol periods
@@ -288,7 +353,13 @@ def run_bench() -> None:
         "delta_n_rumors": k_delta,
         "delta_ticks": d_ticks,
         "delta_converged": d_ok,
-        "delta_vs_baseline": round(baseline_s / delta_s, 2) if delta_s > 0 else 0.0,
+        # same scale-honesty rule as the headline: a ratio against the 1M
+        # baseline only when delta actually ran at 1M
+        "delta_vs_baseline": (
+            (round(baseline_s / delta_s, 2) if delta_s > 0 else 0.0)
+            if n_delta == baseline_n
+            else None
+        ),
         "delta_compile_s": round(delta_compile_s, 2),
         "ring_lookup_qps": round(ring_qps, 0),
         "view_checksum_s": round(checksum_s, 4),

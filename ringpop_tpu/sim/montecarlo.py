@@ -74,7 +74,8 @@ def _mc_run_until_device(
     has detected.  Same shape of fix as ``_run_until_detected_device`` —
     the host-side per-replica ``detection_fraction`` walk this replaces was
     the pattern 1M-bench profiling showed costing ~90% of wall-clock.
-    Returns (states, first_block[B] (-1 = never), blocks_run)."""
+    Returns (states, blocks_run, first_block[B] (-1 = never)) — the order
+    of the while_loop carry."""
 
     def vdone(states):
         return jax.vmap(

@@ -1,0 +1,138 @@
+"""Capture golden per-tick lifecycle-engine trajectories.
+
+Run offline (``python tests/capture_lifecycle_golden.py``) to freeze the
+engine's exact state evolution — every field, every tick — for a set of
+configs spanning the protocol surface: both exchange topologies, packet
+loss, partitions + heal, the full suspect→faulty→tombstone→evict chain,
+slot saturation, K>32 and K<32 tails, heal_prob on/off, and a mid-run
+``admit``.  ``tests/test_lifecycle_golden.py`` replays these and asserts
+bit-identical states, which is what lets the engine's internal
+representation be restructured for speed (e.g. the round-3 bitpacked
+``learned``) with proof that the protocol semantics — PRNG draw order
+included — did not move at all.
+
+The reference's analog is the tier-3 conformance suite pinning protocol
+behavior across implementations (``test/run-integration-tests``); here the
+"other implementation" is the engine's own past self.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from ringpop_tpu.sim.delta import DeltaFaults  # noqa: E402
+from ringpop_tpu.sim import lifecycle  # noqa: E402
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "lifecycle_traj.npz")
+
+# Each config: (name, params-kwargs, fault schedule, admit schedule).
+# The fault schedule is [(first_tick, faults_kwargs)] — the entry with the
+# largest first_tick <= t applies at tick t.  Admits happen BEFORE the
+# given tick's step.
+CONFIGS = [
+    (
+        "crash_shift",
+        dict(n=64, k=32, suspect_ticks=10),
+        [(0, dict(down=[7]))],
+        {},
+        80,
+        1,
+    ),
+    (
+        "partition_drop_heal",
+        dict(n=48, k=12, suspect_ticks=6),
+        [(0, dict(group=[1 if i < 10 else 0 for i in range(48)], drop=0.05)), (50, dict())],
+        {},
+        100,
+        2,
+    ),
+    (
+        "full_chain_uniform",
+        dict(n=40, k=20, exchange="uniform", suspect_ticks=5, faulty_ticks=8, tombstone_ticks=6),
+        [(0, dict(down=[3, 11]))],
+        {},
+        150,
+        3,
+    ),
+    (
+        "saturation",
+        dict(n=24, k=2, suspect_ticks=4, alloc_per_tick=2),
+        [(0, dict(down=[1, 2, 3]))],
+        {},
+        120,
+        11,
+    ),
+    (
+        "evict_readmit_tail48",
+        dict(n=32, k=48, suspect_ticks=4, faulty_ticks=6, tombstone_ticks=6),
+        [(0, dict(down=[9])), (100, dict())],
+        {100: 9},
+        160,
+        17,
+    ),
+    (
+        "no_heal_prob",
+        dict(n=16, k=8, suspect_ticks=4, heal_prob=0.0),
+        [(0, dict(down=[2]))],
+        {},
+        60,
+        5,
+    ),
+]
+
+
+def make_faults(n, down=(), group=None, drop=0.0):
+    up = np.ones(n, bool)
+    for i in down:
+        up[i] = False
+    g = None if group is None else jnp.asarray(group, jnp.int32)
+    return DeltaFaults(up=jnp.asarray(up), group=g, drop_rate=drop)
+
+
+def run_config(pkw, fault_sched, admits, ticks, seed):
+    import functools
+
+    params = lifecycle.LifecycleParams(**pkw)
+    state = lifecycle.init_state(params, seed=seed)
+    # jit changes nothing semantically (same trace) but replaying ~700
+    # eager ticks costs 10x the wall time in op dispatch; recompiles only
+    # when the fault schedule changes the pytree structure
+    stepper = jax.jit(functools.partial(lifecycle.step, params))
+    frames = []
+    for t in range(ticks):
+        if t in admits:
+            state = lifecycle.admit(params, state, admits[t])
+        fkw = max((e for e in fault_sched if e[0] <= t), key=lambda e: e[0])[1]
+        state = stepper(state, make_faults(params.n, **fkw))
+        frames.append({f: np.asarray(getattr(state, f)) for f in state._fields})
+    return {
+        f: np.stack([fr[f] for fr in frames]) for f in frames[0]
+    }
+
+
+def main() -> None:
+    out = {}
+    for name, pkw, fault_sched, admits, ticks, seed in CONFIGS:
+        print(f"capturing {name} ...", flush=True)
+        traj = run_config(pkw, fault_sched, admits, ticks, seed)
+        for f, arr in traj.items():
+            out[f"{name}/{f}"] = arr
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    np.savez_compressed(GOLDEN_PATH, **out)
+    size = os.path.getsize(GOLDEN_PATH) / 1e6
+    print(f"wrote {GOLDEN_PATH} ({size:.1f} MB)")
+
+
+if __name__ == "__main__":
+    main()

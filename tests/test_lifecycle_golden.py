@@ -1,0 +1,51 @@
+"""Golden-trajectory regression: the lifecycle engine must reproduce its
+frozen per-tick state evolution BIT-IDENTICALLY.
+
+The trajectories in ``tests/golden/lifecycle_traj.npz`` were captured by
+``capture_lifecycle_golden.py`` and span both exchange topologies, packet
+loss, partition+heal, the full suspect→faulty→tombstone→evict chain, slot
+saturation, K>32/K<32 word tails, heal_prob on/off, and a mid-run admit.
+Any representation change inside the engine (layout, fusion structure,
+bitpacking) must leave every field of every tick untouched — including
+PRNG draw order, tie-breaks, and deadline arithmetic.  A failure here
+means protocol semantics moved, not just an optimization.
+
+Reference analog: the tier-3 cross-implementation conformance suite
+(``test/run-integration-tests``) pinning protocol behavior; here the other
+implementation is the engine's own frozen history.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import lifecycle
+
+from tests.capture_lifecycle_golden import CONFIGS, GOLDEN_PATH, run_config
+
+_FIELDS_EXACT = [f for f in lifecycle.LifecycleState._fields]
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(GOLDEN_PATH)
+
+
+@pytest.mark.parametrize(
+    "name,pkw,fault_sched,admits,ticks,seed",
+    CONFIGS,
+    ids=[c[0] for c in CONFIGS],
+)
+def test_trajectory_bit_identical(golden, name, pkw, fault_sched, admits, ticks, seed):
+    traj = run_config(pkw, fault_sched, admits, ticks, seed)
+    for field in _FIELDS_EXACT:
+        want = golden[f"{name}/{field}"]
+        got = traj[field]
+        assert got.shape == want.shape, (field, got.shape, want.shape)
+        mism = np.flatnonzero(
+            (got != want).reshape(ticks, -1).any(axis=1)
+        )
+        assert mism.size == 0, (
+            f"{name}: field {field} diverges first at tick {mism[0] if mism.size else '?'}"
+        )
