@@ -9,17 +9,19 @@ PY ?= python
 
 all: native test
 
-# full unit+functional suite (CPU, virtual 8-device mesh via tests/conftest.py)
+# full unit+functional suite (CPU, virtual 8-device mesh via tests/conftest.py;
+# XLA compiles hit the persistent .jax_cache — cold first run pays compile
+# once, warm runs are compile-free.  --durations prints the tier timings.)
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q --durations=15
 
 # skip the scale spot-checks
 test-fast:
-	$(PY) -m pytest tests/ -q -m "not slow"
+	$(PY) -m pytest tests/ -q -m "not slow" --durations=15
 
 # only the scale spot-checks (20k-node sim, 10-process cluster)
 test-slow:
-	$(PY) -m pytest tests/ -q -m slow
+	$(PY) -m pytest tests/ -q -m slow --durations=15
 
 # tier-3 multi-process clusters only (reference: make test-integration)
 test-integration:

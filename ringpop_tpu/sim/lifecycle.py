@@ -13,8 +13,9 @@ Representation.  Every node's view is ``converged base ⊔ learned rumors``:
 * ``base_{status,incarnation,present}[N]`` — the view every node agrees on;
 * a K-slot rumor table ``(subject, incarnation, status, deadline)`` — the
   changes currently in flight;
-* ``learned[N, K]`` / ``pcount[N, K]`` — who has absorbed which rumor and
-  the SWIM piggyback counters bounding how long it rides
+* ``learned[N, W]`` (uint32, the K rumor bits packed 32-per-word — see
+  ``sim/packbits``) / ``pcount[N, K]`` (int8) — who has absorbed which
+  rumor and the SWIM piggyback counters bounding how long it rides
   (``disseminator.go:75-97``).
 
 Because change application is a lattice max over ``key = (incarnation <<
@@ -70,6 +71,16 @@ from ringpop_tpu.sim.delta import (
     resolve_max_p,
     until_loop,
 )
+from ringpop_tpu.sim.packbits import (
+    and_reduce_rows,
+    bit_column,
+    n_words,
+    or_reduce_rows,
+    pack_bool,
+    row_mask,
+    set_bit,
+    unpack_bits,
+)
 from ringpop_tpu.swim.member import (
     ALIVE,
     FAULTY,
@@ -92,8 +103,12 @@ class LifecycleState(NamedTuple):
     r_inc: jax.Array  # int32[K] incarnation (protocol-tick counter)
     r_status: jax.Array  # int8[K]
     r_deadline: jax.Array  # int32[K] tick when the state timer fires
-    # per-(node, rumor)
-    learned: jax.Array  # bool[N, K]
+    # per-(node, rumor); ``learned`` is BIT-PACKED along the rumor axis
+    # (slot j = word j>>5, bit j&31 — see sim/packbits.py): a bool plane
+    # at 1M x 256 is 256 MB and one tick touches a dozen of them, so the
+    # packed layout is what fits the protocol tick in a CPU core's memory
+    # bandwidth and trims HBM bytes on TPU
+    learned: jax.Array  # uint32[N, W], W = ceil(K/32)
     pcount: jax.Array  # int8[N, K]
     # converged base view shared by all nodes
     base_status: jax.Array  # int8[N]
@@ -148,7 +163,7 @@ def init_state_from_key(params: LifecycleParams, key) -> LifecycleState:
         r_inc=jnp.zeros((k,), jnp.int32),
         r_status=jnp.zeros((k,), jnp.int8),
         r_deadline=jnp.full((k,), NO_DEADLINE, jnp.int32),
-        learned=jnp.zeros((n, k), bool),
+        learned=jnp.zeros((n, n_words(k)), jnp.uint32),
         pcount=jnp.zeros((n, k), jnp.int8),
         base_status=jnp.zeros((n,), jnp.int8),
         base_inc=jnp.zeros((n,), jnp.int32),
@@ -173,12 +188,10 @@ def _status_of(key):
 _inc_of = key_incarnation
 
 
-def _bel_rumor_dense(state, rkey, active, targets):
+def _bel_rumor_dense(learned_b, r_subject, rkey, active, targets):
     """Per-node max learned-rumor key about its ping target — the general
-    O(N·K) form (any target assignment)."""
-    bmask = (
-        state.learned & active[None, :] & (state.r_subject[None, :] == targets[:, None])
-    )
+    O(N·K) form (any target assignment; ``learned_b`` unpacked bool)."""
+    bmask = learned_b & active[None, :] & (r_subject[None, :] == targets[:, None])
     return jnp.max(
         jnp.where(bmask, rkey[None, :], jnp.int32(-1)), axis=1, initial=jnp.int32(-1)
     )
@@ -191,7 +204,19 @@ def step(
 ) -> LifecycleState:
     """One protocol period for all N nodes.  Fixed shapes throughout; jit-
     and shard-friendly (the only cross-node ops are segment reductions by
-    ping target / rumor subject and row gathers)."""
+    ping target / rumor subject and row gathers).
+
+    The per-(node, rumor) booleans run BIT-PACKED (``sim/packbits``): the
+    exchange legs, heal merge, and every derived mask are uint32 word ops
+    on [N, W] planes, and the int8 ``pcount`` plane is touched in exactly
+    two fused passes (bump+resets, then the post-alloc clears) with the
+    bit unpacking fused into them.  Shift mode additionally replaces the
+    two O(N·K) masked reduces that only involve (subject, prober) pairs —
+    target belief and self-detection of detractions — with O(K) gathers +
+    scatters, and the per-slot first-live-learner argmax runs only on
+    ticks where a suspicion/faulty timer actually fired (lax.cond).  All
+    of it is value-identical to the unpacked formulation — certified
+    bit-for-bit by tests/test_lifecycle_golden.py."""
     n, k = params.n, params.k
     m = min(params.alloc_per_tick, params.k, params.n)
     maxp = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
@@ -216,21 +241,28 @@ def step(
     )
     eff_max = jnp.maximum(subj_rumor_max, base_key)
 
+    active_w = pack_bool(active)  # [W], tail bits zero
+
     # -- ping target selection + belief gate --------------------------------
     shift_mode = params.exchange == "shift"
     if shift_mode:
         shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
         targets = (i_all + shift) % n
+        # belief[i] about its target: in shift mode each subject has
+        # exactly one prober i = (s - shift) mod n, so the dense masked
+        # reduce collapses to K bit-gathers + one scatter-max (identical
+        # values; the dense form is O(N·K))
+        prober = jnp.mod(state.r_subject - shift, n)
+        pbit = bit_column(state.learned[jnp.clip(prober, 0, n - 1)], jnp.arange(k))
+        bel_vals = jnp.where(active & pbit, rkey, jnp.int32(-1))
+        bel_rumor = jnp.full((n,), -1, jnp.int32).at[
+            jnp.where(active, prober, jnp.int32(n))
+        ].max(bel_vals, mode="drop")
     else:
         targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
         targets = jnp.where(targets >= i_all, targets + 1, targets)
-    # belief[i] about its target: max(base, learned rumors about target).
-    # (A measured dead end, so nobody retries it: in shift mode each subject
-    # has exactly one prober, so an O(K) scatter-max could replace this
-    # O(N·K) masked reduce — but XLA fuses the select into the reduce and
-    # the exchange ops dominate the tick; the scatter version measured
-    # within noise of this at 100k and 400k nodes on CPU.)
-    bel_rumor = _bel_rumor_dense(state, rkey, active, targets)
+        learned0_b = unpack_bits(state.learned, k)
+        bel_rumor = _bel_rumor_dense(learned0_b, state.r_subject, rkey, active, targets)
     bel = jnp.maximum(bel_rumor, base_key[targets])
     bel_status = _status_of(jnp.maximum(bel, 0))
     believes_pingable = (bel >= 0) & is_pingable(bel_status)
@@ -242,25 +274,41 @@ def step(
     delivered = conn & wants
 
     # -- piggyback exchange: request leg + response leg ---------------------
-    riding = state.learned & active[None, :] & (state.pcount < maxp)
-    sent = riding & delivered[:, None]
+    # (packed word ops in shift mode; the uniform path keeps the bool
+    # formulation — segment_max has no bitwise-OR combiner — and packs at
+    # the end.  Both produce identical bits.)
     if shift_mode:
-        inbound = jnp.roll(sent, shift, axis=0)
-        got_pinged = jnp.roll(delivered, shift)
+        ride_ok_w = pack_bool(state.pcount < maxp)  # one fused pass over pcount
+        dmask = row_mask(delivered)
+        riding_w = state.learned & ride_ok_w & active_w[None, :]
+        sent_w = riding_w & dmask
+        # rolls as explicit row gathers with precomputed index vectors:
+        # jnp.roll with a traced shift lowers to a slice-select chain that
+        # XLA re-derives PER CONSUMING ELEMENT when fused downstream
+        # (measured as the dominant cost of the tick); a gather through a
+        # materialized [N] index vector is one address lookup per element
+        # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
+        idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
+        idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
+        inbound_w = sent_w[idx_fwd]
+        got_pinged = delivered[idx_fwd]
+        learned1_w = state.learned | inbound_w
+        answerable_w = learned1_w & ride_ok_w & active_w[None, :]
+        resp_w = answerable_w[idx_back] & dmask
+        learned2_w = learned1_w | resp_w
     else:
-        inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+        ride_ok_b = state.pcount < maxp
+        riding_b = learned0_b & active[None, :] & ride_ok_b
+        sent_b = riding_b & delivered[:, None]
+        inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
         got_pinged = (
             jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
         )
-    learned = state.learned | inbound
-    answerable = learned & active[None, :] & (state.pcount < maxp)
-    resp = (
-        jnp.roll(answerable, -shift, axis=0) if shift_mode else answerable[targets]
-    ) & delivered[:, None]
-    learned = learned | resp
-    bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
-    pcount = jnp.minimum(state.pcount + bump, maxp)
-    pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)
+        learned1_b = learned0_b | inbound_b
+        answerable_b = learned1_b & active[None, :] & ride_ok_b
+        resp_b = answerable_b[targets] & delivered[:, None]
+        learned2_b = learned1_b | resp_b
+        learned2_w = pack_bool(learned2_b)
 
     # -- partition healer (heal_via_discover_provider.go, heal_partition.go):
     # a discovery provider knows every address, so the heal channel ignores
@@ -279,20 +327,52 @@ def step(
             & up[p]
             & _pair_connected(faults, h[None], p[None])[0]
         )
-        pair = (i_all == h) | (i_all == p)
-        merged = (learned[h] | learned[p]) & active
-        learned = jnp.where((pair & attempt)[:, None], merged[None, :], learned)
-        # a join transfer restarts dissemination of everything it carried
-        pcount = jnp.where((pair & attempt)[:, None] & merged[None, :], jnp.int8(0), pcount)
+        merged_row = (learned2_w[h] | learned2_w[p]) & active_w  # [W]
+        # apply the pair swap as two ROW updates, not an [N, W] select: a
+        # plane-wide where() drags this whole scalar chain (row gathers,
+        # connectivity test, PRNG compare) into every downstream per-element
+        # fusion — measured ~1.2 s/tick of pure re-derivation at 1M x 256
+        def _set_row(plane, row):
+            upd = jnp.where(attempt, merged_row, plane[row])[None, :]
+            return jax.lax.dynamic_update_slice(plane, upd, (row, jnp.int32(0)))
+
+        learned2h_w = _set_row(_set_row(learned2_w, h), p)
+        merged_bits = unpack_bits(merged_row, k)  # [K]
+    else:
+        learned2h_w = learned2_w
+
+    # -- pcount pass A: bump + newly-learned + heal resets ------------------
+    # (the unpacks fuse into this int8 pass; with gather-based rolls their
+    # producer chains are one lookup per element, so the fusion stays thin)
+    if shift_mode:
+        sent_bit = unpack_bits(sent_w, k)
+        rg_bit = unpack_bits(riding_w, k) & got_pinged[:, None]
+        newly_bit = unpack_bits(learned2_w & ~state.learned, k)
+    else:
+        sent_bit = sent_b
+        rg_bit = riding_b & got_pinged[:, None]
+        newly_bit = learned2_b & ~learned0_b
+    bump = sent_bit.astype(jnp.int8) + rg_bit.astype(jnp.int8)
+    pcount_a = jnp.minimum(state.pcount + bump, maxp)
+    pcount_a = jnp.where(newly_bit, jnp.int8(0), pcount_a)
+    if params.heal_prob > 0:
+        # heal resets as the same two ROW updates (a join transfer restarts
+        # dissemination of everything it carried); commutes with newly_bit's
+        # reset — both write zero
+        def _reset_row(plane, row):
+            upd = jnp.where(attempt & merged_bits, jnp.int8(0), plane[row])[None, :]
+            return jax.lax.dynamic_update_slice(plane, upd, (row, jnp.int32(0)))
+
+        pcount_a = _reset_row(_reset_row(pcount_a, h), p)
 
     # full-sync analog: re-seed rumors that expired short of full coverage
-    live_col = up[:, None]
-    riding_now = learned & active[None, :] & (pcount < maxp) & live_col
-    fully_learned = jnp.all(learned | ~live_col, axis=0) & active
-    stuck = active & ~riding_now.any(axis=0) & ~fully_learned
-    pcount = jnp.where(stuck[None, :] & learned, jnp.int8(0), pcount)
+    up_mask = row_mask(up)
+    riding_now_w = learned2h_w & pack_bool(pcount_a < maxp) & active_w[None, :] & up_mask
+    fully_learned = unpack_bits(and_reduce_rows(learned2h_w | row_mask(~up)), k) & active
+    has_live_learner = unpack_bits(or_reduce_rows(learned2h_w & up_mask), k)
+    stuck = active & ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully_learned
 
-    state = state._replace(learned=learned, pcount=pcount)
+    state = state._replace(learned=learned2h_w, pcount=pcount_a)
 
     # -- timers fire: slot rumors (state_transitions.go:90-117) -------------
     due = active & (state.tick >= state.r_deadline)
@@ -300,8 +380,8 @@ def step(
     fire = due & dominant
     fire_subj = jnp.clip(subj, 0, n - 1)
     # a transition can only fire where some live node can seed the successor
-    # rumor; otherwise the deadline persists and the slot is reclaimed below
-    has_live_learner = (learned & live_col).any(axis=0)
+    # rumor (has_live_learner, from the packed OR-reduce above); otherwise
+    # the deadline persists and the slot is reclaimed below
     fire_s = fire & (state.r_status == SUSPECT) & has_live_learner
     fire_f = fire & (state.r_status == FAULTY) & has_live_learner
     # eviction additionally waits for the tombstone to be fully disseminated
@@ -315,8 +395,22 @@ def step(
     fire_key = jnp.maximum(
         jax.ops.segment_max(slot_cand, subj, num_segments=n + 1)[:n], jnp.int32(-1)
     )
-    # seed for a fired transition: first live node that learned the rumor
-    slot_seed = jnp.argmax(state.learned & live_col, axis=0).astype(jnp.int32)
+    # seed for a fired transition: first live node that learned the rumor.
+    # The per-slot argmax over N is the single most expensive reduce in the
+    # tick (strided over the packed plane), and its result only matters on
+    # ticks where a suspect/faulty timer actually fired — so it runs under
+    # a cond (value-identical: when nothing fired, seed_node is -1 and the
+    # zeros never flow anywhere)
+    def _first_live_learner(_):
+        lb = unpack_bits(state.learned, k) & up[:, None]
+        return jnp.argmax(lb, axis=0).astype(jnp.int32)
+
+    slot_seed = jax.lax.cond(
+        (fire_s | fire_f).any(),
+        _first_live_learner,
+        lambda _: jnp.zeros((k,), jnp.int32),
+        None,
+    )
     seed_node = jnp.maximum(
         jax.ops.segment_max(
             jnp.where(fire_s | fire_f, slot_seed, jnp.int32(-1)), subj, num_segments=n + 1
@@ -391,8 +485,7 @@ def step(
         | (active & ~has_live_learner)
     )
     r_subject = jnp.where(freed, jnp.int32(-1), state.r_subject)
-    learned = state.learned & ~freed[None, :]
-    pcount = jnp.where(freed[None, :], jnp.int8(0), state.pcount)
+    learned3_w = state.learned & ~pack_bool(freed)[None, :]
     active = r_subject >= 0
     base_key = jnp.where(base_present, _key_of(base_inc, base_status), jnp.int32(-1))
     subj = jnp.where(active, r_subject, jnp.int32(n))
@@ -407,12 +500,21 @@ def step(
     eff_max = jnp.maximum(subj_rumor_max, base_key)
 
     # -- refutation candidates (memberlist.go:337-354) ----------------------
-    self_mask = learned & active[None, :] & (r_subject[None, :] == i_all[:, None])
-    self_detract = jnp.any(
-        self_mask
-        & _is_detraction(state.r_status)[None, :]
-        & (state.r_inc[None, :] >= state.self_inc[:, None]),
-        axis=1,
+    # only (node == slot subject) pairs can self-detect a detraction, so
+    # the dense [N, K] mask collapses to K bit-gathers + one scatter-OR
+    # (identical values to the original any-reduce)
+    subj_c = jnp.clip(subj, 0, n - 1)
+    own_bit = bit_column(learned3_w[subj_c], jnp.arange(k))
+    slot_self_detract = (
+        active
+        & own_bit
+        & _is_detraction(state.r_status)
+        & (state.r_inc >= state.self_inc[subj_c])
+    )
+    self_detract = (
+        jnp.zeros((n,), bool)
+        .at[jnp.where(active, subj, jnp.int32(n))]
+        .max(slot_self_detract, mode="drop")
     )
     base_detract = (
         _is_detraction(base_status) & (base_inc >= state.self_inc) & base_present
@@ -483,22 +585,32 @@ def step(
 
     # fresh slots start unlearned, then get seeded
     placed_col = jnp.zeros((k,), bool).at[free_slots].set(place)
-    learned = learned & ~placed_col[None, :]
-    pcount = jnp.where(placed_col[None, :], jnp.int8(0), pcount)
+    learned4_w = learned3_w & ~pack_bool(placed_col)[None, :]
 
     # seed row per placed candidate: refute → the subject itself; timer
     # transition → first live learner of the precursor rumor.  Fresh suspect
     # rumors are seeded by their declarers below, not here.
     seed_rows = jnp.where(new_status == ALIVE, cand_subj, seed_node[cand_subj])
     seed_ok = place & (new_status != SUSPECT) & (seed_rows >= 0)
-    learned = learned.at[jnp.clip(seed_rows, 0, n - 1), free_slots].max(seed_ok)
+    learned5_w = set_bit(
+        learned4_w, jnp.clip(seed_rows, 0, n - 1), free_slots, seed_ok
+    )
     # suspect rumors: every declarer that targeted the subject seeds it
     subj_to_slot = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
         jnp.where(place & (new_status == SUSPECT), free_slots, jnp.int32(-1))
     )
     decl_slot = subj_to_slot[targets]
     decl_ok = declare & (decl_slot >= 0)
-    learned = learned.at[i_all, jnp.clip(decl_slot, 0, k - 1)].max(decl_ok)
+    learned6_w = set_bit(learned5_w, i_all, jnp.clip(decl_slot, 0, k - 1), decl_ok)
+
+    # -- pcount pass B: the deferred stuck/freed/placed clears (one fused
+    # read/write; all resets-to-zero commute with pass A's) ----------------
+    pcount_final = jnp.where(
+        (freed | placed_col)[None, :]
+        | (stuck[None, :] & unpack_bits(learned2h_w, k)),
+        jnp.int8(0),
+        pcount_a,
+    )
 
     # refutation bumps the refuter's own incarnation (iff its rumor placed)
     placed_subject = jnp.zeros((n,), bool).at[cand_subj].max(place & (new_status == ALIVE))
@@ -524,8 +636,8 @@ def step(
         r_inc=r_inc,
         r_status=r_status,
         r_deadline=r_deadline,
-        learned=learned,
-        pcount=pcount,
+        learned=learned6_w,
+        pcount=pcount_final,
         base_status=base_status,
         base_inc=base_inc,
         base_present=base_present,
@@ -534,6 +646,37 @@ def step(
         self_inc=self_inc,
         tick=state.tick + 1,
         key=key,
+    )
+
+
+def state_shardings(mesh) -> LifecycleState:
+    """The canonical LifecycleState sharding over a ("node", "rumor")
+    mesh: per-node vectors on the node axis, the rumor table on the rumor
+    axis, the big planes on both — ``learned``'s rumor axis is WORDS
+    (uint32 packs 32 slots), so K must supply >= 32 slots per rumor shard.
+    One definition shared by the driver entry (``__graft_entry__``), the
+    sharded-at-scale bench (``cli/simbench bench_sharded100k``), and the
+    sharding tests — a layout change edits exactly this function."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def sh(spec):
+        return NamedSharding(mesh, spec)
+
+    return LifecycleState(
+        r_subject=sh(P("rumor")),
+        r_inc=sh(P("rumor")),
+        r_status=sh(P("rumor")),
+        r_deadline=sh(P("rumor")),
+        learned=sh(P("node", "rumor")),
+        pcount=sh(P("node", "rumor")),
+        base_status=sh(P("node")),
+        base_inc=sh(P("node")),
+        base_present=sh(P("node")),
+        base_pending=sh(P("node")),
+        base_deadline=sh(P("node")),
+        self_inc=sh(P("node")),
+        tick=sh(P()),
+        key=sh(P()),
     )
 
 
@@ -554,13 +697,16 @@ def admit(params: LifecycleParams, state: LifecycleState, idx: int) -> Lifecycle
     k0 = int(free[0])
     now = jnp.int32(int(state.tick) + 1)
     n = params.n
-    learned_col = jnp.zeros((n,), bool).at[idx].set(True)
+    w0, bitv = k0 >> 5, jnp.uint32(1 << (k0 & 31))
+    col = (state.learned[:, w0] & ~bitv) | jnp.where(
+        jnp.arange(n) == idx, bitv, jnp.uint32(0)
+    )
     return state._replace(
         r_subject=state.r_subject.at[k0].set(idx),
         r_inc=state.r_inc.at[k0].set(now),
         r_status=state.r_status.at[k0].set(ALIVE),
         r_deadline=state.r_deadline.at[k0].set(NO_DEADLINE),
-        learned=state.learned.at[:, k0].set(learned_col),
+        learned=state.learned.at[:, w0].set(col),
         pcount=state.pcount.at[:, k0].set(jnp.int8(0)),
         self_inc=state.self_inc.at[idx].set(now),
     )
@@ -573,12 +719,13 @@ def believed_key(state: LifecycleState, subjects) -> jax.Array:
     """int32[N, S]: node i's belief key about each subject (-1 = not
     present).  O(N·K·S) — intended for small subject lists."""
     subjects = jnp.asarray(subjects, jnp.int32)
+    k = state.r_subject.shape[0]
     active = state.r_subject >= 0
     rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
     sel = active[:, None] & (state.r_subject[:, None] == subjects[None, :])  # [K, S]
     per_rumor = jnp.where(sel[None, :, :], rkey[None, :, None], jnp.int32(-1))  # [1,K,S]
     bel_rumor = jnp.max(
-        jnp.where(state.learned[:, :, None], per_rumor, jnp.int32(-1)),
+        jnp.where(unpack_bits(state.learned, k)[:, :, None], per_rumor, jnp.int32(-1)),
         axis=1,
         initial=jnp.int32(-1),
     )  # [N, S]
@@ -608,7 +755,7 @@ def detection_fraction(
     per-observer first-learned-wins semantics from [N]-column ops (a 1M x
     128 x 1000 query goes from ~500 GB of intermediates to ~2k column
     reductions)."""
-    if state.learned.shape[0] * state.learned.shape[1] * len(subjects) > 2**28:
+    if state.learned.shape[0] * state.r_subject.shape[0] * len(subjects) > 2**28:
         return _detection_fraction_large(state, subjects, faults, min_status)
     subjects = jnp.asarray(subjects, jnp.int32)
     bk = believed_key(state, subjects)
@@ -634,7 +781,7 @@ def _detection_fraction_large(
     [N] boolean columns); observers that learned none fall through to the
     base.  Rumor/base metadata is [K]/scalars — only [N]-sized column ops
     touch the device."""
-    n, k = state.learned.shape
+    n = state.learned.shape[0]
     subjects_np = np.asarray(subjects, np.int64)
     r_subject = np.asarray(state.r_subject)
     r_key = (np.asarray(state.r_inc, np.int64) << KEY_STATE_BITS) | np.asarray(
@@ -660,7 +807,7 @@ def _detection_fraction_large(
         for slot in order:
             if base_present[si] and base_key[si] >= r_key[slot]:
                 break  # base outranks this and all lower slots for everyone
-            col = state.learned[:, int(slot)]
+            col = ((state.learned[:, int(slot) >> 5] >> jnp.uint32(slot & 31)) & 1) != 0
             got = remaining & col
             if int(r_key[slot] & (2**KEY_STATE_BITS - 1)) >= min_status:
                 count += int(got.sum())
@@ -742,7 +889,8 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
     subject's last slot (callbacks must gate their update on ``fin``).
     Returns the final carry.  Subjects with no in-flight slot never reach
     ``finalize`` — callers handle them via :func:`_slot_covered`."""
-    n, k = state.learned.shape
+    n = state.learned.shape[0]
+    k = state.r_subject.shape[0]
     active = state.r_subject >= 0
     rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
     subj_or_sentinel = jnp.where(active, state.r_subject, jnp.int32(n))
@@ -752,15 +900,15 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
     is_last = sorted_subj != jnp.concatenate(
         [sorted_subj[1:], jnp.full((1,), n + 1, jnp.int32)]
     )
-    learned_sorted = state.learned.T[order]  # [K, N], rows contiguous per slot
 
     def body(j, c):
         best, carry = c
         s = sorted_subj[j]
         valid = s < n
-        best = jnp.where(
-            learned_sorted[j] & valid, jnp.maximum(best, sorted_key[j]), best
-        )
+        # slot order[j]'s learned column, extracted from the packed plane
+        # (the pre-pack code materialized a [K, N] transpose here)
+        lcol = bit_column(state.learned, order[j])
+        best = jnp.where(lcol & valid, jnp.maximum(best, sorted_key[j]), best)
         m = jnp.maximum(best, base_key[jnp.minimum(s, n - 1)])
         fin = is_last[j] & valid
         carry = finalize(carry, jnp.minimum(s, n - 1), m, fin)
@@ -811,7 +959,7 @@ def view_checksums(
     a node's own checksum is defined whether or not it is up (the
     reference's memberlist exists on a stopped node too).
     """
-    n, k = state.learned.shape
+    n = state.learned.shape[0]
     del faults
 
     active = state.r_subject >= 0

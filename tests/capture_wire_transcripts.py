@@ -1,0 +1,237 @@
+"""Capture golden multi-frame wire CONVERSATIONS (VERDICT round-2 item 5).
+
+``tests/golden/wire_corpus.json`` pins single message bodies; the
+reference's tier-3 suite additionally proves multi-message *sequences* —
+ping → checksum-mismatch full sync → reverse full sync
+(``swim/disseminator.go:156-304``), join rounds
+(``swim/join_sender.go:281-435``), heal merges with reincarnations
+(``swim/heal_partition.go:33-59``) — against real processes
+(``test/run-integration-tests:99-113``).  This harness drives live
+host-plane nodes over an instrumented in-process channel, records every
+RPC frame (caller, peer, endpoint, request body, response body) in order,
+and freezes the sequences.  MockClock + fixed seeds make every field —
+incarnations (clock ms), checksums, timestamps — deterministic, so the
+transcripts replay bit-for-bit.
+
+Run offline to (re)capture:  python tests/capture_wire_transcripts.py
+Replayed by tests/test_wire_transcripts.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ringpop_tpu.net import LocalNetwork, LocalChannel  # noqa: E402
+from ringpop_tpu.swim import heal as heal_mod  # noqa: E402
+from ringpop_tpu.swim.member import Change, state_id  # noqa: E402
+from ringpop_tpu.swim.node import BootstrapOptions, Node, NodeOptions  # noqa: E402
+from ringpop_tpu.swim.ping import send_ping  # noqa: E402
+from ringpop_tpu.swim.state_transitions import StateTimeouts  # noqa: E402
+from ringpop_tpu.util.clock import MockClock  # noqa: E402
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "wire_transcripts.json")
+
+
+class RecordingChannel(LocalChannel):
+    """LocalChannel that logs every outbound RPC frame + its response."""
+
+    def __init__(self, network, hostport, app, log):
+        super().__init__(network, hostport, app=app)
+        self._log = log
+
+    async def call(self, peer, service, endpoint, body, headers=None, timeout=None):
+        frame = {
+            "caller": self.hostport,
+            "peer": peer,
+            "service": service,
+            "endpoint": endpoint,
+            "request": body,
+        }
+        self._log.append(frame)
+        try:
+            res = await super().call(peer, service, endpoint, body, headers, timeout)
+        except Exception as e:  # error frames are part of the conversation
+            frame["error"] = type(e).__name__
+            raise
+        frame["response"] = res
+        return frame["response"]
+
+
+def make_recorded_node(network, address, log, app="test", seed=0):
+    ch = RecordingChannel(network, address, app, log)
+    clock = MockClock(start=1_000_000.0)
+    opts = NodeOptions(clock=clock, seed=seed, state_timeouts=StateTimeouts(suspect=5.0))
+    return Node(app, address, ch, opts)
+
+
+async def _boot(nodes, hosts=None):
+    hosts = hosts or [n.address for n in nodes]
+
+    async def one(n):
+        await n.bootstrap(BootstrapOptions(discover_provider=hosts, join_timeout=0.5))
+        n.gossip.stop()
+        n.healer.stop()
+
+    await asyncio.gather(*(one(n) for n in nodes))
+
+
+async def _drain():
+    for _ in range(6):
+        await asyncio.sleep(0)
+
+
+# -- scenes -----------------------------------------------------------------
+
+
+async def scene_ping_piggyback():
+    """A declares its target suspect, then pings a peer: the suspect change
+    rides as piggyback and the peer's reply echoes its own view
+    (``swim/ping_sender.go:43-120`` / ``ping_handler.go:25-58``)."""
+    log: list = []
+    net = LocalNetwork()
+    nodes = [
+        make_recorded_node(net, f"127.0.0.1:{3000 + i}", log, seed=50 + i)
+        for i in range(3)
+    ]
+    await _boot(nodes)
+    log.clear()  # keep only the conversation, not the bootstrap
+    a, b, c = nodes
+    a.clock.advance(0.001)
+    a.memberlist.make_suspect(c.address, a.memberlist.member(c.address).incarnation)
+    await send_ping(a, b.address, timeout=1.0)
+    await _drain()
+    for n in nodes:
+        n.destroy()
+    return log
+
+
+async def scene_full_sync_reverse():
+    """B silently learns an extra member (join-list insert clears
+    dissemination, ``memberlist.go:398-406``); A's empty-changes ping then
+    hits a checksum mismatch → B answers with its FULL membership and
+    starts a reverse full sync (a join call back to A) to heal the
+    asymmetry (``disseminator.go:156-304``)."""
+    log: list = []
+    net = LocalNetwork()
+    nodes = [
+        make_recorded_node(net, f"127.0.0.1:{3100 + i}", log, seed=60 + i)
+        for i in range(2)
+    ]
+    await _boot(nodes)
+    a, b = nodes
+    # drain bootstrap-era piggyback so A's ping carries NO changes — the
+    # full-sync branch requires checksum mismatch AND an empty changes
+    # response (disseminator.go:168-181)
+    a.disseminator.clear_changes()
+    b.disseminator.clear_changes()
+    b.memberlist.add_join_list(
+        [
+            Change(
+                address="127.0.0.1:3999",
+                incarnation=1_000_000_500,
+                status=state_id("alive"),
+                source=b.address,
+                source_incarnation=b.incarnation(),
+                timestamp=1_000_000_500,
+            )
+        ]
+    )
+    log.clear()
+    await send_ping(a, b.address, timeout=1.0)
+    await _drain()  # lets the reverse-full-sync join land
+    for n in nodes:
+        n.destroy()
+    return log
+
+
+async def scene_join_round():
+    """A fresh node joins a 2-node cluster: the full join round as the
+    joiner drives it (``join_sender.go:281-435``)."""
+    log: list = []
+    net = LocalNetwork()
+    ab = [
+        make_recorded_node(net, f"127.0.0.1:{3200 + i}", log, seed=70 + i)
+        for i in range(2)
+    ]
+    await _boot(ab)
+    joiner = make_recorded_node(net, "127.0.0.1:3210", log, seed=77)
+    log.clear()
+    await _boot([joiner], hosts=[n.address for n in ab] + [joiner.address])
+    await _drain()
+    for n in ab + [joiner]:
+        n.destroy()
+    return log
+
+
+async def scene_heal_reincarnate():
+    """Two 2-node partitions that remember each other as faulty; a heal
+    attempt from A to C must first re-assert the faulty members via
+    Suspect declarations to both sides (refutation-by-reincarnation
+    follows), then merge (``heal_partition.go:33-124``)."""
+    log: list = []
+    net = LocalNetwork()
+    left = [
+        make_recorded_node(net, f"127.0.0.1:{3300 + i}", log, seed=80 + i)
+        for i in range(2)
+    ]
+    right = [
+        make_recorded_node(net, f"127.0.0.1:{3310 + i}", log, seed=90 + i)
+        for i in range(2)
+    ]
+    await _boot(left)
+    await _boot(right)
+    # each side knows the other side's members as faulty, by fiat (the
+    # reference's partition tests write Faulty states directly,
+    # heal_partition_test.go:420-428)
+    for n in left:
+        n.clock.advance(0.001)
+        for m in right:
+            n.memberlist.make_faulty(m.address, 1_000_000_000)
+        n.disseminator.clear_changes()
+    for n in right:
+        n.clock.advance(0.001)
+        for m in left:
+            n.memberlist.make_faulty(m.address, 1_000_000_000)
+        n.disseminator.clear_changes()
+    log.clear()
+    a, c = left[0], right[0]
+    await heal_mod.attempt_heal(a, c.address)
+    await _drain()
+    for n in left + right:
+        n.destroy()
+    return log
+
+
+SCENES = {
+    "ping_piggyback": scene_ping_piggyback,
+    "full_sync_reverse": scene_full_sync_reverse,
+    "join_round": scene_join_round,
+    "heal_reincarnate": scene_heal_reincarnate,
+}
+
+
+def capture() -> dict:
+    out = {}
+    for name, fn in SCENES.items():
+        out[name] = asyncio.run(fn())
+    return out
+
+
+def main() -> None:
+    out = capture()
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    for name, frames in out.items():
+        print(f"{name}: {len(frames)} frames:",
+              [f"{fr['caller'].split(':')[1]}->{fr['peer'].split(':')[1]} {fr['endpoint']}" for fr in frames])
+    print("wrote", GOLDEN_PATH)
+
+
+if __name__ == "__main__":
+    main()

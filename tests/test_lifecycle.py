@@ -406,33 +406,18 @@ def test_jit_shapes_stable_and_sharded():
     """The step runs under jit with in/out shardings on the 8-device CPU
     mesh (node × rumor), proving the multi-chip path compiles + executes."""
     import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
+
+    from ringpop_tpu.sim.lifecycle import state_shardings
 
     devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
     mesh = Mesh(devs, ("node", "rumor"))
-    params = LifecycleParams(n=64, k=16, suspect_ticks=6)
+    # k=64 -> learned is uint32[N, 2] words: the 2-way rumor axis shards
+    # one word per device (the packed plane's rumor axis is words, so K
+    # must supply >= 32 slots per rumor shard)
+    params = LifecycleParams(n=64, k=64, suspect_ticks=6)
     state = init_state(params, seed=7)
-
-    def sh(spec):
-        return NamedSharding(mesh, spec)
-
-    shardings = state._replace(
-        r_subject=sh(P("rumor")),
-        r_inc=sh(P("rumor")),
-        r_status=sh(P("rumor")),
-        r_deadline=sh(P("rumor")),
-        learned=sh(P("node", "rumor")),
-        pcount=sh(P("node", "rumor")),
-        base_status=sh(P("node")),
-        base_inc=sh(P("node")),
-        base_present=sh(P("node")),
-        base_pending=sh(P("node")),
-        base_deadline=sh(P("node")),
-        self_inc=sh(P("node")),
-        tick=sh(P()),
-        key=sh(P()),
-    )
-    state = jax.tree.map(jax.device_put, state, shardings)
+    state = jax.tree.map(jax.device_put, state, state_shardings(mesh))
     faults = make_faults(64, down=[9])
     stepper = jax.jit(lambda s: step(params, s, faults))
     for _ in range(30):

@@ -27,6 +27,17 @@ from tests.capture_lifecycle_golden import CONFIGS, GOLDEN_PATH, run_config
 _FIELDS_EXACT = [f for f in lifecycle.LifecycleState._fields]
 
 
+def _as_bool_plane(arr: np.ndarray, k: int) -> np.ndarray:
+    """Unpack a bit-packed [T, N, W] uint32 ``learned`` to [T, N, K] bool;
+    pass an already-bool plane through.  The goldens were captured from the
+    pre-packing engine, so the comparison is representation-agnostic by
+    construction — exactly what lets them certify layout changes."""
+    if arr.dtype == np.bool_:
+        return arr
+    bits = (arr[..., :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(arr.shape[:-1] + (arr.shape[-1] * 32,))[..., :k].astype(bool)
+
+
 @pytest.fixture(scope="module")
 def golden():
     return np.load(GOLDEN_PATH)
@@ -39,9 +50,12 @@ def golden():
 )
 def test_trajectory_bit_identical(golden, name, pkw, fault_sched, admits, ticks, seed):
     traj = run_config(pkw, fault_sched, admits, ticks, seed)
+    k = lifecycle.LifecycleParams(**pkw).k
     for field in _FIELDS_EXACT:
         want = golden[f"{name}/{field}"]
         got = traj[field]
+        if field == "learned":
+            want, got = _as_bool_plane(want, k), _as_bool_plane(got, k)
         assert got.shape == want.shape, (field, got.shape, want.shape)
         mism = np.flatnonzero(
             (got != want).reshape(ticks, -1).any(axis=1)

@@ -77,7 +77,8 @@ def test_replica_axis_is_one_program():
     leaves with a leading B axis."""
     params = LifecycleParams(n=N, k=K)
     states = init_replicas(params, SEEDS)
-    assert states.learned.shape == (len(SEEDS), N, K)
+    assert states.learned.shape == (len(SEEDS), N, (K + 31) // 32)  # packed words
+    assert states.pcount.shape == (len(SEEDS), N, K)
     assert states.key.shape[0] == len(SEEDS)
 
 

@@ -1,0 +1,74 @@
+"""Golden wire-CONVERSATION regression (VERDICT round-2 item 5).
+
+Replays the scripted multi-frame scenes from
+``capture_wire_transcripts.py`` against live host-plane nodes and asserts
+the recorded frame sequences — order, endpoints, and full request AND
+response bodies — reproduce exactly.  A drift in any handler's *sequence*
+behavior (full-sync trigger condition, reverse-full-sync initiation, join
+fan-out, heal's reincarnation-before-merge) fails here even if every
+individual body still round-trips.
+
+Reference analog: the tier-3 conversation-level conformance runs
+(``test/run-integration-tests:99-113``; sequences under test:
+``swim/disseminator.go:156-304``, ``swim/join_sender.go:281-435``,
+``swim/heal_partition.go:33-124``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from tests.capture_wire_transcripts import GOLDEN_PATH, SCENES
+
+GOLDEN = json.loads(Path(GOLDEN_PATH).read_text())
+
+# every scene must exercise the endpoints its reference call stack names
+_EXPECTED_ENDPOINTS = {
+    "ping_piggyback": [("/protocol/ping", None)],
+    "full_sync_reverse": [("/protocol/ping", None), ("/protocol/join", None)],
+    "join_round": [("/protocol/join", None), ("/protocol/join", None)],
+    "heal_reincarnate": [("/protocol/join", None), ("/protocol/ping", None)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCENES), ids=sorted(SCENES))
+def test_conversation_replays_bit_identical(name):
+    got = asyncio.run(SCENES[name]())
+    want = GOLDEN[name]
+    assert [f["endpoint"] for f in got] == [f["endpoint"] for f in want], (
+        f"{name}: frame sequence changed"
+    )
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, f"{name}: frame {i} ({w['endpoint']}) drifted"
+    assert len(got) == len(want)
+
+
+@pytest.mark.parametrize("name", sorted(SCENES), ids=sorted(SCENES))
+def test_scene_covers_expected_endpoints(name):
+    """Guard against a scene silently degenerating (e.g. the full-sync
+    branch no longer triggering, leaving only a plain ping recorded)."""
+    eps = [f["endpoint"] for f in GOLDEN[name]]
+    assert eps == [e for e, _ in _EXPECTED_ENDPOINTS[name]]
+
+
+def test_full_sync_response_carries_whole_membership():
+    """The recorded full-sync reply must contain B's entire view including
+    the silently-added member — that's what makes it a full sync and not a
+    piggyback reply (disseminator.go:168-181)."""
+    ping = GOLDEN["full_sync_reverse"][0]
+    assert ping["request"]["changes"] == []  # the trigger: no changes
+    addrs = {c["address"] for c in ping["response"]["changes"]}
+    assert "127.0.0.1:3999" in addrs and len(addrs) == 3
+
+
+def test_heal_ping_reasserts_via_suspects():
+    """The heal merge's ping must carry Suspect declarations for the
+    members that would otherwise stay unpingable after the merge
+    (heal_partition.go:64-108)."""
+    ping = GOLDEN["heal_reincarnate"][1]
+    statuses = {c["status"] for c in ping["request"]["changes"]}
+    assert statuses == {"suspect"}
