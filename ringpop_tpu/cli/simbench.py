@@ -17,6 +17,17 @@ line per scenario:
                      churn rebalance (reference analog:
                      ``hashring_test.go:332`` micro-bench, scaled up).
 
+Beyond the five BASELINE configs:
+
+- ``montecarlo``   — B seeded replicas in one vmapped program; exact
+                     per-replica first-detection ticks (1-tick resolution).
+- ``forward`` / ``forward_comparator`` — keyed forwarding qps through a
+                     live 3-node cluster, and the minimal asyncio-proxy
+                     ceiling it is compared against.
+- ``sharded100k``  — the 100k-node lifecycle step jitted over a 4x2
+                     virtual device mesh, asserted bit-equal to the
+                     unsharded step.
+
 Scale auto-shrinks on CPU hosts (full sizes on an accelerator or with
 ``--full``).  Usage::
 
@@ -274,7 +285,7 @@ print(json.dumps(dict(tick_equal=equal, n_devices=len(jax.devices("cpu")),
             "error": (r.stderr or "")[-400:],
         }
     child = json.loads(r.stdout.strip().splitlines()[-1])
-    return {
+    result = {
         "metric": f"sharded_lifecycle_step_n{n}",
         "value": child["sharded_s"],
         "unit": "s",
@@ -286,6 +297,12 @@ print(json.dumps(dict(tick_equal=equal, n_devices=len(jax.devices("cpu")),
         "tick_equal_to_unsharded": child["tick_equal"],
         "unsharded_s": child["unsharded_s"],
     }
+    if not child["tick_equal"]:
+        # the certificate IS the scenario — a mismatch must read as failure
+        # in the artifact, not as a normal row with one odd field
+        result["ok"] = False
+        result["error"] = "sharded step diverged from unsharded step"
+    return result
 
 
 def bench_forward_comparator(seed: int, full: bool) -> dict:
@@ -366,15 +383,17 @@ def bench_forward_comparator(seed: int, full: bool) -> dict:
             w.close()
         proxy_srv.close()
         echo_srv.close()
-        return sorted(qps)
+        return sorted(qps), wave * per_conn
 
-    qps = asyncio.run(run())
+    qps, per_rep = asyncio.run(run())
     return {
         "metric": "forward_comparator_qps_minimal_proxy",
         "value": round(qps[len(qps) // 2], 0),
         "unit": "req_per_s",
         "qps_reps": [round(q) for q in qps],
-        "n_requests_per_rep": (5000 if full else 500),
+        # the count actually driven (wave * per_conn), not the requested
+        # n_req — they differ whenever n_req is not a multiple of wave
+        "n_requests_per_rep": per_rep,
     }
 
 

@@ -42,11 +42,12 @@ from ringpop_tpu.sim.delta import DeltaFaults
 from ringpop_tpu.swim.member import ALIVE, FAULTY
 
 
-def make_faults(n, down=(), drop=0.0):
+def make_faults(n, down=(), drop=0.0, group=None):
     up = np.ones(n, bool)
     for i in down:
         up[i] = False
-    return DeltaFaults(up=jnp.asarray(up), drop_rate=drop)
+    g = None if group is None else jnp.asarray(np.asarray(group, np.int32))
+    return DeltaFaults(up=jnp.asarray(up), drop_rate=drop, group=g)
 
 
 # -- fullview queries -------------------------------------------------------
@@ -79,9 +80,20 @@ def fv_refuted_count(sim: fullview.FullViewSim) -> int:
 # -- lifecycle queries ------------------------------------------------------
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames="min_status")
+def _lc_detection_complete(state, subjects, faults, min_status):
+    return lifecycle.detection_complete(state, subjects, faults, min_status)
+
+
 def lc_detected(sim: lifecycle.LifecycleSim, victims, faults) -> bool:
-    frac = lifecycle.detection_fraction(sim.state, list(victims), faults, FAULTY)
-    return bool((np.asarray(frac) >= 1.0).all())
+    # jitted on-device predicate: the eager detection_fraction walk costs
+    # ~0.27 s of dispatch per call, which dominated the 50-seed study
+    # (checked every 2 ticks x ~30 ticks x 50 seeds)
+    subjects = jnp.asarray(list(victims), jnp.int32)
+    return bool(_lc_detection_complete(sim.state, subjects, faults, FAULTY))
 
 
 def lc_quiet_all_alive(sim: lifecycle.LifecycleSim) -> bool:
@@ -153,6 +165,48 @@ def refutation_run(engine: str, n: int, seed: int, drop=0.10, noisy_ticks=60,
         if t % 4 == 0 and settled(sim):
             return max(refuted_mid, refuted(sim)), True, t
     return max(refuted_mid, refuted(sim)), False, quiet_ticks
+
+
+def partition_run(engine: str, n: int, seed: int, minority_frac=0.3,
+                  part_ticks=15, quiet_ticks=600, suspect_ticks=25):
+    """ASYMMETRIC (30/70) hard partition for ``part_ticks`` — long enough
+    that cross-partition suspicions pile up on both sides, healed before
+    they convert to Faulty (suspects stay pingable, so normal gossip can
+    carry the refutations after the heal; a full mutual-faulty split needs
+    the discovery-provider healer, which only the lifecycle engine models
+    — ``heal_via_discover_provider.go`` — so THAT deadlock cannot be an
+    agreement scenario against the healer-less fullview oracle).
+
+    Returns (cross_suspects_mid, recovered: bool, recovery_ticks,
+    refuted_count).  Exercises the group-partition connectivity channel
+    and the inconclusive-vs-suspect indirect-probe paths the loss scenario
+    doesn't (reference: ``swim/node.go:494-510``,
+    ``memberlist.go:337-354``)."""
+    minority = list(range(int(n * minority_frac)))
+    group = np.zeros(n, np.int32)
+    group[: int(n * minority_frac)] = 1
+    part = make_faults(n, group=group)
+    clean = make_faults(n)
+    sim = _get_sim(engine, n, seed, suspect_ticks)
+    refuted = fv_refuted_count if engine == "fullview" else lc_refuted_count
+    settled = fv_all_alive_converged if engine == "fullview" else lc_quiet_all_alive
+    for _ in range(part_ticks):
+        sim.tick(part)
+    # cross-partition suspicion mass at heal time: (majority observer,
+    # minority subject) pairs believed >= SUSPECT
+    from ringpop_tpu.swim.member import SUSPECT
+
+    if engine == "fullview":
+        status = np.asarray(sim.state.status)
+        cross = int((status[np.ix_(range(len(minority), n), minority)] >= SUSPECT).sum())
+    else:
+        bs = np.asarray(lifecycle.believed_status(sim.state, minority))
+        cross = int((bs[len(minority):, :] >= SUSPECT).sum())
+    for t in range(1, quiet_ticks + 1):
+        sim.tick(clean)
+        if t % 4 == 0 and settled(sim):
+            return cross, True, t, refuted(sim)
+    return cross, False, quiet_ticks, refuted(sim)
 
 
 def quiescence_run(engine: str, n: int, seed: int, ticks=60):

@@ -11,11 +11,16 @@ test: ``swim/state_transitions.go:90-117`` (suspicion→faulty timing),
 ``swim/memberlist.go:337-354`` (refutation-by-reincarnation),
 ``swim/node.go:470-513`` (probe path).
 
-Measured baseline for the chosen params (n=256, 6-seed pilot): detection
-medians 22 (fullview) vs 24 (lifecycle) ticks; drop-induced refutation
-counts 8.7 vs 10.5 mean; recovery 100% both.  Tolerances below are ~3x the
-observed gaps, so they catch a *material* distortion (e.g. a broken timer
-path doubling detection latency), not seed noise.
+Measured baseline for the chosen params (n=256, 50 seeds, round 3):
+detection medians 22 (fullview) vs 24 (lifecycle), p90 24 vs 24, mean
+ratio 1.04; drop-induced refutation counts 9.5 vs 9.1 mean; recovery 100%
+both (lifecycle settles faster post-drop: median 8 vs 40 ticks — the
+aggregate representation folds refutations in one pass).  Tolerances
+below sit just above those measured gaps — p50/p90 within 2 ticks, mean
+ratio within 1.15x — tight enough that a ~15% systematic distortion from
+any of the four documented lifecycle approximations
+(``sim/lifecycle.py`` module docstring) fails the suite.  The runs are
+fully seeded, so the assertions are deterministic, not flaky.
 """
 
 from __future__ import annotations
@@ -25,18 +30,20 @@ import pytest
 
 from tests.engine_agreement import (
     detection_latency,
+    partition_run,
     quiescence_run,
     refutation_run,
 )
 
 N = 256
-SEEDS = 20
+SEEDS = 50
+PARTITION_SEEDS = 8
 
 
 @pytest.mark.slow
 def test_detection_latency_distributions_agree():
-    """Crash 3 nodes; both engines must detect in every seed, with medians
-    within 8 ticks and means within 1.5x of each other."""
+    """Crash 3 nodes; both engines must detect in every seed, with p50 and
+    p90 within 2 ticks and means within 1.15x of each other."""
     rng = np.random.default_rng(7)
     victim_sets = [
         sorted(rng.choice(N, size=3, replace=False).tolist()) for _ in range(SEEDS)
@@ -52,9 +59,13 @@ def test_detection_latency_distributions_agree():
     )
     assert (fv < max_ticks).all(), f"fullview failed to detect: {fv}"
     assert (lc < max_ticks).all(), f"lifecycle failed to detect: {lc}"
-    assert abs(np.median(fv) - np.median(lc)) <= 8, (np.median(fv), np.median(lc))
+    assert abs(np.median(fv) - np.median(lc)) <= 2, (np.median(fv), np.median(lc))
+    assert abs(np.percentile(fv, 90) - np.percentile(lc, 90)) <= 2, (
+        np.percentile(fv, 90),
+        np.percentile(lc, 90),
+    )
     ratio = lc.mean() / fv.mean()
-    assert 1 / 1.5 <= ratio <= 1.5, (fv.mean(), lc.mean())
+    assert 1 / 1.15 <= ratio <= 1.15, (fv.mean(), lc.mean())
 
 
 @pytest.mark.slow
@@ -73,6 +84,27 @@ def test_refutation_counts_and_recovery_agree():
     assert fv_counts.mean() > 0 and lc_counts.mean() > 0
     ratio = lc_counts.mean() / fv_counts.mean()
     assert 1 / 3 <= ratio <= 3, (fv_counts.mean(), lc_counts.mean())
+
+
+@pytest.mark.slow
+def test_asymmetric_partition_recovery_agrees():
+    """30/70 hard partition, healed while cross-suspicions are in flight:
+    both engines must breed cross-partition suspicion mass of the same
+    magnitude during the split and, once healed, return every seed to an
+    all-alive converged view (reference semantics:
+    ``swim/node.go:494-510`` indirect-probe suspicion across a split +
+    ``memberlist.go:337-354`` refutation)."""
+    fv = [partition_run("fullview", N, 300 + s) for s in range(PARTITION_SEEDS)]
+    lc = [partition_run("lifecycle", N, 300 + s) for s in range(PARTITION_SEEDS)]
+    assert all(r[1] for r in fv), f"fullview failed to recover: {fv}"
+    assert all(r[1] for r in lc), f"lifecycle failed to recover: {lc}"
+    fv_cross = np.array([r[0] for r in fv], float)
+    lc_cross = np.array([r[0] for r in lc], float)
+    # the split must actually cause cross-partition suspicion in both
+    # engines, at the same magnitude
+    assert fv_cross.mean() > 0 and lc_cross.mean() > 0, (fv_cross, lc_cross)
+    ratio = lc_cross.mean() / fv_cross.mean()
+    assert 1 / 3 <= ratio <= 3, (fv_cross.mean(), lc_cross.mean())
 
 
 def test_steady_state_quiescence_agrees():

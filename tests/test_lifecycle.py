@@ -56,9 +56,14 @@ def test_crash_detected_and_becomes_faulty():
 def test_false_suspicion_refuted():
     """Suspicion of a LIVE node is refuted by reincarnation: the victim
     reasserts Alive at a higher incarnation and never turns faulty."""
+    import functools
+
+    import jax
+
     n = 48
     params = LifecycleParams(n=n, k=32, suspect_ticks=12)
     state = init_state(params, seed=2)
+    jstep = jax.jit(functools.partial(step, params))  # 68 eager ticks cost ~19 s
     # drop every message for a while: probes fail, suspects pile up,
     # but ping-reqs also fail -> inconclusive, no declarations. Instead,
     # partition node 5 away briefly so it gets suspected, then heal.
@@ -67,13 +72,13 @@ def test_false_suspicion_refuted():
     part = DeltaFaults(up=jnp.ones(n, bool), group=jnp.asarray(group))
     heal = DeltaFaults(up=jnp.ones(n, bool))
     for _ in range(8):
-        state = step(params, state, part)
+        state = jstep(state, part)
     # under partition some nodes should have declared node 5 suspect
     sus = believed_status(state, [5])
     assert int((sus == SUSPECT).sum()) > 0
     # heal before the suspicion deadline can finish propagating faulty
     for _ in range(60):
-        state = step(params, state, heal)
+        state = jstep(state, heal)
     final = believed_status(state, [5])
     assert bool((final == ALIVE).all()), np.asarray(final).tolist()
     # refutation bumped the victim's incarnation
@@ -196,6 +201,10 @@ def test_detection_complete_matches_fraction():
     while_loop) must agree with ``(detection_fraction >= 1).all()`` on the
     same rich mixed states the large-path test uses — including the
     all-detected end state and base-only (no-slot) subjects."""
+    import functools
+
+    import jax
+
     from ringpop_tpu.sim.lifecycle import detection_complete, detection_fraction
 
     n = 96
@@ -203,16 +212,23 @@ def test_detection_complete_matches_fraction():
     victims = [5, 40, 41, 77]
     faults = make_faults(n, down=victims, drop=0.08)
     subject_sets = ([5], victims, victims + [0, 17, 60])
+    # jit both sides per (shape, min_status) combo: 360 eager evaluations
+    # of these queries cost ~100 s of pure dispatch on one core
+    jc = jax.jit(
+        functools.partial(detection_complete), static_argnames="min_status"
+    )
+    jf = jax.jit(
+        functools.partial(detection_fraction), static_argnames="min_status"
+    )
     checked_true = 0
     for _ in range(40):
         sim.run(8, faults)
         for subjects in subject_sets:
+            subj = jnp.asarray(subjects, jnp.int32)
             for min_status in (SUSPECT, FAULTY, TOMBSTONE):
-                frac = np.asarray(
-                    detection_fraction(sim.state, subjects, faults, min_status)
-                )
+                frac = np.asarray(jf(sim.state, subj, faults, min_status=min_status))
                 want = bool((frac >= 1.0).all())
-                got = bool(detection_complete(sim.state, subjects, faults, min_status))
+                got = bool(jc(sim.state, subj, faults, min_status=min_status))
                 assert got == want, (subjects, min_status, frac)
                 checked_true += want
     assert checked_true > 0, "never reached a detected state — test too weak"
