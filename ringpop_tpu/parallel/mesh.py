@@ -5,8 +5,13 @@ The reference scales by running more processes connected over TChannel
 arrays over a ``jax.sharding.Mesh`` and letting GSPMD insert the
 collectives:
 
-* ``DeltaState.learned/pcount [N, K]`` shard as ``("node", "rumor")`` — a 2D
-  mesh: node-axis data parallelism × rumor-axis model parallelism.
+* ``DeltaState`` planes shard as ``("node", "rumor")`` — a 2D mesh:
+  node-axis data parallelism × rumor-axis model parallelism.  NOTE the
+  rumor axis counts different units per leaf: ``pcount [N, K]`` shards K
+  SLOTS, while the bit-packed ``learned``/``ride_ok`` ``uint32[N, K/32]``
+  shard WORDS — so K must supply at least 32 slots per rumor shard
+  (k >= 32 * rumor_axis_size), the constraint behind the k=64 minima in
+  the tests and ``dryrun_multichip``.
 * the per-tick cross-shard traffic is the ping scatter/gather
   (``segment_max`` by target + row gather), which XLA lowers to
   all-to-all/all-gather over ICI — the message-exchange analog of the
@@ -65,6 +70,7 @@ def delta_shardings(mesh: Mesh) -> DeltaState:
     return DeltaState(
         learned=NamedSharding(mesh, P("node", "rumor")),
         pcount=NamedSharding(mesh, P("node", "rumor")),
+        ride_ok=NamedSharding(mesh, P("node", "rumor")),
         tick=NamedSharding(mesh, P()),
         key=NamedSharding(mesh, P()),
     )

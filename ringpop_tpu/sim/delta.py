@@ -8,7 +8,8 @@ node's view is EXACTLY determined by *which* of the K in-flight changes it
 has learned.  So the cluster state compresses to:
 
 * a change table (member, incarnation, status) × K — the rumors in flight;
-* ``learned[N, K]``  — which rumors each node has absorbed;
+* ``learned[N, W]``  — which rumors each node has absorbed, BIT-PACKED 32
+  slots per uint32 word along the rumor axis (``sim/packbits``);
 * ``pcount[N, K]``   — per-node piggyback counters with the SWIM maxP bound
   (``disseminator.go:75-97``).
 
@@ -38,10 +39,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ringpop_tpu.sim.packbits import (
+    and_reduce_rows,
+    or_reduce_rows,
+    pack_bool,
+    row_mask,
+    unpack_bits,
+)
+
 
 class DeltaState(NamedTuple):
-    learned: jax.Array  # bool[N, K]
+    learned: jax.Array  # uint32[N, W], W = ceil(K/32) — packed rumor bits
     pcount: jax.Array  # int8[N, K]
+    # derived invariant, carried so it is MATERIALIZED at tick boundaries:
+    # ride_ok == pack_bool(pcount < max_p).  Recomputing it inside the tick
+    # lets XLA:CPU inline the 32-wide pack-reduce into the per-element
+    # pcount fusion (one re-derivation per BIT — measured 2x tick cost);
+    # a loop-carried leaf is the one materialization fence XLA cannot
+    # strip.  ``step`` maintains it; ``init_state`` seeds it all-riding.
+    ride_ok: jax.Array  # uint32[N, W]
     tick: jax.Array  # int32
     key: jax.Array  # PRNG key
 
@@ -114,10 +130,14 @@ def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray]
     n, k = params.n, params.k
     if sources is None:
         sources = np.arange(k, dtype=np.int64) % n
-    learned = jnp.zeros((n, k), dtype=bool).at[jnp.asarray(sources), jnp.arange(k)].set(True)
+    learned_b = jnp.zeros((n, k), dtype=bool).at[jnp.asarray(sources), jnp.arange(k)].set(True)
     return DeltaState(
-        learned=learned,
+        learned=pack_bool(learned_b),
         pcount=jnp.zeros((n, k), dtype=jnp.int8),
+        ride_ok=pack_bool(
+            jnp.zeros((n, k), jnp.int8)
+            < jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
+        ),
         tick=jnp.asarray(0, jnp.int32),
         key=jax.random.PRNGKey(seed),
     )
@@ -125,8 +145,12 @@ def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray]
 
 def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> DeltaState:
     """One protocol period for all N nodes (jit/shard-friendly: fixed
-    shapes; with the default "shift" topology the whole exchange is rolls
-    and gathers — no scatter)."""
+    shapes; with the default "shift" topology the whole exchange is
+    bitwise word ops on the packed plane plus index-vector row gathers —
+    no scatter, and no traced-shift rolls, whose slice-select lowering
+    XLA:CPU re-derives per consuming element; see PERF.md "Round 3").
+    Value-identical to the unpacked formulation — certified bit-for-bit
+    by tests/test_delta_golden.py."""
     n, k = params.n, params.k
     max_p = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
     key, k_target, k_drop = jax.random.split(state.key, 3)
@@ -148,60 +172,105 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     if faults.drop_rate > 0:
         conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
 
-    active = state.pcount < max_p
-    riding = state.learned & active
-
-    # request leg: sender i's rumors land at targets[i]
-    sent = riding & conn[:, None]
     if shift_mode:
-        # targets form a cyclic permutation: delivery is a roll, receipt
-        # uniqueness is structural (node j is pinged only by j-s)
-        inbound = jnp.roll(sent, s, axis=0)
-        got_pinged = jnp.roll(conn, s)
+        ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
+        cmask = row_mask(conn)
+        riding_w = state.learned & ride_ok_w
+        # request leg: sender i's rumors land at targets[i].  The cyclic
+        # permutation makes delivery a row gather (receipt uniqueness is
+        # structural: node j is pinged only by j-s).
+        idx_fwd = jnp.mod(i_all - s, n)
+        sent_w = riding_w & cmask
+        inbound_w = sent_w[idx_fwd]
+        got_pinged = conn[idx_fwd]
+        learned1_w = state.learned | inbound_w
+        # response leg: the target's riding rumors come back to the pinger
+        answerable_w = learned1_w & ride_ok_w
+        resp_w = answerable_w[jnp.mod(i_all + s, n)] & cmask
+        learned2_w = learned1_w | resp_w
+        # bump = sent + (riding & got_pinged) = riding * (conn + got):
+        # the bit factor is ONE materialized-plane product (learned &
+        # ride_ok are both state carries), the rest is per-row scalars —
+        # so the int8 pass reads two words per 32 elements instead of
+        # re-deriving the sent/resp gather chains per bit
+        riding_bit = unpack_bits(riding_w, k)
+        bump = riding_bit.astype(jnp.int8) * (
+            conn.astype(jnp.int8) + got_pinged.astype(jnp.int8)
+        )[:, None]
+        newly_bit = unpack_bits(learned2_w & ~state.learned, k)
     else:
+        learned0_b = unpack_bits(state.learned, k)
+        ride_ok_b = state.pcount < max_p
+        riding_b = learned0_b & ride_ok_b
+        sent_b = riding_b & conn[:, None]
         # scatter-or by target (bool max == or; duplicate targets merge)
-        inbound = jax.ops.segment_max(sent, targets, num_segments=n)
+        inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
         got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
-    learned = state.learned | inbound
+        learned1_b = learned0_b | inbound_b
+        answerable_b = learned1_b & ride_ok_b
+        resp_b = answerable_b[targets] & conn[:, None]
+        learned2_b = learned1_b | resp_b
+        learned2_w = pack_bool(learned2_b)
+        bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
+            jnp.int8
+        )
+        newly_bit = learned2_b & ~learned0_b
 
-    # response leg: the target's riding rumors come back to the pinger
-    answerable = learned & (state.pcount < max_p)
-    resp = (jnp.roll(answerable, -s, axis=0) if shift_mode else answerable[targets]) & conn[:, None]
-    learned = learned | resp
-
-    # piggyback bumps: sender on success; receiver once per busy tick
-    bump = sent.astype(jnp.int8) + (riding & got_pinged[:, None]).astype(jnp.int8)
-    pcount = jnp.minimum(state.pcount + bump, max_p)
+    # piggyback bumps: sender on success; receiver once per busy tick;
     # newly learned rumors start at pcount 0 (RecordChange)
-    pcount = jnp.where(learned & ~state.learned, jnp.int8(0), pcount)
+    pcount_mid = jnp.minimum(state.pcount + bump, max_p)
+    pcount_mid = jnp.where(newly_bit, jnp.int8(0), pcount_mid)
 
     # full-sync analog (disseminator.go:156-304): a rumor whose piggyback
     # counters all expired short of full coverage (e.g. it saturated one
     # side of a partition) is re-seeded, the way checksum-mismatch full
     # syncs repair divergence the maxP bound left behind
-    live = up[:, None]
-    fully = jnp.all(learned | ~live, axis=0)
-    stuck = ~((learned & live & (pcount < max_p)).any(axis=0)) & ~fully
-    pcount = jnp.where(stuck[None, :] & learned, jnp.int8(0), pcount)
+    up_mask = row_mask(up)
+    mid_ride_w = pack_bool(pcount_mid < max_p)  # materialized reduce output
+    fully = unpack_bits(and_reduce_rows(learned2_w | row_mask(~up)), k)
+    riding_now_w = learned2_w & up_mask & mid_ride_w
+    stuck = ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully
+    stuck_w = pack_bool(stuck)
+    # one fused reset pass over the int8 plane, reading packed words
+    reset_w = learned2_w & stuck_w[None, :]
+    pcount = jnp.where(unpack_bits(reset_w, k), jnp.int8(0), pcount_mid)
+    # maintain the carried invariant: riding resumes where the stuck reset
+    # re-opened counters, plus wherever the mid gate was already open
+    ride_ok_next = mid_ride_w | reset_w
 
-    return DeltaState(learned=learned, pcount=pcount, tick=state.tick + 1, key=key)
+    return DeltaState(
+        learned=learned2_w, pcount=pcount, ride_ok=ride_ok_next, tick=state.tick + 1, key=key
+    )
 
 
 def converged_fraction(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Array:
-    """Fraction of (live node, rumor) pairs delivered."""
+    """Fraction of (live node, rumor) pairs delivered (popcount over the
+    packed plane; tail bits are structurally zero so they never count)."""
+    k = state.pcount.shape[1]
+    n = state.learned.shape[0]
+    # float32-accumulated: a uint32 popcount sum wraps at n*k >= 2^32 bits
+    # (hit exactly at the 16M x 256 config) and would report 0.0 for a
+    # fully converged plane.  Per-row counts (<= K) are float32-exact and
+    # the global sum's ~1e-7 relative error is far below any use of a
+    # coverage fraction.
+    bits = jax.lax.population_count(state.learned).sum(axis=1, dtype=jnp.float32)
     if faults.up is not None:
-        live = state.learned[faults.up]
-        return live.mean()
-    return state.learned.mean()
+        live = faults.up
+        return jnp.where(live, bits, 0.0).sum() / (jnp.maximum(live.sum(), 1) * k)
+    return bits.sum() / (n * k)
 
 
 def converged(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Array:
     """bool scalar, on-device: have all rumors reached every live node?
     (Dead rows are vacuously done — a fused masked reduce, no dynamic
     shapes, so it can sit inside a jitted loop.)"""
-    if faults.up is None:
-        return state.learned.all()
-    return (state.learned | ~faults.up[:, None]).all()
+    k = state.pcount.shape[1]
+    plane = (
+        state.learned
+        if faults.up is None
+        else state.learned | row_mask(~faults.up)
+    )
+    return unpack_bits(and_reduce_rows(plane), k).all()
 
 
 def until_loop(run_block, state, max_blocks, pred):

@@ -345,14 +345,19 @@ def step(
     # (the unpacks fuse into this int8 pass; with gather-based rolls their
     # producer chains are one lookup per element, so the fusion stays thin)
     if shift_mode:
-        sent_bit = unpack_bits(sent_w, k)
-        rg_bit = unpack_bits(riding_w, k) & got_pinged[:, None]
+        # bump = sent + (riding & got_pinged) = riding * (delivered + got):
+        # one packed-plane bit factor + per-row scalars (same restructure
+        # as delta.step — the sent plane's gather chain never has to be
+        # re-derived inside the int8 pass)
+        bump = unpack_bits(riding_w, k).astype(jnp.int8) * (
+            delivered.astype(jnp.int8) + got_pinged.astype(jnp.int8)
+        )[:, None]
         newly_bit = unpack_bits(learned2_w & ~state.learned, k)
     else:
-        sent_bit = sent_b
-        rg_bit = riding_b & got_pinged[:, None]
+        bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
+            jnp.int8
+        )
         newly_bit = learned2_b & ~learned0_b
-    bump = sent_bit.astype(jnp.int8) + rg_bit.astype(jnp.int8)
     pcount_a = jnp.minimum(state.pcount + bump, maxp)
     pcount_a = jnp.where(newly_bit, jnp.int8(0), pcount_a)
     if params.heal_prob > 0:

@@ -1,0 +1,90 @@
+"""Capture golden per-tick delta-engine trajectories (same contract as
+``capture_lifecycle_golden.py``: freeze the exact state evolution so the
+engine's internal representation can be restructured — e.g. the round-3
+bitpacked ``learned`` — with bit-for-bit proof that the dissemination
+semantics, PRNG draw order included, did not move).
+
+Run offline (``python tests/capture_delta_golden.py``) to (re)capture;
+replayed by ``tests/test_delta_golden.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from ringpop_tpu.sim import delta  # noqa: E402
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "delta_traj.npz")
+
+# (name, params-kwargs, sources, fault schedule, ticks, seed) — the fault
+# schedule works like the lifecycle capture's: the entry with the largest
+# first_tick <= t applies at tick t.
+CONFIGS = [
+    ("plain_shift", dict(n=64, k=32), None, [(0, dict())], 40, 1),
+    (
+        "uniform_drop_tail12",
+        dict(n=48, k=12, exchange="uniform"),
+        None,
+        [(0, dict(drop=0.1))],
+        60,
+        2,
+    ),
+    (
+        "partition_heal_stuck",
+        dict(n=64, k=16),
+        np.zeros(16, np.int64),
+        [(0, dict(group=[0] * 32 + [1] * 32)), (60, dict())],
+        120,
+        3,
+    ),
+    (
+        "deadnodes_maxp2_tail48",
+        dict(n=40, k=48, max_p=2),
+        None,
+        [(0, dict(down=[3, 9, 22, 23, 39]))],
+        60,
+        4,
+    ),
+]
+
+
+from tests.sim_faults import make_faults  # noqa: E402
+
+
+def run_config(pkw, sources, fault_sched, ticks, seed):
+    import functools
+
+    params = delta.DeltaParams(**pkw)
+    state = delta.init_state(params, seed=seed, sources=sources)
+    stepper = jax.jit(functools.partial(delta.step, params))
+    frames = []
+    for t in range(ticks):
+        fkw = max((e for e in fault_sched if e[0] <= t), key=lambda e: e[0])[1]
+        state = stepper(state, make_faults(params.n, **fkw))
+        frames.append({f: np.asarray(getattr(state, f)) for f in state._fields})
+    return {f: np.stack([fr[f] for fr in frames]) for f in frames[0]}
+
+
+def main() -> None:
+    out = {}
+    for name, pkw, sources, fault_sched, ticks, seed in CONFIGS:
+        print(f"capturing {name} ...", flush=True)
+        traj = run_config(pkw, sources, fault_sched, ticks, seed)
+        for f, arr in traj.items():
+            out[f"{name}/{f}"] = arr
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    np.savez_compressed(GOLDEN_PATH, **out)
+    print(f"wrote {GOLDEN_PATH} ({os.path.getsize(GOLDEN_PATH) / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -92,12 +92,7 @@ CONFIGS = [
 ]
 
 
-def make_faults(n, down=(), group=None, drop=0.0):
-    up = np.ones(n, bool)
-    for i in down:
-        up[i] = False
-    g = None if group is None else jnp.asarray(group, jnp.int32)
-    return DeltaFaults(up=jnp.asarray(up), group=g, drop_rate=drop)
+from tests.sim_faults import make_faults  # noqa: E402
 
 
 def run_config(pkw, fault_sched, admits, ticks, seed):

@@ -42,12 +42,7 @@ from ringpop_tpu.sim.delta import DeltaFaults
 from ringpop_tpu.swim.member import ALIVE, FAULTY
 
 
-def make_faults(n, down=(), drop=0.0, group=None):
-    up = np.ones(n, bool)
-    for i in down:
-        up[i] = False
-    g = None if group is None else jnp.asarray(np.asarray(group, np.int32))
-    return DeltaFaults(up=jnp.asarray(up), drop_rate=drop, group=g)
+from tests.sim_faults import make_faults  # noqa: E402
 
 
 # -- fullview queries -------------------------------------------------------

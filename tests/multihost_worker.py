@@ -39,14 +39,20 @@ def main() -> None:
     from ringpop_tpu.parallel.mesh import delta_shardings
     from ringpop_tpu.sim.delta import DeltaParams, init_state, step
 
-    params = DeltaParams(n=64, k=16)
+    # k=64 -> the packed learned plane is uint32[N, 2] words: one word per
+    # rumor-axis shard
+    params = DeltaParams(n=64, k=64)
     sh = delta_shardings(mesh)
     state = jax.jit(lambda: init_state(params, seed=0), out_shardings=sh)()
     out = jax.jit(functools.partial(step, params), in_shardings=(sh,), out_shardings=sh)(state)
     jax.block_until_ready(out)
     assert int(out.tick) == 1
-    # dissemination progressed globally (the roll exchange crossed processes)
-    assert int(out.learned.sum()) > int(state.learned.sum())
+    # dissemination progressed globally (the exchange crossed processes);
+    # popcount, not sum — the packed words are not a bit count
+    def bits(s):
+        return int(jax.lax.population_count(s.learned).sum())
+
+    assert bits(out) > bits(state)
     print(f"rank {pid} OK", flush=True)
 
 

@@ -1,0 +1,18 @@
+"""The one shared ``make_faults`` helper for sim-plane tests and golden
+captures (previously four byte-equivalent copies — any new DeltaFaults
+field had to be threaded through all of them)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim.delta import DeltaFaults
+
+
+def make_faults(n, down=(), group=None, drop=0.0):
+    up = np.ones(n, bool)
+    for i in down:
+        up[i] = False
+    g = None if group is None else jnp.asarray(np.asarray(group, np.int32))
+    return DeltaFaults(up=jnp.asarray(up), group=g, drop_rate=drop)

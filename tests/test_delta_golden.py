@@ -1,0 +1,48 @@
+"""Golden-trajectory regression for the delta engine — the dissemination
+twin of ``test_lifecycle_golden.py``: every field of every tick must
+reproduce bit-for-bit across representation changes (the packed
+``learned`` plane included), PRNG draw order and all."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import delta
+
+from tests.capture_delta_golden import CONFIGS, GOLDEN_PATH, run_config
+from tests.test_lifecycle_golden import _as_bool_plane
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(GOLDEN_PATH)
+
+
+@pytest.mark.parametrize(
+    "name,pkw,sources,fault_sched,ticks,seed",
+    CONFIGS,
+    ids=[c[0] for c in CONFIGS],
+)
+def test_trajectory_bit_identical(golden, name, pkw, sources, fault_sched, ticks, seed):
+    params = delta.DeltaParams(**pkw)
+    k = params.k
+    traj = run_config(pkw, sources, fault_sched, ticks, seed)
+    for field in delta.DeltaState._fields:
+        if f"{name}/{field}" not in golden:
+            continue  # fields added after capture are checked by invariant below
+        want = golden[f"{name}/{field}"]
+        got = traj[field]
+        if field == "learned":
+            want, got = _as_bool_plane(want, k), _as_bool_plane(got, k)
+        assert got.shape == want.shape, (field, got.shape, want.shape)
+        mism = np.flatnonzero((got != want).reshape(ticks, -1).any(axis=1))
+        assert mism.size == 0, (
+            f"{name}: field {field} diverges first at tick {mism[0] if mism.size else '?'}"
+        )
+    # the carried ride_ok plane is derived state: its invariant pins it to
+    # the golden-checked pcount at every tick
+    max_p = min(params.resolved_max_p(), 126)
+    want_ride = traj["pcount"] < max_p
+    got_ride = _as_bool_plane(traj["ride_ok"], k)
+    assert (got_ride == want_ride).all(), f"{name}: ride_ok invariant broken"

@@ -155,7 +155,9 @@ class TestDelta:
         parted = DeltaFaults(group=jnp.asarray(group))
         for _ in range(64):
             sim.tick(parted)
-        learned = np.asarray(sim.state.learned)
+        from ringpop_tpu.sim.packbits import unpack_bits
+
+        learned = np.asarray(unpack_bits(sim.state.learned, k))
         assert learned[: n // 2].all()  # side 0 fully infected
         assert not learned[n // 2 :].any()  # side 1 isolated
 
@@ -189,14 +191,17 @@ class TestDelta:
         sim = DeltaSim(n, 8, seed=4)
         ticks, ok = sim.run_until_converged(faults)
         assert ok  # converged over LIVE nodes
-        assert not bool(np.asarray(sim.state.learned)[50].all())
+        from ringpop_tpu.sim.packbits import unpack_bits
+
+        assert not bool(np.asarray(unpack_bits(sim.state.learned, 8))[50].all())
 
 
 class TestMeshSharding:
     def test_sharded_step_matches_single_device(self):
         from ringpop_tpu.parallel.mesh import make_mesh, shard_delta_state, sharded_delta_step
 
-        params = DeltaParams(n=64, k=16)
+        # k=64 -> packed learned is uint32[N, 2]: one word per rumor shard
+        params = DeltaParams(n=64, k=64)
         state = delta_init(params, seed=5)
         mesh = make_mesh(8)
         sharded = shard_delta_state(state, mesh)
