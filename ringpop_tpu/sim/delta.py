@@ -256,7 +256,10 @@ def converged_fraction(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -
     bits = jax.lax.population_count(state.learned).sum(axis=1, dtype=jnp.float32)
     if faults.up is not None:
         live = faults.up
-        return jnp.where(live, bits, 0.0).sum() / (jnp.maximum(live.sum(), 1) * k)
+        # float32 denominator too: an int32 live.sum() * k wraps (to
+        # exactly zero at 16M live x k=256)
+        denom = jnp.maximum(live.sum(dtype=jnp.float32), 1.0) * k
+        return jnp.where(live, bits, 0.0).sum() / denom
     return bits.sum() / (n * k)
 
 

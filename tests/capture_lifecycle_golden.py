@@ -29,9 +29,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import jax.numpy as jnp  # noqa: E402
-
-from ringpop_tpu.sim.delta import DeltaFaults  # noqa: E402
 from ringpop_tpu.sim import lifecycle  # noqa: E402
 
 GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "lifecycle_traj.npz")

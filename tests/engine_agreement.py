@@ -38,7 +38,6 @@ except RuntimeError:
 import jax.numpy as jnp
 
 from ringpop_tpu.sim import fullview, lifecycle
-from ringpop_tpu.sim.delta import DeltaFaults
 from ringpop_tpu.swim.member import ALIVE, FAULTY
 
 

@@ -42,7 +42,7 @@ def test_trajectory_bit_identical(golden, name, pkw, sources, fault_sched, ticks
         )
     # the carried ride_ok plane is derived state: its invariant pins it to
     # the golden-checked pcount at every tick
-    max_p = min(params.resolved_max_p(), 126)
+    max_p = min(params.resolved_max_p(), delta.INT8_SAFE_MAX_P)
     want_ride = traj["pcount"] < max_p
     got_ride = _as_bool_plane(traj["ride_ok"], k)
     assert (got_ride == want_ride).all(), f"{name}: ride_ok invariant broken"
