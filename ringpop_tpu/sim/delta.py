@@ -75,6 +75,17 @@ def resolve_max_p(n: int, p_factor: int, max_p: Optional[int]) -> int:
     return int(p_factor * np.ceil(np.log10(n + 1)))
 
 
+def clamped_max_p(params) -> int:
+    """The int8-safe piggyback cap every engine compares counters against.
+    ONE definition on purpose: the carried ``ride_ok`` invariant
+    (== ``pack_bool(pcount < clamped_max_p)``) is maintained at several
+    sites per engine (init, step, admit, snapshot migration, the golden
+    tests), and any two of them disagreeing on the clamp silently corrupts
+    the gate.  Works for DeltaParams and LifecycleParams alike (both carry
+    ``resolved_max_p``)."""
+    return min(params.resolved_max_p(), INT8_SAFE_MAX_P)
+
+
 @dataclass(frozen=True)
 class DeltaParams:
     n: int
@@ -136,7 +147,7 @@ def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray]
         pcount=jnp.zeros((n, k), dtype=jnp.int8),
         ride_ok=pack_bool(
             jnp.zeros((n, k), jnp.int8)
-            < jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
+            < jnp.int8(clamped_max_p(params))
         ),
         tick=jnp.asarray(0, jnp.int32),
         key=jax.random.PRNGKey(seed),
@@ -152,7 +163,7 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     Value-identical to the unpacked formulation — certified bit-for-bit
     by tests/test_delta_golden.py."""
     n, k = params.n, params.k
-    max_p = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
+    max_p = jnp.int8(clamped_max_p(params))
     key, k_target, k_drop = jax.random.split(state.key, 3)
     i_all = jnp.arange(n, dtype=jnp.int32)
 

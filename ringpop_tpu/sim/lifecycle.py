@@ -65,8 +65,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.sim.delta import (
-    INT8_SAFE_MAX_P,
     DeltaFaults,
+    clamped_max_p,
     pair_connected as _pair_connected,
     resolve_max_p,
     until_loop,
@@ -110,6 +110,12 @@ class LifecycleState(NamedTuple):
     # bandwidth and trims HBM bytes on TPU
     learned: jax.Array  # uint32[N, W], W = ceil(K/32)
     pcount: jax.Array  # int8[N, K]
+    # derived invariant carried as state: ride_ok == pack_bool(pcount <
+    # maxp).  A loop-carried leaf is the only materialization fence
+    # XLA:CPU honors — recomputing the 32-wide pack-reduce in-tick lets it
+    # inline per consuming element (the lesson sim/delta.py learned the
+    # hard way; see PERF.md "Round 3")
+    ride_ok: jax.Array  # uint32[N, W]
     # converged base view shared by all nodes
     base_status: jax.Array  # int8[N]
     base_inc: jax.Array  # int32[N]
@@ -165,6 +171,10 @@ def init_state_from_key(params: LifecycleParams, key) -> LifecycleState:
         r_deadline=jnp.full((k,), NO_DEADLINE, jnp.int32),
         learned=jnp.zeros((n, n_words(k)), jnp.uint32),
         pcount=jnp.zeros((n, k), jnp.int8),
+        ride_ok=pack_bool(
+            jnp.zeros((n, k), jnp.int8)
+            < jnp.int8(clamped_max_p(params))
+        ),
         base_status=jnp.zeros((n,), jnp.int8),
         base_inc=jnp.zeros((n,), jnp.int32),
         base_present=jnp.ones((n,), bool),
@@ -219,7 +229,7 @@ def step(
     bit-for-bit by tests/test_lifecycle_golden.py."""
     n, k = params.n, params.k
     m = min(params.alloc_per_tick, params.k, params.n)
-    maxp = jnp.int8(min(params.resolved_max_p(), INT8_SAFE_MAX_P))
+    maxp = jnp.int8(clamped_max_p(params))
     key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
     # incarnation epoch = tick counter (strictly increasing, like the
     # reference's wall-ms but 200× denser in int32: 2^28 ticks ≈ 621 days of
@@ -278,7 +288,7 @@ def step(
     # formulation — segment_max has no bitwise-OR combiner — and packs at
     # the end.  Both produce identical bits.)
     if shift_mode:
-        ride_ok_w = pack_bool(state.pcount < maxp)  # one fused pass over pcount
+        ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
         dmask = row_mask(delivered)
         riding_w = state.learned & ride_ok_w & active_w[None, :]
         sent_w = riding_w & dmask
@@ -372,7 +382,8 @@ def step(
 
     # full-sync analog: re-seed rumors that expired short of full coverage
     up_mask = row_mask(up)
-    riding_now_w = learned2h_w & pack_bool(pcount_a < maxp) & active_w[None, :] & up_mask
+    mid_ride_w = pack_bool(pcount_a < maxp)  # reused for the carried gate below
+    riding_now_w = learned2h_w & mid_ride_w & active_w[None, :] & up_mask
     fully_learned = unpack_bits(and_reduce_rows(learned2h_w | row_mask(~up)), k) & active
     has_live_learner = unpack_bits(or_reduce_rows(learned2h_w & up_mask), k)
     stuck = active & ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully_learned
@@ -616,6 +627,14 @@ def step(
         jnp.int8(0),
         pcount_a,
     )
+    # maintain the carried gate invariant ride_ok == pack(pcount < maxp):
+    # a reset-to-zero opens the gate iff maxp > 0 (degenerate max_p=0
+    # configs never ride)
+    reset_w = (
+        pack_bool(freed | placed_col)[None, :]
+        | (pack_bool(stuck)[None, :] & learned2h_w)
+    ) & jnp.where(maxp > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    ride_next = mid_ride_w | reset_w
 
     # refutation bumps the refuter's own incarnation (iff its rumor placed)
     placed_subject = jnp.zeros((n,), bool).at[cand_subj].max(place & (new_status == ALIVE))
@@ -643,6 +662,7 @@ def step(
         r_deadline=r_deadline,
         learned=learned6_w,
         pcount=pcount_final,
+        ride_ok=ride_next,
         base_status=base_status,
         base_inc=base_inc,
         base_present=base_present,
@@ -674,6 +694,7 @@ def state_shardings(mesh) -> LifecycleState:
         r_deadline=sh(P("rumor")),
         learned=sh(P("node", "rumor")),
         pcount=sh(P("node", "rumor")),
+        ride_ok=sh(P("node", "rumor")),
         base_status=sh(P("node")),
         base_inc=sh(P("node")),
         base_present=sh(P("node")),
@@ -706,6 +727,13 @@ def admit(params: LifecycleParams, state: LifecycleState, idx: int) -> Lifecycle
     col = (state.learned[:, w0] & ~bitv) | jnp.where(
         jnp.arange(n) == idx, bitv, jnp.uint32(0)
     )
+    # slot k0's counters reset to 0, so its carried ride gate opens
+    # (invariant ride_ok == pack(pcount < maxp); maxp >= 1 except the
+    # degenerate max_p=0 override, where nothing ever rides)
+    maxp = clamped_max_p(params)
+    ride_col = (
+        (state.ride_ok[:, w0] | bitv) if maxp > 0 else (state.ride_ok[:, w0] & ~bitv)
+    )
     return state._replace(
         r_subject=state.r_subject.at[k0].set(idx),
         r_inc=state.r_inc.at[k0].set(now),
@@ -713,6 +741,7 @@ def admit(params: LifecycleParams, state: LifecycleState, idx: int) -> Lifecycle
         r_deadline=state.r_deadline.at[k0].set(NO_DEADLINE),
         learned=state.learned.at[:, w0].set(col),
         pcount=state.pcount.at[:, k0].set(jnp.int8(0)),
+        ride_ok=state.ride_ok.at[:, w0].set(ride_col),
         self_inc=state.self_inc.at[idx].set(now),
     )
 

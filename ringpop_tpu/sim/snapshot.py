@@ -39,9 +39,16 @@ def save_state(path: str, state) -> None:
     np.savez_compressed(path, __meta__=np.frombuffer(meta.encode(), dtype=np.uint8), **arrays)
 
 
-def load_state(path: str, cls: Type[T]) -> T:
+def load_state(path: str, cls: Type[T], params=None) -> T:
     """Load a snapshot written by :func:`save_state` back into ``cls``.
-    Validates the engine type and field list before reconstructing."""
+    Validates the engine type and field list before reconstructing.
+
+    Migration: snapshots written before the round-3 packed engines carry no
+    ``ride_ok`` plane.  Since it is derived state (== ``pack_bool(pcount <
+    clamped_max_p)``), it is reconstructed here instead of refusing the
+    load.  Pass the run's ``params`` when the snapshot was taken with a
+    non-default ``p_factor``/``max_p`` — without it the default SWIM bound
+    for the snapshot's n is assumed."""
     import jax.numpy as jnp
 
     with np.load(path) as data:
@@ -54,11 +61,27 @@ def load_state(path: str, cls: Type[T]) -> T:
             raise ValueError(
                 f"{path}: snapshot holds {meta['type']}, asked to load {cls.__name__}"
             )
-        if list(meta["fields"]) != list(cls._fields):
-            raise ValueError(
-                f"{path}: field mismatch {meta['fields']} != {list(cls._fields)}"
+        saved = list(meta["fields"])
+        want = list(cls._fields)
+        migrate_ride = saved != want and [f for f in want if f != "ride_ok"] == saved
+        if saved != want and not migrate_ride:
+            raise ValueError(f"{path}: field mismatch {saved} != {want}")
+        out = {f: jnp.asarray(data[f]) for f in saved}
+        if migrate_ride:
+            from ringpop_tpu.sim.delta import (
+                INT8_SAFE_MAX_P,
+                clamped_max_p,
+                resolve_max_p,
             )
-        return cls(**{f: jnp.asarray(data[f]) for f in cls._fields})
+            from ringpop_tpu.sim.packbits import pack_bool
+
+            if params is not None:
+                max_p = clamped_max_p(params)
+            else:
+                n = out["pcount"].shape[0]
+                max_p = min(resolve_max_p(n, 15, None), INT8_SAFE_MAX_P)
+            out["ride_ok"] = pack_bool(out["pcount"] < np.int8(max_p))
+        return cls(**out)
 
 
 # -- orbax backend (optional): async, non-blocking saves ---------------------

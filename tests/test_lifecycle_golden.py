@@ -50,8 +50,16 @@ def golden():
 )
 def test_trajectory_bit_identical(golden, name, pkw, fault_sched, admits, ticks, seed):
     traj = run_config(pkw, fault_sched, admits, ticks, seed)
-    k = lifecycle.LifecycleParams(**pkw).k
+    params = lifecycle.LifecycleParams(**pkw)
+    k = params.k
+    # fields added to the state AFTER the goldens were captured; each must
+    # be pinned by a derived-invariant check below — a field missing from
+    # the npz for any OTHER reason is a stale golden and must fail loudly
+    post_capture_fields = {"ride_ok"}
     for field in _FIELDS_EXACT:
+        if field in post_capture_fields:
+            assert f"{name}/{field}" not in golden  # re-capture drops it from this set
+            continue
         want = golden[f"{name}/{field}"]
         got = traj[field]
         if field == "learned":
@@ -63,3 +71,11 @@ def test_trajectory_bit_identical(golden, name, pkw, fault_sched, admits, ticks,
         assert mism.size == 0, (
             f"{name}: field {field} diverges first at tick {mism[0] if mism.size else '?'}"
         )
+    # the carried ride_ok plane is derived state: its invariant pins it to
+    # the golden-checked pcount at every tick
+    from ringpop_tpu.sim.delta import clamped_max_p
+
+    max_p = clamped_max_p(params)
+    want_ride = traj["pcount"] < max_p
+    got_ride = _as_bool_plane(traj["ride_ok"], k)
+    assert (got_ride == want_ride).all(), f"{name}: ride_ok invariant broken"

@@ -137,3 +137,48 @@ def test_host_membership_export_import(tmp_path):
             n.destroy()
 
     asyncio.run(run())
+
+
+@pytest.mark.parametrize("engine", ["delta", "lifecycle"])
+def test_pre_ride_ok_snapshot_migrates(tmp_path, engine):
+    """Snapshots written before the packed engines carry no ride_ok plane;
+    load_state must reconstruct it from pcount (the carried-gate invariant)
+    instead of refusing — old long-running-sim checkpoints stay loadable."""
+    import json
+
+    if engine == "delta":
+        params = delta.DeltaParams(n=48, k=8)
+        state = delta.init_state(params, seed=5)
+        cls = delta.DeltaState
+        for _ in range(6):
+            state = delta.step(params, state)
+    else:
+        params = lifecycle.LifecycleParams(n=48, k=8, suspect_ticks=4)
+        faults = delta.DeltaFaults(up=jnp.ones(48, bool).at[3].set(False))
+        state = lifecycle.init_state(params, seed=5)
+        cls = lifecycle.LifecycleState
+        for _ in range(6):
+            state = lifecycle.step(params, state, faults)
+
+    # forge the old schema: same arrays minus ride_ok, meta without it
+    path = str(tmp_path / "old.npz")
+    save_state(path, state)
+    with np.load(path) as data:
+        arrays = {f: data[f] for f in data.files if f not in ("__meta__", "ride_ok")}
+    meta = json.dumps(
+        {
+            "magic": "ringpop_tpu-snapshot-v1",
+            "type": cls.__name__,
+            "fields": [f for f in cls._fields if f != "ride_ok"],
+        }
+    )
+    np.savez_compressed(
+        path, __meta__=np.frombuffer(meta.encode(), dtype=np.uint8), **arrays
+    )
+
+    restored = load_state(path, cls, params=params)
+    assert _trees_equal(restored, state)  # ride_ok reconstructed exactly
+    # and without params, the default SWIM bound for this n matches too
+    # (these configs use the default p_factor / max_p)
+    restored_default = load_state(path, cls)
+    assert _trees_equal(restored_default, state)
