@@ -36,6 +36,14 @@ test-accel:
 bench:
 	$(PY) bench.py
 
+# detach the TPU tunnel watcher: probes the axon tunnel all round and runs
+# bench.py + scripts/tpu_ksweep.py the moment the chip answers, committing
+# timestamped captures under captures/ (see scripts/tpu_watch.sh)
+tpu-watch:
+	chmod +x scripts/tpu_watch.sh
+	setsid nohup scripts/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 < /dev/null &
+	@echo "watcher detached; log: /tmp/tpu_watch.log"
+
 # all five BASELINE scenario configs
 simbench:
 	$(PY) -m ringpop_tpu.cli.simbench

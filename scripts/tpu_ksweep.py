@@ -1,0 +1,290 @@
+"""On-chip measurement sweep, run by the tunnel watcher (``make tpu-watch``)
+while the TPU is alive.  This is the round-4 replacement for the off-tree
+round-3 script the verdict rejected as inadmissible: it lives in-tree, it
+stamps every capture with git head + dirty flag + UTC timestamp + config,
+and its per-tick numbers are measured with explicit ``block_until_ready``
+around every timed rep so a sub-60s claim can never be an async-dispatch
+artifact.
+
+Sections (each independently try/excepted; the JSON is rewritten after
+every section so a mid-run tunnel death still leaves partial evidence):
+
+1. **Per-tick cost model** — the centerpiece.  For each k in ``KSWEEP_KS``
+   at n=``KSWEEP_N``: compile one 32-tick lifecycle block, then time
+   synced reps.  This single-sources the "ms/tick at 1M" number that
+   round 3's artifacts disagreed about (0.57 s/64 ticks vs a 142 ms/tick
+   trace reading — see PERF.md round-4 reconciliation).
+2. Headline detection at the official config (k=256, 1000 victims),
+   fresh state, wall + ticks; cross-checked against the cost model.
+3. Convergence (view-checksum agreement + quiescence) continuing from
+   the detected state — the literal BASELINE.md north-star wording.
+4. Delta rumor convergence at 1M and at 16M (16x north-star scale).
+5. Batched ring lookup qps (sustained: 10 batches inside one jitted
+   loop — per-dispatch timing through the tunnel would measure the
+   tunnel, not the op; methodology per bench.py).
+6. Pallas FarmHash kernel vs the jnp lowering (the reference's
+   ``hashring/hashring_test.go:332`` micro-benchmark analog, on-chip).
+
+Reference analog: none — the Go reference has no accelerator plane; this
+is rebuild-owned measurement infrastructure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+# invoked as `python scripts/tpu_ksweep.py` — the repo root (one level up)
+# is not on sys.path then, so add it for the ringpop_tpu imports
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _env_capture() -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _git(*args):
+        try:
+            return subprocess.run(
+                ["git", "-C", repo, *args], capture_output=True, text=True, timeout=10
+            ).stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+    import jax
+
+    return {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "captured_by": "scripts/tpu_ksweep.py",
+        "git_head": _git("rev-parse", "HEAD"),
+        "git_dirty": bool(_git("status", "--porcelain")),
+        "jax_version": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main() -> None:
+    import jax
+
+    # KSWEEP_PIN=cpu for smoke runs: this environment's axon site hook can
+    # initialize the (hang-prone, tunnel-backed) axon client regardless of
+    # JAX_PLATFORMS, so an explicit config pin is the only reliable opt-out
+    pin = os.environ.get("KSWEEP_PIN")
+    if pin:
+        try:
+            jax.config.update("jax_platforms", pin)
+        except RuntimeError:
+            pass  # backend already initialized
+
+    import jax.numpy as jnp
+
+    # same persistent, platform-fingerprinted compile cache as bench.py —
+    # a repeat capture in a later tunnel window pays zero recompiles
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    configure_compile_cache(os.path.join(repo_root, ".jax_cache"))
+
+    out = _env_capture()
+    if os.environ.get("KSWEEP_REQUIRE_TPU") and out["platform"] == "cpu":
+        raise SystemExit(f"KSWEEP_REQUIRE_TPU set but platform={out['platform']}")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_override = os.environ.get("KSWEEP_OUT")
+    if out_override:
+        # smoke/test runs: write ONLY here — never clobber the round's
+        # real .tpu_ksweep.json / captures/ evidence with CPU smoke data
+        paths = (out_override,)
+    else:
+        ts = out["captured_at"].replace(":", "").replace("-", "")
+        cap_dir = os.path.join(repo, "captures")
+        os.makedirs(cap_dir, exist_ok=True)
+        paths = (
+            os.path.join(repo, ".tpu_ksweep.json"),
+            os.path.join(cap_dir, f"tpu_ksweep_{ts}.json"),
+        )
+
+    def flush():
+        blob = json.dumps(out, indent=1)
+        for p in paths:
+            with open(p, "w") as f:
+                f.write(blob)
+
+    flush()
+
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import (
+        DeltaFaults,
+        DeltaParams,
+        init_state,
+        run_until_converged,
+    )
+
+    n = int(os.environ.get("KSWEEP_N", 1_000_000))
+    ks = [int(k) for k in os.environ.get("KSWEEP_KS", "128,256,512").split(",")]
+    k_head = int(os.environ.get("KSWEEP_K_HEADLINE", 256))
+    block = 32
+    reps = int(os.environ.get("KSWEEP_REPS", 3))
+
+    rng = np.random.default_rng(0)
+    victims = np.sort(rng.choice(n, size=max(2, n // 1000), replace=False))
+    up = np.ones(n, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+
+    # -- 1: per-tick cost model across k ------------------------------------
+    out["tick_cost"] = {}
+    for k in ks:
+        try:
+            sim = lifecycle.LifecycleSim(n=n, k=k, seed=0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(sim.run(block, faults))  # compile + first block
+            compile_s = time.perf_counter() - t0
+            per_rep = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(sim.run(block, faults))
+                per_rep.append(time.perf_counter() - t0)
+            out["tick_cost"][str(k)] = {
+                "n": n,
+                "block_ticks": block,
+                "compile_plus_first_block_s": round(compile_s, 3),
+                "block_s_reps": [round(r, 4) for r in per_rep],
+                "ms_per_tick_median": round(sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3),
+            }
+            del sim
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            out["tick_cost"][str(k)] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+
+    # -- 2+3: headline detection then convergence at the official config ----
+    try:
+        sim = lifecycle.LifecycleSim(n=n, k=k_head, seed=0)
+        # warm exactly the device detect loop the timed run uses
+        sim.run_until_detected(victims, faults, max_ticks=block, check_every=block)
+        jax.block_until_ready(sim.state.learned)
+        sim.state = lifecycle.init_state(sim.params, seed=0)
+        t0 = time.perf_counter()
+        ticks, ok = sim.run_until_detected(
+            victims, faults, max_ticks=2048, check_every=block, time_budget_s=900
+        )
+        jax.block_until_ready(sim.state.learned)
+        detect_wall = time.perf_counter() - t0
+        out["detect_headline"] = {
+            "n": n,
+            "k": k_head,
+            "n_victims": int(victims.size),
+            "ticks": ticks,
+            "detected": bool(ok),
+            "wall_s": round(detect_wall, 3),
+            "ms_per_tick_implied": round(detect_wall / max(ticks, 1) * 1e3, 3),
+        }
+        flush()
+        t0 = time.perf_counter()
+        c_ticks, c_ok = sim.run_until_converged(faults, max_ticks=4096, check_every=block)
+        jax.block_until_ready(sim.state.learned)
+        out["converge_after_detect"] = {
+            "extra_ticks": c_ticks,
+            "converged": bool(c_ok),
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "total_ticks": ticks + c_ticks,
+        }
+        del sim
+    except Exception as e:  # pragma: no cover
+        # record the breadcrumb under whichever section was in flight — a
+        # detect_headline that already landed must not swallow a converge
+        # failure (the capture may be the only evidence from this window)
+        err = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if "detect_headline" not in out:
+            out["detect_headline"] = err
+        else:
+            out.setdefault("converge_after_detect", err)
+    flush()
+
+    # -- 4: delta rumor convergence at 1M and 16M ---------------------------
+    for label, dn, dk in (
+        ("delta_1m", n, 128),
+        ("delta_16m", int(os.environ.get("KSWEEP_DELTA_N", 16_000_000)), 64),
+    ):
+        try:
+            params = DeltaParams(n=dn, k=dk)
+            run_until_converged(params, init_state(params, seed=0), max_ticks=8)  # warm
+            state = init_state(params, seed=1)
+            t0 = time.perf_counter()
+            dstate, d_ticks, d_ok = run_until_converged(params, state, max_ticks=4096)
+            jax.block_until_ready(dstate.learned)
+            out[label] = {
+                "n": dn,
+                "k": dk,
+                "ticks": d_ticks,
+                "converged": bool(d_ok),
+                "wall_s": round(time.perf_counter() - t0, 3),
+            }
+        except Exception as e:  # pragma: no cover
+            out[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+
+    # -- 5: sustained batched ring lookup -----------------------------------
+    try:
+        from ringpop_tpu.ops.ring_ops import build_ring_tokens, ring_lookup
+
+        servers = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(4096)]
+        tokens, owners = build_ring_tokens(servers, 256)
+        batch = 1_000_000
+        hashes = jnp.asarray(
+            np.random.default_rng(0).integers(0, 2**32, size=batch, dtype=np.uint32)
+        )
+
+        @jax.jit
+        def qps_loop(tokens, owners, hashes):
+            def body(i, acc):
+                o = ring_lookup(tokens, owners, hashes + i.astype(hashes.dtype))
+                return acc + o.astype(jnp.uint32).sum()
+
+            return jax.lax.fori_loop(0, 10, body, jnp.uint32(0))
+
+        jax.block_until_ready(qps_loop(tokens, owners, hashes))
+        t0 = time.perf_counter()
+        jax.block_until_ready(qps_loop(tokens, owners, hashes))
+        out["ring_lookup_qps"] = round(batch * 10 / (time.perf_counter() - t0), 0)
+    except Exception as e:  # pragma: no cover
+        out["ring_lookup_qps"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
+    # -- 6: Pallas FarmHash vs jnp lowering ---------------------------------
+    try:
+        from ringpop_tpu.hashing.farm import pack_strings
+        from ringpop_tpu.ops.hash_ops import fingerprint32_device
+        from ringpop_tpu.ops.hash_pallas import fingerprint32_pallas
+
+        nh = 262_144
+        addrs = [
+            f"10.{i % 256}.{(i >> 8) % 256}.{i % 100}:{3000 + i % 64}" for i in range(nh)
+        ]
+        mat, lens = pack_strings(addrs)
+        mat, lens = jnp.asarray(mat), jnp.asarray(lens)
+        for label, fn in (("farm_pallas", fingerprint32_pallas), ("farm_jnp", fingerprint32_device)):
+            try:
+                jax.block_until_ready(fn(mat, lens))  # compile
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    r = fn(mat, lens)
+                jax.block_until_ready(r)
+                dt = (time.perf_counter() - t0) / 5
+                out[label] = {"s": round(dt, 5), "mhashes_per_s": round(nh / dt / 1e6, 1)}
+            except Exception as e:  # pragma: no cover
+                out[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    except Exception as e:  # pragma: no cover
+        out["farm_bench_error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

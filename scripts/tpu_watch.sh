@@ -1,0 +1,91 @@
+#!/bin/bash
+# TPU tunnel watcher (VERDICT r3 item 1: the watcher must be in-tree and
+# its captures admissible).  Run detached, e.g.:
+#
+#     make tpu-watch          # setsid + nohup, log to /tmp/tpu_watch.log
+#
+# The axon tunnel to the TPU is alive only in occasional windows; this
+# loop probes every WATCH_INTERVAL_S seconds (in a subprocess — a wedged
+# tunnel HANGS jax device init rather than raising) and, the moment the
+# chip answers:
+#
+#   1. runs the driver bench (bench.py) and saves the JSON — wrapped with
+#      git head, dirty flag, and UTC timestamp — to .tpu_bench_result.json
+#      (which bench.py embeds as `tpu_watcher_capture` on CPU fallback
+#      runs, staleness-guarded) AND to captures/tpu_bench_<ts>.json;
+#   2. runs scripts/tpu_ksweep.py (per-tick cost model, detection +
+#      convergence headline, delta 1M/16M, ring qps, Pallas hash), which
+#      writes .tpu_ksweep.json + captures/tpu_ksweep_<ts>.json itself;
+#   3. commits the captures (best-effort, with index-lock retries) so the
+#      evidence is in history even if the session is busy elsewhere.
+#
+# All captures are committed files, not gitignored scratch.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+ATTEMPTS=${WATCH_ATTEMPTS:-230}
+INTERVAL=${WATCH_INTERVAL_S:-180}
+BENCH_TIMEOUT=${WATCH_BENCH_TIMEOUT_S:-2400}
+KSWEEP_TIMEOUT=${WATCH_KSWEEP_TIMEOUT_S:-2400}
+
+ts() { date -u +%FT%TZ; }
+
+for i in $(seq 1 "$ATTEMPTS"); do
+  alive=$(timeout 110 python -c "
+from ringpop_tpu.util.accel import probe_accelerator
+p = probe_accelerator(timeouts_s=(75,))
+print('yes' if p['alive'] and p.get('platform') not in ('cpu', None) else 'no')
+" 2>/dev/null | tail -1)
+  if [ "${alive:-no}" = "yes" ]; then
+    echo "[$(ts)] tunnel alive at attempt $i; running bench.py"
+    BENCH_PROBE_TIMEOUTS_S=75 timeout "$BENCH_TIMEOUT" python bench.py \
+      2>/tmp/tpu_watch_bench_stderr.log | tail -1 >/tmp/tpu_watch_bench_raw.json
+    if [ -s /tmp/tpu_watch_bench_raw.json ] \
+        && grep -q '"platform"' /tmp/tpu_watch_bench_raw.json \
+        && ! grep -q '"platform": "cpu"' /tmp/tpu_watch_bench_raw.json; then
+      python - <<'EOF'
+import json, os, subprocess, time
+repo = os.getcwd()
+r = json.load(open("/tmp/tpu_watch_bench_raw.json"))
+git = lambda *a: subprocess.run(["git", "-C", repo, *a],
+                                capture_output=True, text=True).stdout.strip()
+ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+cap = {"captured_at": ts, "captured_by": "scripts/tpu_watch.sh",
+       "git_head": git("rev-parse", "HEAD"),
+       "git_dirty": bool(git("status", "--porcelain")), "result": r}
+blob = json.dumps(cap, indent=1)
+open(os.path.join(repo, ".tpu_bench_result.json"), "w").write(blob)
+os.makedirs(os.path.join(repo, "captures"), exist_ok=True)
+open(os.path.join(repo, "captures",
+     f"tpu_bench_{ts.replace(':', '').replace('-', '')}.json"), "w").write(blob)
+EOF
+      echo "[$(ts)] bench captured:"; cat /tmp/tpu_watch_bench_raw.json
+      echo "[$(ts)] running ksweep"
+      timeout "$KSWEEP_TIMEOUT" python scripts/tpu_ksweep.py \
+        2>/tmp/tpu_watch_ksweep_stderr.log
+      echo "[$(ts)] ksweep done (rc=$?); committing captures"
+      paths="captures"
+      [ -f .tpu_bench_result.json ] && paths="$paths .tpu_bench_result.json"
+      [ -f .tpu_ksweep.json ] && paths="$paths .tpu_ksweep.json"
+      for try in 1 2 3 4 5; do
+        # shellcheck disable=SC2086  # $paths is a deliberate word list
+        if git add $paths 2>/dev/null \
+            && git commit --only $paths \
+                 -m "Record TPU watcher captures $(ts)" \
+                 -m "No-Verification-Needed: data-only capture artifacts from make tpu-watch" \
+                 2>/dev/null; then
+          echo "[$(ts)] captures committed"
+          break
+        fi
+        echo "[$(ts)] git busy (attempt $try), retrying in 20s"
+        sleep 20
+      done
+      exit 0
+    fi
+    echo "[$(ts)] bench attempt failed or fell back to cpu; stderr tail:"
+    tail -3 /tmp/tpu_watch_bench_stderr.log
+  fi
+  sleep "$INTERVAL"
+done
+echo "[$(ts)] tunnel never revived after $ATTEMPTS attempts"
+exit 1

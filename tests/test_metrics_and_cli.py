@@ -97,3 +97,46 @@ def test_simbench_cli_smoke():
     assert result["platform"] == "cpu"
     assert result["full_scale"] is False
     assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_tpu_ksweep_smoke_cpu(tmp_path):
+    """The watcher's measurement payload (scripts/tpu_ksweep.py) must run
+    end-to-end — it only ever executes unattended in a live tunnel window,
+    so a broken section would otherwise be discovered by wasting the
+    window.  Tiny shapes, CPU-pinned, output redirected (KSWEEP_OUT) so a
+    smoke run can never clobber real captured evidence; asserts the
+    capture schema the round artifacts and PERF.md cite."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    out_path = str(tmp_path / "ksweep_smoke.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "tpu_ksweep.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            KSWEEP_PIN="cpu",
+            KSWEEP_OUT=out_path,
+            KSWEEP_N="2048",
+            KSWEEP_KS="64",
+            KSWEEP_K_HEADLINE="64",
+            KSWEEP_DELTA_N="4096",
+            KSWEEP_REPS="2",
+        ),
+        cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu" and out["git_head"]
+    tc = out["tick_cost"]["64"]
+    assert tc["ms_per_tick_median"] > 0 and len(tc["block_s_reps"]) == 2
+    assert out["detect_headline"]["detected"] is True
+    assert out["detect_headline"]["ms_per_tick_implied"] > 0
+    assert out["converge_after_detect"]["converged"] is True
+    assert out["delta_1m"]["converged"] and out["delta_16m"]["converged"]
+    assert out["ring_lookup_qps"] > 0
+    # the redirected capture file carries the same record
+    cap = json.load(open(out_path))
+    assert cap["captured_at"] == out["captured_at"]
