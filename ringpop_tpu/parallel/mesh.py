@@ -83,16 +83,12 @@ def shard_delta_state(state: DeltaState, mesh: Mesh) -> DeltaState:
 
 def sharded_delta_step(params: DeltaParams, mesh: Mesh):
     """Jitted step with explicit in/out shardings over the mesh."""
-    rumor_shards = mesh.shape.get("rumor", 1)
-    if params.k < 32 * rumor_shards:
-        # the packed learned/ride_ok planes shard WORDS (32 slots each);
-        # fail here with the real rule instead of an opaque GSPMD
-        # divisibility error deep inside jit
-        raise ValueError(
-            f"k={params.k} cannot shard over a {rumor_shards}-way rumor axis: "
-            f"the bit-packed planes need k >= 32 * rumor_shards "
-            f"(= {32 * rumor_shards})"
-        )
+    from ringpop_tpu.sim.packbits import check_rumor_shardable
+
+    # packed planes shard words, unpacked planes shard slots — k must be a
+    # multiple of 32 * rumor_shards (shared rule; raises with the real
+    # constraint instead of an opaque GSPMD divisibility error inside jit)
+    check_rumor_shardable(params.k, mesh.shape.get("rumor", 1))
     sh = delta_shardings(mesh)
     return jax.jit(
         functools.partial(step, params),

@@ -74,6 +74,7 @@ from ringpop_tpu.sim.delta import (
 from ringpop_tpu.sim.packbits import (
     and_reduce_rows,
     bit_column,
+    check_rumor_shardable,
     n_words,
     or_reduce_rows,
     pack_bool,
@@ -674,15 +675,20 @@ def step(
     )
 
 
-def state_shardings(mesh) -> LifecycleState:
+def state_shardings(mesh, k: Optional[int] = None) -> LifecycleState:
     """The canonical LifecycleState sharding over a ("node", "rumor")
     mesh: per-node vectors on the node axis, the rumor table on the rumor
     axis, the big planes on both — ``learned``'s rumor axis is WORDS
-    (uint32 packs 32 slots), so K must supply >= 32 slots per rumor shard.
-    One definition shared by the driver entry (``__graft_entry__``), the
-    sharded-at-scale bench (``cli/simbench bench_sharded100k``), and the
-    sharding tests — a layout change edits exactly this function."""
+    (uint32 packs 32 slots), so k must be a multiple of 32 * rumor-shards
+    (pass ``k`` to validate against the mesh up front; see
+    ``packbits.check_rumor_shardable``).  One definition shared by the
+    driver entry (``__graft_entry__``), the sharded-at-scale bench
+    (``cli/simbench bench_sharded100k``), and the sharding tests — a
+    layout change edits exactly this function."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if k is not None:
+        check_rumor_shardable(k, mesh.shape.get("rumor", 1))
 
     def sh(spec):
         return NamedSharding(mesh, spec)
@@ -1115,84 +1121,35 @@ class LifecycleSim:
         self.state = self._block(self.state, faults, ticks=ticks)
         return self.state
 
-    def run_until_converged(
+    def _run_until(
         self,
-        faults: DeltaFaults = DeltaFaults(),
-        max_ticks: int = 5000,
-        check_every: int = 8,
-        blocks_per_dispatch: int = 4,
+        dispatch,
+        max_ticks: int,
+        check_every: int,
+        blocks_per_dispatch: int,
+        time_budget_s: Optional[float],
     ):
-        """Tick until every live node's view checksum agrees — the
-        reference's convergence criterion for protocol tests
-        (``swim/test_utils.go:164-199``), run on-device with early exit.
-        Returns (ticks_used, converged); 0 ticks if already quiescent (the
-        check itself runs even with a zero/exhausted budget, without
-        stepping)."""
-        ticks = 0
-        while True:
-            max_blocks = min(
-                blocks_per_dispatch, max(0, (max_ticks - ticks) // check_every)
-            )
-            self.state, blocks, done = _run_until_converged_device(
-                self.params,
-                self.state,
-                faults,
-                block_ticks=check_every,
-                max_blocks=jnp.int32(max_blocks),
-            )
-            ticks += int(blocks) * check_every
-            if bool(done):
-                return ticks, True
-            if max_blocks == 0 or ticks + check_every > max_ticks:
-                return ticks, False
-
-    def run_until_detected(
-        self,
-        subjects: Sequence[int],
-        faults: DeltaFaults = DeltaFaults(),
-        min_status: int = FAULTY,
-        max_ticks: int = 5000,
-        check_every: int = 8,
-        time_budget_s: Optional[float] = None,
-        blocks_per_dispatch: int = 4,
-    ):
-        """Tick until every live observer believes every subject has reached
-        ``min_status``.  Returns (ticks_used, detected).
-
-        The loop AND its convergence test run on-device
-        (``_run_until_detected_device``): each dispatch covers up to
-        ``blocks_per_dispatch`` blocks of ``check_every`` ticks with the
-        early-exit test between blocks, so the host reads back one (blocks,
-        done) pair per dispatch instead of walking rumor slots over the
-        interconnect.  ``time_budget_s`` bounds wall-clock between
-        dispatches (benchmarks on an unexpectedly slow backend stop at the
-        budget and report partial progress instead of running away); with a
+        """Shared host loop for the budgeted device run-until machinery:
+        ``dispatch(max_blocks)`` runs one jitted dispatch (up to that many
+        ``check_every``-tick blocks with the early-exit predicate between
+        blocks, updating ``self.state``) and returns its (blocks, done)
+        arrays; the host reads back ONE pair per dispatch.  With a time
         budget set, the first dispatch runs a single block to measure block
         cost, then dispatch sizes adapt to the remaining budget (up to
         ``blocks_per_dispatch``) so one dispatch can never blow far past
-        the deadline.
-        """
+        the deadline; an overrun stops with partial progress.  A
+        zero/exhausted tick budget still dispatches once with 0 blocks: the
+        entry check runs without stepping, so an already-done state reports
+        (0, True) instead of a false negative."""
         import time as _time
 
         deadline = None if time_budget_s is None else _time.perf_counter() + time_budget_s
         bpd = 1 if deadline is not None else blocks_per_dispatch
-        subjects = jnp.asarray(list(subjects), jnp.int32)
         ticks = 0
         while True:
-            # a zero/exhausted budget still dispatches once with 0 blocks:
-            # the entry check runs without stepping, so an already-detected
-            # state reports (0, True) instead of a false negative
             max_blocks = min(bpd, max(0, (max_ticks - ticks) // check_every))
             t0 = _time.perf_counter()
-            self.state, blocks, done = _run_until_detected_device(
-                self.params,
-                self.state,
-                faults,
-                subjects,
-                min_status=min_status,
-                block_ticks=check_every,
-                max_blocks=jnp.int32(max_blocks),
-            )
+            blocks, done = dispatch(max_blocks)
             n_blocks = int(blocks)  # blocking readback — completes the dispatch
             now = _time.perf_counter()
             ticks += n_blocks * check_every
@@ -1208,3 +1165,65 @@ class LifecycleSim:
                     1,
                     min(blocks_per_dispatch, int((deadline - now) / max(per_block, 1e-9))),
                 )
+
+    def run_until_converged(
+        self,
+        faults: DeltaFaults = DeltaFaults(),
+        max_ticks: int = 5000,
+        check_every: int = 8,
+        blocks_per_dispatch: int = 4,
+        time_budget_s: Optional[float] = None,
+    ):
+        """Tick until every live node's view checksum agrees — the
+        reference's convergence criterion for protocol tests
+        (``swim/test_utils.go:164-199``), run on-device with early exit
+        (``_run_until_converged_device``).  Returns (ticks_used,
+        converged).  Loop/budget semantics: :meth:`_run_until`."""
+
+        def dispatch(max_blocks):
+            self.state, blocks, done = _run_until_converged_device(
+                self.params,
+                self.state,
+                faults,
+                block_ticks=check_every,
+                max_blocks=jnp.int32(max_blocks),
+            )
+            return blocks, done
+
+        return self._run_until(
+            dispatch, max_ticks, check_every, blocks_per_dispatch, time_budget_s
+        )
+
+    def run_until_detected(
+        self,
+        subjects: Sequence[int],
+        faults: DeltaFaults = DeltaFaults(),
+        min_status: int = FAULTY,
+        max_ticks: int = 5000,
+        check_every: int = 8,
+        time_budget_s: Optional[float] = None,
+        blocks_per_dispatch: int = 4,
+    ):
+        """Tick until every live observer believes every subject has reached
+        ``min_status``.  Returns (ticks_used, detected).  The loop AND its
+        detection test run on-device (``_run_until_detected_device``) so
+        the host reads back one (blocks, done) pair per dispatch instead
+        of walking rumor slots over the interconnect.  Loop/budget
+        semantics: :meth:`_run_until`."""
+        subjects = jnp.asarray(list(subjects), jnp.int32)
+
+        def dispatch(max_blocks):
+            self.state, blocks, done = _run_until_detected_device(
+                self.params,
+                self.state,
+                faults,
+                subjects,
+                min_status=min_status,
+                block_ticks=check_every,
+                max_blocks=jnp.int32(max_blocks),
+            )
+            return blocks, done
+
+        return self._run_until(
+            dispatch, max_ticks, check_every, blocks_per_dispatch, time_budget_s
+        )

@@ -133,3 +133,25 @@ def set_bit(p: jax.Array, rows: jax.Array, slots: jax.Array, on: jax.Array) -> j
     vals = jnp.where(on, jnp.uint32(1) << (slots & 31).astype(jnp.uint32), jnp.uint32(0))
     upd = jnp.zeros((n, w), jnp.uint32).at[rows, slots >> 5].add(vals, mode="drop")
     return p | upd
+
+
+def check_rumor_shardable(k: int, rumor_shards: int) -> None:
+    """Validate that ``k`` rumor slots can shard over a ``rumor_shards``-way
+    mesh axis, raising with the real rule instead of the opaque GSPMD
+    divisibility error deep inside jit.
+
+    The packed planes (``learned``/``ride_ok``) shard WORDS while the
+    unpacked planes (``pcount``) shard SLOTS, so a clean placement needs
+    every shard to hold whole words AND the word boundaries to coincide
+    with the slot boundaries — i.e. ``k`` must be a multiple of
+    ``32 * rumor_shards``.  (k=96 over a 2-way axis passes a bare
+    ``k >= 32*rumor_shards`` check but still fails placement: 3 words do
+    not divide by 2.)"""
+    if rumor_shards > 1 and k % (WORD * rumor_shards):
+        raise ValueError(
+            f"k={k} cannot shard over a {rumor_shards}-way rumor axis: the "
+            f"bit-packed planes shard 32-slot words, so k must be a "
+            f"multiple of 32 * rumor_shards (= {WORD * rumor_shards}); "
+            f"n_words(k)={n_words(k)} words / slot-alignment would not "
+            f"divide evenly"
+        )

@@ -29,13 +29,26 @@ T = TypeVar("T", bound=tuple)
 _MAGIC = "ringpop_tpu-snapshot-v1"
 
 
-def save_state(path: str, state) -> None:
+def save_state(path: str, state, params=None) -> None:
     """Write any engine state (a NamedTuple of arrays) to ``path`` (.npz).
-    Works for DeltaState, FullViewState and LifecycleState alike."""
+    Works for DeltaState, FullViewState and LifecycleState alike.
+
+    Pass the run's ``params`` when the engine has a dissemination bound
+    (delta/lifecycle): the resolved ``max_p`` is persisted in the snapshot
+    meta, so a later :func:`load_state` migration can rebuild derived
+    planes without guessing the bound (a custom ``max_p`` run restored
+    with the default bound would get a silently wrong ride gate)."""
     arrays = {f: np.asarray(v) for f, v in zip(state._fields, state)}
-    meta = json.dumps(
-        {"magic": _MAGIC, "type": type(state).__name__, "fields": list(state._fields)}
-    )
+    meta_dict = {
+        "magic": _MAGIC,
+        "type": type(state).__name__,
+        "fields": list(state._fields),
+    }
+    if params is not None and hasattr(params, "p_factor"):
+        from ringpop_tpu.sim.delta import clamped_max_p
+
+        meta_dict["max_p"] = int(clamped_max_p(params))
+    meta = json.dumps(meta_dict)
     np.savez_compressed(path, __meta__=np.frombuffer(meta.encode(), dtype=np.uint8), **arrays)
 
 
@@ -68,19 +81,50 @@ def load_state(path: str, cls: Type[T], params=None) -> T:
             raise ValueError(f"{path}: field mismatch {saved} != {want}")
         out = {f: jnp.asarray(data[f]) for f in saved}
         if migrate_ride:
+            import warnings
+
             from ringpop_tpu.sim.delta import (
                 INT8_SAFE_MAX_P,
                 clamped_max_p,
                 resolve_max_p,
             )
-            from ringpop_tpu.sim.packbits import pack_bool
+            from ringpop_tpu.sim.packbits import n_words, pack_bool
 
+            # pre-packing snapshots stored the boolean planes unpacked
+            # (bool[N, K]); the packed engines expect uint32[N, ceil(K/32)].
+            # Pack them here — loading them raw would shape-error for k>32
+            # and, worse, silently broadcast-corrupt the k<=32 case.
+            for f in ("learned",):
+                if f in out and out[f].dtype == bool:
+                    out[f] = pack_bool(out[f])
             if params is not None:
                 max_p = clamped_max_p(params)
+            elif "max_p" in meta:
+                max_p = int(meta["max_p"])
             else:
                 n = out["pcount"].shape[0]
                 max_p = min(resolve_max_p(n, 15, None), INT8_SAFE_MAX_P)
+                warnings.warn(
+                    f"{path}: migrating a pre-ride_ok snapshot without params; "
+                    f"assuming the default dissemination bound max_p={max_p} "
+                    f"for n={n} — pass the run's params if it used a custom "
+                    "p_factor/max_p, or the rebuilt ride gate will be wrong",
+                    stacklevel=2,
+                )
             out["ride_ok"] = pack_bool(out["pcount"] < np.int8(max_p))
+            # post-migration structural check: every packed plane must now be
+            # word-typed with ceil(K/32) words for pcount's K (the class
+            # annotations carry no dtypes, so validate the invariant directly)
+            n, k = out["pcount"].shape
+            for f in ("learned", "ride_ok"):
+                if f in out and (
+                    out[f].dtype != np.uint32 or out[f].shape != (n, n_words(k))
+                ):
+                    raise ValueError(
+                        f"{path}: migrated field {f!r} is "
+                        f"{out[f].shape}/{out[f].dtype}, expected "
+                        f"({n}, {n_words(k)})/uint32"
+                    )
         return cls(**out)
 
 

@@ -82,3 +82,63 @@ def ensure_live_backend(timeouts_s: Sequence[float] = (90.0, 240.0)) -> dict:
             pass  # backend already up — caller initialized earlier
         info["fallback"] = info["reason"]
     return info
+
+
+def compile_cache_dir(base: str, create: bool = True) -> str:
+    """Return a per-platform-fingerprint subdirectory of ``base`` for the
+    persistent XLA compilation cache.
+
+    The cache must never be shared across heterogeneous containers: XLA:CPU
+    kernels are compiled for the build host's CPU features, and loading one
+    on a host missing those features "could lead to execution errors such
+    as SIGILL" (LLVM's own warning, observed in the round-3 bench artifact
+    when a shared ``.jax_cache`` crossed containers).  Keying the directory
+    by platform + device kind + jax version + the host CPU flag set makes a
+    mismatched entry unreachable instead of trusted.
+
+    Requires jax to be importable; initializes the backend (callers set
+    platform pins first, same as they must before any jax use)."""
+    import hashlib
+
+    import jax
+
+    bits = ["cache-v1", jax.__version__]
+    try:
+        dev = jax.devices()[0]
+        bits += [dev.platform, str(getattr(dev, "device_kind", ""))]
+    except Exception:  # pragma: no cover - backendless environments
+        bits.append("no-backend")
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 "flags"; arm64 "Features" — one representative line
+                if line.startswith(("flags", "Features")):
+                    bits.append(line.strip())
+                    break
+    except OSError:  # pragma: no cover - non-Linux
+        pass
+    fp = hashlib.sha1("|".join(bits).encode()).hexdigest()[:12]
+    path = os.path.join(os.path.abspath(base), fp)
+    if create:
+        os.makedirs(path, exist_ok=True)
+    return path
+
+
+def configure_compile_cache(base: str) -> Optional[str]:
+    """Point jax's persistent compilation cache at the fingerprinted subdir
+    of ``base`` (see :func:`compile_cache_dir`), with the cache thresholds
+    every entry point here wants (cache anything that took >= 1 s to
+    compile, regardless of size).  One helper so bench.py, the test
+    conftest, the watcher's ksweep and the simbench children cannot drift.
+    Returns the directory used, or None when this jax version has no cache
+    flags (the caller runs uncached)."""
+    import jax
+
+    try:
+        path = compile_cache_dir(base)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception:
+        return None

@@ -428,7 +428,7 @@ def test_jit_shapes_stable_and_sharded():
     # must supply >= 32 slots per rumor shard)
     params = LifecycleParams(n=64, k=64, suspect_ticks=6)
     state = init_state(params, seed=7)
-    state = jax.tree.map(jax.device_put, state, state_shardings(mesh))
+    state = jax.tree.map(jax.device_put, state, state_shardings(mesh, k=params.k))
     faults = make_faults(64, down=[9])
     stepper = jax.jit(lambda s: step(params, s, faults))
     for _ in range(30):

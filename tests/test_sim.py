@@ -220,6 +220,29 @@ class TestMeshSharding:
         mesh = make_mesh(8)
         assert mesh.shape["node"] * mesh.shape["rumor"] == 8
 
+    def test_rumor_shard_rule(self):
+        """The shared guard rejects every k the packed planes cannot place:
+        not just k < 32*shards but any k whose WORD count (or slot
+        alignment) does not divide the rumor axis — k=96 over 2 shards is
+        the advisor's counterexample (3 words, 2 shards)."""
+        import pytest
+
+        from ringpop_tpu.parallel.mesh import make_mesh, sharded_delta_step
+        from ringpop_tpu.sim.lifecycle import state_shardings
+        from ringpop_tpu.sim.packbits import check_rumor_shardable
+
+        check_rumor_shardable(64, 2)  # fine: one word per shard
+        check_rumor_shardable(96, 1)  # fine: unsharded rumor axis
+        for k, shards in ((96, 2), (48, 2), (33, 2), (64, 4)):
+            with pytest.raises(ValueError, match="multiple of 32"):
+                check_rumor_shardable(k, shards)
+
+        mesh = make_mesh(8)  # (4, 2) by default
+        with pytest.raises(ValueError, match="multiple of 32"):
+            sharded_delta_step(DeltaParams(n=64, k=96), mesh)
+        with pytest.raises(ValueError, match="multiple of 32"):
+            state_shardings(mesh, k=96)
+
 
 class TestRingOps:
     def test_device_lookup_matches_host_ring(self):

@@ -139,32 +139,40 @@ def test_host_membership_export_import(tmp_path):
     asyncio.run(run())
 
 
-@pytest.mark.parametrize("engine", ["delta", "lifecycle"])
-def test_pre_ride_ok_snapshot_migrates(tmp_path, engine):
-    """Snapshots written before the packed engines carry no ride_ok plane;
-    load_state must reconstruct it from pcount (the carried-gate invariant)
-    instead of refusing — old long-running-sim checkpoints stay loadable."""
+@pytest.mark.parametrize(
+    "engine,k", [("delta", 8), ("lifecycle", 8), ("lifecycle", 40)]
+)
+def test_pre_ride_ok_snapshot_migrates(tmp_path, engine, k):
+    """Snapshots written before the packed engines stored ``learned`` as an
+    UNPACKED bool[N, K] plane and carried no ride_ok; load_state must pack
+    the plane and reconstruct the gate instead of refusing — old
+    long-running-sim checkpoints stay loadable.  k=8 (one word) covers the
+    silent-broadcast hazard, k=40 (two words) the shape-error one."""
     import json
 
-    if engine == "delta":
-        params = delta.DeltaParams(n=48, k=8)
-        state = delta.init_state(params, seed=5)
-        cls = delta.DeltaState
-        for _ in range(6):
-            state = delta.step(params, state)
-    else:
-        params = lifecycle.LifecycleParams(n=48, k=8, suspect_ticks=4)
-        faults = delta.DeltaFaults(up=jnp.ones(48, bool).at[3].set(False))
-        state = lifecycle.init_state(params, seed=5)
-        cls = lifecycle.LifecycleState
-        for _ in range(6):
-            state = lifecycle.step(params, state, faults)
+    from ringpop_tpu.sim.packbits import unpack_bits
 
-    # forge the old schema: same arrays minus ride_ok, meta without it
+    if engine == "delta":
+        params = delta.DeltaParams(n=48, k=k)
+        state = delta.init_state(params, seed=5)
+        cls, eng_step = delta.DeltaState, delta.step
+        faults = ()
+    else:
+        params = lifecycle.LifecycleParams(n=48, k=k, suspect_ticks=4)
+        faults = (delta.DeltaFaults(up=jnp.ones(48, bool).at[3].set(False)),)
+        state = lifecycle.init_state(params, seed=5)
+        cls, eng_step = lifecycle.LifecycleState, lifecycle.step
+    for _ in range(6):
+        state = eng_step(params, state, *faults)
+
+    # forge the TRUE old on-disk schema: learned as bool[N, K] (unpacked),
+    # no ride_ok field, meta without it
     path = str(tmp_path / "old.npz")
     save_state(path, state)
     with np.load(path) as data:
         arrays = {f: data[f] for f in data.files if f not in ("__meta__", "ride_ok")}
+    arrays["learned"] = np.asarray(unpack_bits(state.learned, params.k))
+    assert arrays["learned"].dtype == bool and arrays["learned"].shape == (48, k)
     meta = json.dumps(
         {
             "magic": "ringpop_tpu-snapshot-v1",
@@ -177,8 +185,53 @@ def test_pre_ride_ok_snapshot_migrates(tmp_path, engine):
     )
 
     restored = load_state(path, cls, params=params)
-    assert _trees_equal(restored, state)  # ride_ok reconstructed exactly
-    # and without params, the default SWIM bound for this n matches too
-    # (these configs use the default p_factor / max_p)
-    restored_default = load_state(path, cls)
+    assert _trees_equal(restored, state)  # learned re-packed, ride_ok rebuilt
+    # and the loaded state must STEP identically to the packed original
+    cont, rcont = state, restored
+    for _ in range(4):
+        cont = eng_step(params, cont, *faults)
+        rcont = eng_step(params, rcont, *faults)
+    assert _trees_equal(rcont, cont)
+    # without params the default SWIM bound is assumed — loudly (these
+    # configs use the default p_factor/max_p, so the result still matches)
+    with pytest.warns(UserWarning, match="assuming the default dissemination"):
+        restored_default = load_state(path, cls)
     assert _trees_equal(restored_default, state)
+
+
+def test_snapshot_meta_max_p_rides_migration(tmp_path):
+    """A snapshot saved with params persists the resolved max_p in its
+    meta; a migration that must rebuild ride_ok without a params argument
+    uses it (no warning, correct gate) even for a custom bound."""
+    import json
+
+    from ringpop_tpu.sim.packbits import pack_bool, unpack_bits
+
+    params = delta.DeltaParams(n=48, k=8, max_p=3)  # custom, non-default bound
+    state = delta.init_state(params, seed=5)
+    for _ in range(6):
+        state = delta.step(params, state)
+
+    path = str(tmp_path / "old.npz")
+    save_state(path, state, params=params)
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        arrays = {f: data[f] for f in data.files if f not in ("__meta__", "ride_ok")}
+    assert meta["max_p"] == 3
+    arrays["learned"] = np.asarray(unpack_bits(state.learned, params.k))
+    meta["fields"] = [f for f in delta.DeltaState._fields if f != "ride_ok"]
+    np.savez_compressed(
+        path,
+        __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **arrays,
+    )
+
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # meta max_p must suppress the warning
+        restored = load_state(path, delta.DeltaState)
+    assert _trees_equal(restored, state)
+    assert np.array_equal(
+        np.asarray(restored.ride_ok), np.asarray(pack_bool(state.pcount < np.int8(3)))
+    )
