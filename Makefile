@@ -44,7 +44,7 @@ tpu-watch:
 	setsid nohup scripts/tpu_watch.sh >> /tmp/tpu_watch.log 2>&1 < /dev/null &
 	@echo "watcher detached; log: /tmp/tpu_watch.log"
 
-# all five BASELINE scenario configs
+# all BASELINE scenario configs + paired A/Bs (forward_ab, mc_churn, ...)
 simbench:
 	$(PY) -m ringpop_tpu.cli.simbench
 

@@ -248,12 +248,13 @@ def run_bench() -> None:
     # while_loop (blocks + detection check in ONE dispatch; round-1 traces
     # showed the host-side detection walk was ~90% of wall-clock at 1M) —
     # then restart from a fresh state
+    # max_ticks=0 dispatches each device loop once with 0 blocks: the full
+    # program (blocks + predicate + early exit) compiles and the predicate
+    # executes, without paying a 32-tick block (~80 s of warmup at 1M on
+    # the CPU fallback) just to warm it
     life.run_until_detected(
-        victims, faults, max_ticks=check_every, check_every=check_every
+        victims, faults, max_ticks=0, check_every=check_every
     )
-    # also pre-compile the convergence-loop program the post-detection phase
-    # runs (max_ticks=0 dispatches the device loop with 0 blocks: the
-    # quiescence+checksum check executes once, no stepping)
     life.run_until_converged(faults, max_ticks=0, check_every=check_every)
     jax.block_until_ready(life.state.learned)
     life_warmup_s = time.perf_counter() - t_c0
@@ -371,7 +372,7 @@ def run_bench() -> None:
         "n_nodes": n_life,
         "n_rumor_slots": k_life,
         "n_victims": n_victims,
-        "warmup_s": round(life_warmup_s, 2),  # detect+converge compiles + 32 ticks
+        "warmup_s": round(life_warmup_s, 2),  # detect+converge compiles + entry checks
         "lifecycle_scale_reason": life_scale_reason,
         # literal north-star convergence, continued from the detected state:
         # wall seconds and extra ticks until quiescence + checksum agreement

@@ -315,6 +315,7 @@ equal = all(bool((np.asarray(a) == np.asarray(b)).all())
 # same verdict, and land bit-equal state vs the unsharded run
 subjects = jnp.asarray(victims, jnp.int32)
 detect_kw = dict(min_status=lifecycle.FAULTY, block_ticks=32, max_blocks=jnp.int32(16))
+detect_block_ticks = detect_kw["block_ticks"]
 t0 = time.perf_counter()
 dref, ref_blocks, ref_done = lifecycle._run_until_detected_device(
     params, lifecycle.init_state(params, seed=seed), faults, subjects, **detect_kw)
@@ -331,7 +332,7 @@ detect_sharded_s = time.perf_counter() - t0
 
 detect_equal = all(bool((np.asarray(a) == np.asarray(b)).all())
                    for a, b in zip(jax.tree.leaves(dref), jax.tree.leaves(dsh)))
-detect = dict(detected=bool(ref_done), ticks=int(ref_blocks) * 32,
+detect = dict(detected=bool(ref_done), ticks=int(ref_blocks) * detect_block_ticks,
               blocks_equal=int(ref_blocks) == int(sh_blocks),
               verdict_equal=bool(ref_done) == bool(sh_done),
               state_equal=detect_equal,
@@ -549,9 +550,7 @@ class _FwdCluster:
             ch.register("fwd", "/op", lambda body, headers: {"ok": True})
         self.rps = [Ringpop("fwd", ch) for ch in self.chans]
         hosts = [ch.hostport for ch in self.chans]
-        import asyncio as _a
-
-        await _a.gather(*(rp.bootstrap(discover_provider=hosts) for rp in self.rps))
+        await asyncio.gather(*(rp.bootstrap(discover_provider=hosts) for rp in self.rps))
         return self
 
     async def one(self, i: int) -> bool:
@@ -820,7 +819,7 @@ def bench_forward_ab(seed: int, full: bool) -> dict:
         proxy = await _MinimalProxy().start(comp_wave)
 
         # interleaved reps: full, comparator, full, comparator, ...
-        reps, warm_reps = (5, 3) if full else (3, 1)
+        reps, warm_reps = (5, 4) if full else (3, 1)
         full_qps, comp_qps = [], []
         for rep in range(warm_reps + reps):
             f, _ = await cluster.rep(rep, waves, wave)

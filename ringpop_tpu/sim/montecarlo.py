@@ -119,7 +119,6 @@ def _mc_run_until_device(
 
     # entry check keeps tick-for-tick equivalence with LifecycleSim's
     # runner, which reports 0 ticks on an already-detected state
-    b = jax.tree.leaves(states)[0].shape[0]
     first0 = jnp.where(vdone(states), jnp.int32(0), jnp.int32(-1))
     return jax.lax.while_loop(cond, body, (states, jnp.int32(0), first0))
 
@@ -280,7 +279,8 @@ def detection_latency_under_churn(
     kw = {} if suspect_ticks is None else {"suspect_ticks": suspect_ticks}
     params = LifecycleParams(n=n, k=k, **kw)
     tick_s = params.tick_ms / 1000.0
-    b_count = len(list(seeds))
+    seeds = list(seeds)  # consumed twice below — a generator must not exhaust
+    b_count = len(seeds)
     victims = sorted(int(v) for v in victims)
 
     rng = np.random.default_rng(churn_seed)
