@@ -63,7 +63,10 @@ EOF
       echo "[$(ts)] running ksweep"
       timeout "$KSWEEP_TIMEOUT" python scripts/tpu_ksweep.py \
         2>/tmp/tpu_watch_ksweep_stderr.log
-      echo "[$(ts)] ksweep done (rc=$?); committing captures"
+      echo "[$(ts)] ksweep done (rc=$?); running hardware test suite"
+      timeout 1200 python -m pytest tests_accel/ -q \
+        >/tmp/tpu_watch_accel_tests.log 2>&1
+      echo "[$(ts)] test-accel rc=$? ($(tail -1 /tmp/tpu_watch_accel_tests.log)); committing captures"
       paths="captures"
       [ -f .tpu_bench_result.json ] && paths="$paths .tpu_bench_result.json"
       [ -f .tpu_ksweep.json ] && paths="$paths .tpu_ksweep.json"
