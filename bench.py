@@ -85,14 +85,22 @@ def main() -> None:
 
     from ringpop_tpu.util.accel import probe_accelerator
 
-    # one quick + one patient attempt (a cold tunnel can be slow-but-alive).
-    # Continuous probing is the round watcher's job (see _watcher_capture);
-    # burning 330s here, as the round-2 artifact did, buys nothing.
-    probe_timeouts = tuple(
-        float(t)
-        for t in os.environ.get("BENCH_PROBE_TIMEOUTS_S", "75,150").split(",")
-    )
-    probe = probe_accelerator(timeouts_s=probe_timeouts)
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # deterministic CPU-only run (tests, smoke): skip the probe and the
+        # accelerator attempt entirely instead of relying on a short probe
+        # timeout losing the race against a live tunnel
+        probe = {"alive": False, "platform": None, "probe_s": 0.0,
+                 "reason": "BENCH_FORCE_CPU=1"}
+    else:
+        # one quick + one patient attempt (a cold tunnel can be slow-but-
+        # alive).  Continuous probing is the round watcher's job (see
+        # _watcher_capture); burning 330s here, as the round-2 artifact
+        # did, buys nothing.
+        probe_timeouts = tuple(
+            float(t)
+            for t in os.environ.get("BENCH_PROBE_TIMEOUTS_S", "75,150").split(",")
+        )
+        probe = probe_accelerator(timeouts_s=probe_timeouts)
     fallback_reason = None if probe["alive"] else probe["reason"]
 
     attempt_plan = []

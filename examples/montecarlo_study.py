@@ -22,6 +22,7 @@ if not os.environ.get("KEEP_PLATFORM"):
     jax.config.update("jax_platforms", "cpu")
 
 from ringpop_tpu.sim import detection_latency_distribution
+from ringpop_tpu.sim.montecarlo import detection_latency_under_churn
 
 
 def main():
@@ -44,6 +45,30 @@ def main():
         f"({out['sim_s_median']:.1f}s of simulated time at 200ms periods), "
         f"p90 {out['ticks_p90']:.0f}, max {out['ticks_max']:.0f}"
     )
+
+    # follow-up question: how does that latency degrade while the cluster
+    # is ALSO digesting unrelated churn?  Replica b crashes ~b/B of
+    # churn_max extra background nodes (a [B, N] fault-mask batch — the
+    # fault pytree vmaps alongside the state), detection still judged on
+    # the same two victims.  The dose-response curve is the answer.
+    churn_max = n // 16
+    print(f"\nsame study under background churn (0..{churn_max} extra crashes):")
+    out = detection_latency_under_churn(
+        n=n,
+        seeds=range(replicas),
+        victims=victims,
+        churn_max=churn_max,
+        k=32,
+        max_ticks=2048,
+    )
+    print(f"replicas detected: {out['detected']}/{out['n_replicas']}")
+    detected_ticks = [t for _, t in out["churn_ticks"] if t is not None]
+    scale = max(detected_ticks) if detected_ticks else 1
+    for churn, ticks in out["churn_ticks"]:
+        # normalize to the slowest replica so the chart fits a terminal
+        bar = "" if ticks is None else "#" * max(1, round(ticks / scale * 50))
+        label = "never" if ticks is None else f"{ticks:4d} ticks"
+        print(f"  churn {churn:4d}: {label} {bar}")
 
 
 if __name__ == "__main__":

@@ -23,6 +23,18 @@
 set -u
 cd "$(dirname "$0")/.." || exit 1
 
+# single-instance guard: two watchers would double-run the bench in a
+# live window and race the capture commits (the lock dies with the
+# holder, so a crashed watcher never wedges a later launch)
+# children are spawned with 9>&- so an orphaned grandchild (e.g. a
+# bench subprocess outliving its timeout'd parent) cannot keep the lock
+# held after the watcher itself dies
+exec 9>/tmp/tpu_watch.lock
+if ! flock -n 9; then
+  echo "another watcher holds /tmp/tpu_watch.lock; exiting"
+  exit 0
+fi
+
 ATTEMPTS=${WATCH_ATTEMPTS:-230}
 INTERVAL=${WATCH_INTERVAL_S:-180}
 BENCH_TIMEOUT=${WATCH_BENCH_TIMEOUT_S:-2400}
@@ -31,14 +43,14 @@ KSWEEP_TIMEOUT=${WATCH_KSWEEP_TIMEOUT_S:-2400}
 ts() { date -u +%FT%TZ; }
 
 for i in $(seq 1 "$ATTEMPTS"); do
-  alive=$(timeout 110 python -c "
+  alive=$(timeout 110 python 9>&- -c "
 from ringpop_tpu.util.accel import probe_accelerator
 p = probe_accelerator(timeouts_s=(75,))
 print('yes' if p['alive'] and p.get('platform') not in ('cpu', None) else 'no')
 " 2>/dev/null | tail -1)
   if [ "${alive:-no}" = "yes" ]; then
     echo "[$(ts)] tunnel alive at attempt $i; running bench.py"
-    BENCH_PROBE_TIMEOUTS_S=75 timeout "$BENCH_TIMEOUT" python bench.py \
+    BENCH_PROBE_TIMEOUTS_S=75 timeout "$BENCH_TIMEOUT" python bench.py 9>&- \
       2>/tmp/tpu_watch_bench_stderr.log | tail -1 >/tmp/tpu_watch_bench_raw.json
     if [ -s /tmp/tpu_watch_bench_raw.json ] \
         && grep -q '"platform"' /tmp/tpu_watch_bench_raw.json \
@@ -61,10 +73,10 @@ open(os.path.join(repo, "captures",
 EOF
       echo "[$(ts)] bench captured:"; cat /tmp/tpu_watch_bench_raw.json
       echo "[$(ts)] running ksweep"
-      timeout "$KSWEEP_TIMEOUT" python scripts/tpu_ksweep.py \
+      timeout "$KSWEEP_TIMEOUT" python scripts/tpu_ksweep.py 9>&- \
         2>/tmp/tpu_watch_ksweep_stderr.log
       echo "[$(ts)] ksweep done (rc=$?); running hardware test suite"
-      timeout 1200 python -m pytest tests_accel/ -q \
+      timeout 1200 python -m pytest tests_accel/ -q 9>&- \
         >/tmp/tpu_watch_accel_tests.log 2>&1
       echo "[$(ts)] test-accel rc=$? ($(tail -1 /tmp/tpu_watch_accel_tests.log)); committing captures"
       paths="captures"

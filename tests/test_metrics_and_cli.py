@@ -140,3 +140,45 @@ def test_tpu_ksweep_smoke_cpu(tmp_path):
     # the redirected capture file carries the same record
     cap = json.load(open(out_path))
     assert cap["captured_at"] == out["captured_at"]
+
+
+@pytest.mark.slow
+def test_bench_fast_artifact_schema():
+    """bench.py is the driver's interface: one JSON line whose schema the
+    round artifacts (BENCH_r{N}.json) and BASELINE comparisons consume.
+    Run it in BENCH_FAST smoke mode, forced to the CPU-only path
+    (BENCH_FORCE_CPU skips the probe AND the accelerator attempt — a
+    short probe timeout would merely race a live tunnel), and pin the
+    fields — detection AND the literal convergence companions
+    (VERDICT r3 item 3) must always ride the line."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=dict(
+            os.environ,
+            BENCH_FAST="1",
+            BENCH_FORCE_CPU="1",
+        ),
+        cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    line = next(
+        ln for ln in reversed(r.stdout.strip().splitlines()) if ln.startswith("{")
+    )
+    out = json.loads(line)
+    assert out["metric"].startswith("swim_lifecycle_detect_n")
+    assert out["detected"] is True and out["ticks"] > 0
+    # the literal north-star convergence companions
+    assert out["converged"] is True
+    assert out["converge_total_ticks"] == out["ticks"] + out["converge_extra_ticks"]
+    assert out["converge_total_s"] >= out["value"]
+    # scale honesty: smoke scale must not claim a 1M-baseline ratio
+    assert out["vs_baseline"] is None
+    assert out["vs_baseline_at_reduced_scale"] > 0
+    assert out["delta_converged"] is True
+    assert out["ring_lookup_qps"] > 0
+    assert out["platform"] == "cpu"
+    assert "probe" in out and "tpu_watcher_capture" in out
