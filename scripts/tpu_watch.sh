@@ -55,7 +55,7 @@ print('yes' if p['alive'] and p.get('platform') not in ('cpu', None) else 'no')
     if [ -s /tmp/tpu_watch_bench_raw.json ] \
         && grep -q '"platform"' /tmp/tpu_watch_bench_raw.json \
         && ! grep -q '"platform": "cpu"' /tmp/tpu_watch_bench_raw.json; then
-      python - <<'EOF'
+      python 9>&- - <<'EOF'
 import json, os, subprocess, time
 repo = os.getcwd()
 r = json.load(open("/tmp/tpu_watch_bench_raw.json"))
@@ -84,8 +84,8 @@ EOF
       [ -f .tpu_ksweep.json ] && paths="$paths .tpu_ksweep.json"
       for try in 1 2 3 4 5; do
         # shellcheck disable=SC2086  # $paths is a deliberate word list
-        if git add $paths 2>/dev/null \
-            && git commit --only $paths \
+        if git add $paths 2>/dev/null 9>&- \
+            && git commit --only $paths 9>&- \
                  -m "Record TPU watcher captures $(ts)" \
                  -m "No-Verification-Needed: data-only capture artifacts from make tpu-watch" \
                  2>/dev/null; then

@@ -1,0 +1,121 @@
+"""Randomized property sweeps for the bit-packed plane primitives.
+
+Every sim-engine boolean plane rides `sim/packbits.py` (learned, ride_ok
+and every mask derived from them), and the round-3 bit-identity claim —
+packed engines compute exactly what the bool-plane engines computed —
+reduces to these word-level primitives agreeing with their boolean
+definitions.  The goldens pin whole trajectories; these sweeps pin each
+primitive in isolation across shapes the engines actually use (word-tail
+Ks, non-power-of-two Ns), in the repo's seeded-random style
+(`test_member_properties.py`), not hand-picked tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim.packbits import (
+    WORD,
+    and_reduce_rows,
+    bit_column,
+    check_rumor_shardable,
+    n_words,
+    or_reduce_rows,
+    pack_bool,
+    row_mask,
+    set_bit,
+    unpack_bits,
+)
+
+SHAPES = [(1, 1), (3, 8), (7, 32), (5, 33), (16, 64), (9, 95), (33, 129)]
+
+
+def _rand_plane(rng, n, k):
+    return rng.random((n, k)) < 0.5
+
+
+@pytest.mark.parametrize("n,k", SHAPES)
+def test_pack_unpack_roundtrip_and_zero_tail(n, k):
+    rng = np.random.default_rng(n * 1000 + k)
+    for _ in range(5):
+        b = _rand_plane(rng, n, k)
+        p = np.asarray(pack_bool(b))
+        assert p.shape == (n, n_words(k)) and p.dtype == np.uint32
+        assert np.array_equal(np.asarray(unpack_bits(p, k)), b)
+        # tail bits past k in the last word are zero by construction — the
+        # engines' word-level ANY/ALL reductions depend on it
+        tail = n_words(k) * WORD - k
+        if tail:
+            assert not (p[:, -1] >> np.uint32(WORD - tail)).any()
+
+
+@pytest.mark.parametrize("n,k", SHAPES)
+def test_word_ops_are_boolean_ops(n, k):
+    """The packed engines combine planes with &, |, ~row_mask — each must
+    equal the boolean-plane op bit for bit."""
+    rng = np.random.default_rng(n * 7919 + k)
+    a, b = _rand_plane(rng, n, k), _rand_plane(rng, n, k)
+    pa, pb = pack_bool(a), pack_bool(b)
+    assert np.array_equal(np.asarray(unpack_bits(pa | pb, k)), a | b)
+    assert np.array_equal(np.asarray(unpack_bits(pa & pb, k)), a & b)
+    rows = rng.random(n) < 0.5
+    gated = np.asarray(unpack_bits(pa & row_mask(rows), k))
+    assert np.array_equal(gated, a & rows[:, None])
+
+
+@pytest.mark.parametrize("n,k", SHAPES)
+def test_row_reduces_match_numpy(n, k):
+    rng = np.random.default_rng(n * 104729 + k)
+    b = _rand_plane(rng, n, k)
+    p = pack_bool(b)
+    assert np.array_equal(
+        np.asarray(unpack_bits(or_reduce_rows(p)[None, :], k))[0], b.any(axis=0)
+    )
+    assert np.array_equal(
+        np.asarray(unpack_bits(and_reduce_rows(p)[None, :], k))[0], b.all(axis=0)
+    )
+
+
+@pytest.mark.parametrize("n,k", [(5, 33), (16, 64), (9, 95)])
+def test_bit_column_scalar_and_batched(n, k):
+    rng = np.random.default_rng(n * 31 + k)
+    b = _rand_plane(rng, n, k)
+    p = pack_bool(b)
+    for j in (0, 1, 31, 32, k - 1):
+        assert np.array_equal(np.asarray(bit_column(p, j)), b[:, j])
+    js = rng.integers(0, k, size=n)
+    assert np.array_equal(
+        np.asarray(bit_column(p, js)), b[np.arange(n), js]
+    )
+
+
+@pytest.mark.parametrize("n,k", [(8, 33), (32, 64), (11, 95)])
+def test_set_bit_matches_loop_reference(n, k):
+    """set_bit with distinct (row, slot) pairs == the per-pair loop; rows
+    out of [0, n) are dropped (the engines clip-and-gate this way)."""
+    rng = np.random.default_rng(n * 613 + k)
+    b = _rand_plane(rng, n, k)
+    m = min(n, k)
+    rows = rng.permutation(n)[:m].astype(np.int64)
+    rows[0] = n + 3  # one out-of-range row must be dropped
+    slots = rng.permutation(k)[:m]
+    on = rng.random(m) < 0.7
+    out = np.asarray(
+        unpack_bits(set_bit(pack_bool(b), rows, slots, on), k)
+    )
+    want = b.copy()
+    for r, s, o in zip(rows, slots, on):
+        if o and 0 <= r < n:
+            want[r, s] = True
+    assert np.array_equal(out, want)
+
+
+def test_shard_rule_accepts_exactly_multiples():
+    for shards in (2, 4, 8):
+        for mult in (1, 2, 3):
+            check_rumor_shardable(WORD * shards * mult, shards)
+    for k, shards in ((WORD, 2), (WORD * 3, 2), (WORD * 2 + 1, 2), (WORD * 2, 4)):
+        with pytest.raises(ValueError):
+            check_rumor_shardable(k, shards)
+    check_rumor_shardable(17, 1)  # unsharded rumor axis accepts any k
