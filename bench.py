@@ -59,21 +59,42 @@ def _watcher_capture() -> dict | None:
         cap["age_hours"] = round((time.time() - os.path.getmtime(path)) / 3600.0, 1)
     except OSError:
         cap["age_hours"] = None
-    try:
-        head = subprocess.run(
-            ["git", "-C", os.path.dirname(path), "rev-parse", "HEAD"],
-            capture_output=True,
-            text=True,
-            timeout=10,
-        ).stdout.strip()
-    except (OSError, subprocess.SubprocessError):
-        head = None
+    repo = os.path.dirname(path)
+
+    def _git(*args):
+        try:
+            r = subprocess.run(
+                ["git", "-C", repo, *args], capture_output=True, text=True, timeout=10
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        return r.stdout.strip() if r.returncode == 0 else None
+
+    head = _git("rev-parse", "HEAD")
     cap["git_head_now"] = head
     cap["same_code"] = (
         bool(head) and cap.get("git_head") == head if cap.get("git_head") else None
     )
+    # a capture is only invalidated by commits that touch what it MEASURED:
+    # doc/test/host-plane commits after a window must not mark the round's
+    # on-chip evidence stale.  Unknown diff (bad head, git failure) stays
+    # conservative (treated as engine-changed).
+    engine_changed = None
+    if cap["same_code"] is False:
+        # diff capture commit vs the WORKING TREE (not ..HEAD) so
+        # uncommitted engine edits invalidate too; swim/ is included
+        # because the sim engines import their measured semantics
+        # (member precedence/override rules) from it
+        diff = _git(
+            "diff", "--name-only", cap["git_head"], "--",
+            "ringpop_tpu/sim", "ringpop_tpu/ops", "ringpop_tpu/hashing",
+            "ringpop_tpu/parallel", "ringpop_tpu/swim", "bench.py",
+            "scripts/tpu_ksweep.py",
+        )
+        engine_changed = True if diff is None else bool(diff)
+    cap["engine_paths_changed_since"] = engine_changed
     cap["stale"] = bool(cap["age_hours"] is not None and cap["age_hours"] > 20.0) or (
-        cap["same_code"] is False
+        engine_changed is True
     )
     return cap
 
@@ -320,9 +341,10 @@ def run_bench() -> None:
     # -- secondary: delta rumor convergence ---------------------------------
     sim = DeltaSim(n=n_delta, k=k_delta, seed=0)
     t_c1 = time.perf_counter()
-    # warm the exact device-loop program the timed run uses (one 8-tick
-    # block's worth of stepping rides along)
-    run_until_converged(sim.params, sim.state, max_ticks=8)
+    # warm the exact device-loop program the timed run uses (max_ticks=0:
+    # compile + one entry-predicate eval, no block stepping — same trick
+    # as the lifecycle warmup above)
+    run_until_converged(sim.params, sim.state, max_ticks=0)
     delta_compile_s = time.perf_counter() - t_c1
 
     sim.state = init_state(sim.params, seed=1)
