@@ -75,16 +75,16 @@ def _watcher_capture() -> dict | None:
     cap["same_code"] = (
         bool(head) and cap.get("git_head") == head if cap.get("git_head") else None
     )
-    # a capture is only invalidated by commits that touch what it MEASURED:
+    # a capture is only invalidated by changes that touch what it MEASURED:
     # doc/test/host-plane commits after a window must not mark the round's
-    # on-chip evidence stale.  Unknown diff (bad head, git failure) stays
-    # conservative (treated as engine-changed).
+    # on-chip evidence stale.  The diff runs capture-commit vs the WORKING
+    # TREE whenever both heads are known — even at the same head, dirty
+    # engine edits invalidate.  Unknown diff (bad head, git failure) stays
+    # conservative (treated as engine-changed).  swim/ is included because
+    # the sim engines import their measured semantics (member precedence /
+    # override rules) from it.
     engine_changed = None
-    if cap["same_code"] is False:
-        # diff capture commit vs the WORKING TREE (not ..HEAD) so
-        # uncommitted engine edits invalidate too; swim/ is included
-        # because the sim engines import their measured semantics
-        # (member precedence/override rules) from it
+    if cap.get("git_head") and head:
         diff = _git(
             "diff", "--name-only", cap["git_head"], "--",
             "ringpop_tpu/sim", "ringpop_tpu/ops", "ringpop_tpu/hashing",

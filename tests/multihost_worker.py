@@ -53,6 +53,47 @@ def main() -> None:
         return int(jax.lax.population_count(s.learned).sum())
 
     assert bits(out) > bits(state)
+
+    # the FLAGSHIP engine over the same cross-process mesh: a sharded
+    # lifecycle state and the headline detect path (blocks + on-device
+    # predicate + early exit) — the exact program the driver bench runs,
+    # with its collectives crossing the process boundary.  Fault masks and
+    # subjects are baked in as traced constants (host-local committed
+    # arrays are not addressable across a multi-process mesh).
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    lp = lifecycle.LifecycleParams(n=64, k=64, suspect_ticks=4)
+    lsh = lifecycle.state_shardings(mesh, k=lp.k)
+    lstate = jax.jit(lambda: lifecycle.init_state(lp, seed=0), out_shardings=lsh)()
+    up = np.ones(lp.n, bool)
+    up[lp.n // 2] = False
+
+    @jax.jit
+    def detect(s):
+        return lifecycle._run_until_detected_device(
+            lp,
+            s,
+            DeltaFaults(up=jnp.asarray(up)),
+            jnp.asarray([lp.n // 2], jnp.int32),
+            min_status=lifecycle.FAULTY,
+            block_ticks=4,
+            max_blocks=jnp.int32(16),
+        )
+
+    lout, blocks, done = detect(lstate)
+    jax.block_until_ready(lout.learned)
+    # the point is the PRODUCT outcome over the cross-process mesh: the
+    # victim must actually be detected faulty by every live observer, via
+    # the on-device predicate, with the early exit stopping short of the
+    # 16-block budget
+    assert bool(done), "victim not detected over the multi-host mesh"
+    assert int(lout.tick) == int(blocks) * 4
+    assert 1 <= int(blocks) < 16, int(blocks)
+
     print(f"rank {pid} OK", flush=True)
 
 
