@@ -84,7 +84,9 @@ def _watcher_capture() -> dict | None:
     # the sim engines import their measured semantics (member precedence /
     # override rules) from it.
     engine_changed = None
-    if cap.get("git_head") and head:
+    if cap.get("git_head"):
+        # needs only the capture's commit — a failed rev-parse HEAD must
+        # not skip the check (diff failure falls back to engine-changed)
         diff = _git(
             "diff", "--name-only", cap["git_head"], "--",
             "ringpop_tpu/sim", "ringpop_tpu/ops", "ringpop_tpu/hashing",
@@ -219,12 +221,8 @@ def run_bench() -> None:
     # can SIGILL here, so heterogeneous containers must never share entries.
     from ringpop_tpu.util.accel import configure_compile_cache
 
-    configure_compile_cache(
-        os.environ.get(
-            "BENCH_COMPILE_CACHE",
-            os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
-        )
-    )
+    # BENCH_COMPILE_CACHE overrides; otherwise the shared default base
+    configure_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)

@@ -274,7 +274,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 from ringpop_tpu.util.accel import configure_compile_cache
-configure_compile_cache({os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache"))!r})
+configure_compile_cache()
 import numpy as np
 import jax.numpy as jnp
 from jax.sharding import Mesh

@@ -124,16 +124,23 @@ def compile_cache_dir(base: str, create: bool = True) -> str:
     return path
 
 
-def configure_compile_cache(base: str) -> Optional[str]:
+def configure_compile_cache(base: Optional[str] = None) -> Optional[str]:
     """Point jax's persistent compilation cache at the fingerprinted subdir
     of ``base`` (see :func:`compile_cache_dir`), with the cache thresholds
     every entry point here wants (cache anything that took >= 1 s to
-    compile, regardless of size).  One helper so bench.py, the test
-    conftest, the watcher's ksweep and the simbench children cannot drift.
-    Returns the directory used, or None when this jax version has no cache
-    flags (the caller runs uncached)."""
+    compile, regardless of size).  One helper — with one default base:
+    ``$RINGPOP_TPU_COMPILE_CACHE`` or ``<repo root>/.jax_cache`` — so
+    bench.py, the test conftest, the driver entries, the watcher's ksweep
+    and the simbench children cannot drift.  Returns the directory used,
+    or None when this jax version has no cache flags (the caller runs
+    uncached)."""
     import jax
 
+    if base is None:
+        base = os.environ.get("RINGPOP_TPU_COMPILE_CACHE") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
     try:
         path = compile_cache_dir(base)
         jax.config.update("jax_compilation_cache_dir", path)

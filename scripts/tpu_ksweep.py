@@ -87,8 +87,7 @@ def main() -> None:
     # a repeat capture in a later tunnel window pays zero recompiles
     from ringpop_tpu.util.accel import configure_compile_cache
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    configure_compile_cache(os.path.join(repo_root, ".jax_cache"))
+    configure_compile_cache()
 
     out = _env_capture()
     if os.environ.get("KSWEEP_REQUIRE_TPU") and out["platform"] == "cpu":

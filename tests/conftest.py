@@ -20,12 +20,7 @@ jax.config.update("jax_platforms", "cpu")
 # SIGILL bait.
 from ringpop_tpu.util.accel import configure_compile_cache  # noqa: E402
 
-configure_compile_cache(
-    os.environ.get(
-        "RINGPOP_TPU_COMPILE_CACHE",
-        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-    )
-)
+configure_compile_cache()  # $RINGPOP_TPU_COMPILE_CACHE or repo .jax_cache
 
 
 def pytest_configure(config):
