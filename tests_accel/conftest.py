@@ -10,7 +10,7 @@ skips everything cleanly when no live accelerator is reachable.
 
 import pytest
 
-from ringpop_tpu.util.accel import probe_accelerator
+from ringpop_tpu.util.accel import configure_compile_cache, probe_accelerator
 
 _PROBE = None
 
@@ -25,6 +25,12 @@ def _probe():
 def pytest_collection_modifyitems(config, items):
     probe = _probe()
     if probe["alive"] and probe.get("platform") not in ("cpu", None):
+        # persistent fingerprinted compile cache (shared default base): a
+        # repeat run in this window — or the next — pays zero recompiles.
+        # Only AFTER a live probe: the fingerprint touches jax.devices(),
+        # which HANGS (not raises) on a wedged tunnel, and this suite's
+        # whole design is to never let that hang reach the main process.
+        configure_compile_cache()
         return
     if probe["alive"]:
         reason = f"backend is {probe.get('platform')!r}, not an accelerator"
