@@ -1,0 +1,47 @@
+"""Every example must actually run — they are the user-facing 'switch from
+the reference' demos (PARITY §2.7), and nothing else executes them, so an
+API drift would rot them silently (the round-4 Monte-Carlo churn addition
+touched exactly such a path).  Each runs in its own subprocess (they pin
+their own CPU backend before jax init) and must exit 0 with its closing
+line intact."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = {
+    "ping_json.py": "ok=True",
+    "keyed_service.py": "ring owner",
+    "montecarlo_study.py": "churn",
+    "failure_study.py": "bit-exact: True",
+}
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,expect", sorted(EXAMPLES.items()))
+def test_example_runs(name, expect):
+    from ringpop_tpu.util.accel import compile_cache_dir
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # run the example as a USER would: without the suite's virtual-8-device
+    # XLA_FLAGS mutation (tests/conftest.py sets it at import), and with
+    # jax's native cache env var pointed at the shared fingerprinted dir so
+    # CI runs don't pay full sim-engine recompiles per example
+    env.pop("XLA_FLAGS", None)
+    env["JAX_COMPILATION_CACHE_DIR"] = compile_cache_dir(
+        os.path.join(_REPO, ".jax_cache")
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", name)],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=_REPO,
+        env=env,
+    )
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1000:])
+    assert expect in r.stdout, r.stdout[-500:]
