@@ -1,0 +1,130 @@
+"""Judge a fresh on-chip capture against PERF.md's round-4 cost model.
+
+Reads the watcher's captures (``.tpu_ksweep.json`` / ``captures/tpu_ksweep_*``
+and ``.tpu_bench_result.json``) and prints, per measurement, whether it
+CERTIFIES or REFUTES the reconciled per-tick model — so folding a tunnel
+window's numbers into PERF.md is a transcription job, not an analysis one.
+
+The model under test (PERF.md "Round 4: the reconciled on-chip per-tick
+story"):
+
+- v5e-1 packed lifecycle tick at 1M: ~2-15 ms/tick at k=128..256,
+  roughly linear in k (the retracted round-2 reading was 142 ms/tick at
+  k=128; anything within ~5x of 142 ms at its k REFUTES the round-4
+  model and reinstates the trace reading).
+- 1M detection at the headline config: well under the 60 s north star.
+- 16M delta convergence: sub-second-per-tick scale corroboration.
+
+Usage: ``python scripts/certify_cost_model.py [capture.json]``
+(defaults to the newest ksweep capture found).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# model bounds: predicted ms/tick per k at n=1M on a v5e-1 class chip,
+# PACKED engine.  Generous brackets — the point is to separate the two
+# competing models (~2-15 ms vs ~142 ms at k=128), not to grade noise.
+MODEL_MS_PER_TICK = {128: (0.5, 30.0), 256: (1.0, 60.0), 512: (2.0, 120.0)}
+RETRACTED_MS_AT_K128 = 142.0
+NORTH_STAR_S = 60.0
+
+
+def newest_ksweep() -> str | None:
+    cands = sorted(glob.glob(os.path.join(REPO, "captures", "tpu_ksweep_2*.json")))
+    # the r3 archive is not a current-code capture; prefer dated round-4+ files
+    cands = [c for c in cands if "r3_" not in os.path.basename(c)]
+    if cands:
+        return cands[-1]
+    p = os.path.join(REPO, ".tpu_ksweep.json")
+    return p if os.path.exists(p) else None
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else newest_ksweep()
+    if not path:
+        print("no ksweep capture found (run make tpu-watch and wait for a window)")
+        return 1
+    cap = json.load(open(path))
+    print(f"capture: {path}")
+    print(f"  platform={cap.get('platform')} git_head={str(cap.get('git_head'))[:12]} "
+          f"dirty={cap.get('git_dirty')} at={cap.get('captured_at')}")
+    if cap.get("platform") == "cpu":
+        print("  CPU capture — the model under test is the on-chip one; nothing to certify")
+        return 1
+
+    verdicts = []
+
+    for k_str, tc in (cap.get("tick_cost") or {}).items():
+        if "ms_per_tick_median" not in tc:
+            verdicts.append((f"tick_cost k={k_str}", None, tc.get("error", "missing")))
+            continue
+        ms = tc["ms_per_tick_median"]
+        k = int(k_str)
+        lo, hi = MODEL_MS_PER_TICK.get(k, (0.5, 240.0 * k / 512))
+        if lo <= ms <= hi:
+            verdicts.append((f"tick_cost k={k}", True, f"{ms} ms/tick in model range [{lo}, {hi}]"))
+        elif k == 128 and ms > RETRACTED_MS_AT_K128 / 5:
+            verdicts.append(
+                (f"tick_cost k={k}", False,
+                 f"{ms} ms/tick is within 5x of the RETRACTED 142 ms reading — "
+                 "the round-4 reconciliation is wrong; reinstate the trace model")
+            )
+        else:
+            verdicts.append((f"tick_cost k={k}", False, f"{ms} ms/tick outside [{lo}, {hi}]"))
+
+    dh = cap.get("detect_headline") or {}
+    if dh.get("detected") is not None:
+        wall = dh.get("wall_s")
+        ok = bool(dh.get("detected")) and wall is not None and wall < NORTH_STAR_S
+        verdicts.append(
+            ("1M detection vs 60s north star", ok,
+             f"detected={dh.get('detected')} in {wall} s / {dh.get('ticks')} ticks "
+             f"({dh.get('ms_per_tick_implied')} ms/tick implied)")
+        )
+    cv = cap.get("converge_after_detect") or {}
+    if cv.get("converged") is not None:
+        total = (dh.get("wall_s") or 0) + (cv.get("wall_s") or 0)
+        verdicts.append(
+            ("1M convergence (literal north star)", bool(cv.get("converged")) and total < NORTH_STAR_S,
+             f"converged={cv.get('converged')} total {round(total, 3)} s "
+             f"({cv.get('total_ticks')} ticks)")
+        )
+    d16 = cap.get("delta_16m") or {}
+    if d16.get("converged") is not None and d16.get("ticks"):
+        ms = (d16.get("wall_s") or 0) / d16["ticks"] * 1e3
+        verdicts.append(
+            ("16M delta corroboration", ms < 200.0,
+             f"{round(ms, 1)} ms/tick at 16M x {d16.get('k')}")
+        )
+
+    print()
+    all_known = True
+    for name, ok, detail in verdicts:
+        mark = "?" if ok is None else ("CERTIFIES" if ok else "REFUTES  ")
+        if ok is None:
+            all_known = False
+        print(f"  [{mark}] {name}: {detail}")
+    if not verdicts:
+        print("  capture has no judgeable sections")
+        return 1
+    bad = [v for v in verdicts if v[1] is False]
+    print()
+    if bad:
+        print("VERDICT: capture REFUTES the round-4 cost model on "
+              f"{len(bad)} point(s) — update PERF.md accordingly (the model, "
+              "not the measurement, loses)")
+        return 2
+    print("VERDICT: capture CERTIFIES the round-4 cost model"
+          + ("" if all_known else " (some sections missing)"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
