@@ -43,7 +43,17 @@ def _watcher_capture() -> dict | None:
     that capture rides along under this clearly-labelled key — auxiliary
     evidence of on-chip behavior, never a substitute for the ``platform``
     field of the current run."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tpu_bench_result.json")
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo_dir, ".tpu_bench_result.json")
+    if not os.path.exists(path):
+        # fall back to the newest committed capture: the watcher writes
+        # both, and the captures/ copy survives clean-ups of the working
+        # file (timestamped names sort chronologically)
+        import glob
+
+        committed = sorted(glob.glob(os.path.join(repo_dir, "captures", "tpu_bench_2*.json")))
+        if committed:
+            path = committed[-1]
     try:
         with open(path) as f:
             cap = json.load(f)
@@ -59,6 +69,18 @@ def _watcher_capture() -> dict | None:
         cap["age_hours"] = round((time.time() - os.path.getmtime(path)) / 3600.0, 1)
     except OSError:
         cap["age_hours"] = None
+    # a committed captures/ file's mtime is CHECKOUT time, not capture
+    # time — prefer the capture's own timestamp when it parses, so a
+    # months-old capture cannot ride a fresh clone as fresh evidence
+    try:
+        import calendar
+
+        t_cap = calendar.timegm(
+            time.strptime(cap["captured_at"], "%Y-%m-%dT%H:%M:%SZ")
+        )
+        cap["age_hours"] = round((time.time() - t_cap) / 3600.0, 1)
+    except (KeyError, TypeError, ValueError):
+        pass  # keep the mtime-based estimate
     repo = os.path.dirname(path)
 
     def _git(*args):

@@ -1,7 +1,7 @@
 """Judge a fresh on-chip capture against PERF.md's round-4 cost model.
 
-Reads the watcher's captures (``.tpu_ksweep.json`` / ``captures/tpu_ksweep_*``
-and ``.tpu_bench_result.json``) and prints, per measurement, whether it
+Reads the watcher's ksweep capture (``.tpu_ksweep.json`` /
+``captures/tpu_ksweep_*``) and prints, per measurement, whether it
 CERTIFIES or REFUTES the reconciled per-tick model — so folding a tunnel
 window's numbers into PERF.md is a transcription job, not an analysis one.
 
@@ -37,9 +37,9 @@ NORTH_STAR_S = 60.0
 
 
 def newest_ksweep() -> str | None:
+    # the r3 archive (tpu_ksweep_r3_*) cannot match this glob — only
+    # dated round-4+ captures are considered
     cands = sorted(glob.glob(os.path.join(REPO, "captures", "tpu_ksweep_2*.json")))
-    # the r3 archive is not a current-code capture; prefer dated round-4+ files
-    cands = [c for c in cands if "r3_" not in os.path.basename(c)]
     if cands:
         return cands[-1]
     p = os.path.join(REPO, ".tpu_ksweep.json")
@@ -51,7 +51,14 @@ def main() -> int:
     if not path:
         print("no ksweep capture found (run make tpu-watch and wait for a window)")
         return 1
-    cap = json.load(open(path))
+    try:
+        with open(path) as f:
+            cap = json.load(f)
+    except (OSError, ValueError) as e:
+        # a torn concurrent write by the watcher's flush() must yield a
+        # clean message, not a traceback (same guard as bench.py)
+        print(f"unreadable capture {path}: {e}")
+        return 1
     print(f"capture: {path}")
     print(f"  platform={cap.get('platform')} git_head={str(cap.get('git_head'))[:12]} "
           f"dirty={cap.get('git_dirty')} at={cap.get('captured_at')}")
@@ -70,7 +77,7 @@ def main() -> int:
         lo, hi = MODEL_MS_PER_TICK.get(k, (0.5, 240.0 * k / 512))
         if lo <= ms <= hi:
             verdicts.append((f"tick_cost k={k}", True, f"{ms} ms/tick in model range [{lo}, {hi}]"))
-        elif k == 128 and ms > RETRACTED_MS_AT_K128 / 5:
+        elif k == 128 and RETRACTED_MS_AT_K128 / 5 < ms < RETRACTED_MS_AT_K128 * 5:
             verdicts.append(
                 (f"tick_cost k={k}", False,
                  f"{ms} ms/tick is within 5x of the RETRACTED 142 ms reading — "
@@ -115,12 +122,18 @@ def main() -> int:
         print("  capture has no judgeable sections")
         return 1
     bad = [v for v in verdicts if v[1] is False]
+    good = [v for v in verdicts if v[1] is True]
     print()
     if bad:
         print("VERDICT: capture REFUTES the round-4 cost model on "
               f"{len(bad)} point(s) — update PERF.md accordingly (the model, "
               "not the measurement, loses)")
         return 2
+    if not good:
+        # every section errored out (e.g. the tunnel died mid-sweep):
+        # nothing was actually judged, so nothing is certified
+        print("VERDICT: capture contains no successful measurements — nothing judged")
+        return 1
     print("VERDICT: capture CERTIFIES the round-4 cost model"
           + ("" if all_known else " (some sections missing)"))
     return 0
