@@ -81,7 +81,11 @@ def _watcher_capture() -> dict | None:
         cap["age_hours"] = round((time.time() - t_cap) / 3600.0, 1)
     except (KeyError, TypeError, ValueError):
         pass  # keep the mtime-based estimate
-    repo = os.path.dirname(path)
+    # NOT dirname(path): the committed-capture fallback's path lives in
+    # captures/, and `git -C captures/ diff -- ringpop_tpu/sim ...` resolves
+    # the pathspecs against captures/ — matching nothing, exit 0 — which
+    # would silently mark an old-engine capture engine_unchanged
+    repo = repo_dir
 
     def _git(*args):
         try:

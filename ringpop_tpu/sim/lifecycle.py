@@ -339,15 +339,20 @@ def step(
             & _pair_connected(faults, h[None], p[None])[0]
         )
         merged_row = (learned2_w[h] | learned2_w[p]) & active_w  # [W]
-        # apply the pair swap as two ROW updates, not an [N, W] select: a
-        # plane-wide where() drags this whole scalar chain (row gathers,
-        # connectivity test, PRNG compare) into every downstream per-element
-        # fusion — measured ~1.2 s/tick of pure re-derivation at 1M x 256
-        def _set_row(plane, row):
-            upd = jnp.where(attempt, merged_row, plane[row])[None, :]
-            return jax.lax.dynamic_update_slice(plane, upd, (row, jnp.int32(0)))
-
-        learned2h_w = _set_row(_set_row(learned2_w, h), p)
+        # apply the pair swap as a 2-row SCATTER, not dynamic_update_slices
+        # or a plane-wide select: a DUS whose operand is a fused producer
+        # makes XLA:CPU emit a full-plane copy fusion whose body RE-DERIVES
+        # the whole upstream chain per element (the round-4 HLO dump showed
+        # two 256 MB pcount copies with 153/120-op bodies — the dominant
+        # cost of the tick), and a where() against a thin row mask just
+        # fuses the same chain back into the big pass (measured 3.0 s/tick).
+        # A scatter is not elementwise, so XLA wraps it instead of fusing:
+        # the producer materializes once with a thin body and the 2-row
+        # update is O(2·K), in-place when the input buffer is dead.
+        heal_rows2 = jnp.stack([h, p])  # int32[2]
+        learned2h_w = learned2_w.at[heal_rows2].set(
+            jnp.where(attempt, merged_row[None, :], learned2_w[heal_rows2])
+        )
         merged_bits = unpack_bits(merged_row, k)  # [K]
     else:
         learned2h_w = learned2_w
@@ -372,14 +377,16 @@ def step(
     pcount_a = jnp.minimum(state.pcount + bump, maxp)
     pcount_a = jnp.where(newly_bit, jnp.int8(0), pcount_a)
     if params.heal_prob > 0:
-        # heal resets as the same two ROW updates (a join transfer restarts
-        # dissemination of everything it carried); commutes with newly_bit's
-        # reset — both write zero
-        def _reset_row(plane, row):
-            upd = jnp.where(attempt & merged_bits, jnp.int8(0), plane[row])[None, :]
-            return jax.lax.dynamic_update_slice(plane, upd, (row, jnp.int32(0)))
-
-        pcount_a = _reset_row(_reset_row(pcount_a, h), p)
+        # heal resets (a join transfer restarts dissemination of everything
+        # it carried) as the same 2-row scatter shape as the learned-plane
+        # swap above — pass A materializes once with a thin body and the
+        # row writes are O(2·K); commutes with newly_bit's reset — both
+        # write zero
+        pcount_a = pcount_a.at[heal_rows2].set(
+            jnp.where(
+                attempt & merged_bits[None, :], jnp.int8(0), pcount_a[heal_rows2]
+            )
+        )
 
     # full-sync analog: re-seed rumors that expired short of full coverage
     up_mask = row_mask(up)

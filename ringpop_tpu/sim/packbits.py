@@ -113,8 +113,11 @@ def and_reduce_rows(p: jax.Array) -> jax.Array:
 # self-scatter ``x.at[0].set(x[0])`` (algebraically simplified away) were
 # tried and CANNOT force materialization of a producer chain.  The working
 # levers are structural: gathers through precomputed index vectors instead
-# of traced-shift rolls, and row dynamic_update_slices instead of
-# plane-wide selects (see PERF.md "Round 3").
+# of traced-shift rolls, and genuine multi-row SCATTERS (``.at[rows].set``)
+# for row updates — NOT dynamic_update_slice, whose fused form re-derives
+# its whole operand chain per element of a full-plane copy, and NOT
+# plane-wide selects, which drag the mask's producer chain into every
+# consuming element (see PERF.md "Round 3" / "Round 4").
 
 
 def set_bit(p: jax.Array, rows: jax.Array, slots: jax.Array, on: jax.Array) -> jax.Array:

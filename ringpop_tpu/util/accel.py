@@ -110,11 +110,22 @@ def compile_cache_dir(base: str, create: bool = True) -> str:
         bits.append("no-backend")
     try:
         with open("/proc/cpuinfo") as f:
+            seen = set()
             for line in f:
-                # x86 "flags"; arm64 "Features" — one representative line
-                if line.startswith(("flags", "Features")):
+                # x86 "flags" + identity lines; arm64 "Features"/"CPU part".
+                # The flags line alone is NOT enough: XLA:CPU keys tuning
+                # preferences (e.g. +prefer-no-gather on some Xeons) to the
+                # CPU *model*, so two containers with identical CPUID flags
+                # but different models produce AOT entries whose target
+                # configs mismatch — observed as the "could lead to
+                # execution errors such as SIGILL" loader warning even with
+                # flags-keyed cache dirs.
+                key = line.split(":", 1)[0].strip()
+                if key in ("flags", "Features", "model name", "vendor_id",
+                           "cpu family", "model", "stepping", "CPU part",
+                           "CPU implementer") and key not in seen:
+                    seen.add(key)
                     bits.append(line.strip())
-                    break
     except OSError:  # pragma: no cover - non-Linux
         pass
     fp = hashlib.sha1("|".join(bits).encode()).hexdigest()[:12]
