@@ -208,6 +208,71 @@ def _bel_rumor_dense(learned_b, r_subject, rkey, active, targets):
     )
 
 
+# candidate-compression capacity for _top_m_sparse, and the minimum n at
+# which the sparse path engages at all.  Module-level so tests can
+# monkeypatch them down to force both the compressed path and the overflow
+# fallback at small n.  MIN_N matters because ``lax.cond`` under vmap
+# (the Monte-Carlo engine vmaps step over a replica axis) lowers to a
+# select that executes BOTH branches — the sparse path there would pay
+# the full sort AND the compression; keeping every plausible vmapped
+# cluster size (MC sweeps run 4k–16k nodes) on the static dense path
+# makes that pessimization unreachable, while the 100k–16M single-sim
+# shapes that actually suffer the sort get the sparse win.
+_SPARSE_TOPK_CAP = 4096
+_SPARSE_TOPK_MIN_N = 65536
+
+
+def _top_m_sparse(cand: jax.Array, m: int):
+    """Exact ``lax.top_k(cand, m)`` for a sparse candidate vector.
+
+    ``top_k`` over [N] lowers to a full stable SORT — measured 446 ms of
+    the 1M-node tick on XLA:CPU, ~20% of the whole step — but at most
+    ~(victims + K + refuters) entries of ``cand`` are ever >= 0 (every
+    other subject carries the -1 sentinel).  So: prefix-sum the candidate
+    mask, scatter the candidates (in index order) into a fixed [C] buffer,
+    and top_k THAT.  Value-identity with the full top_k, including scatter
+    side effects downstream:
+
+    * real candidates keep their original index order, and top_k is a
+      stable sort, so equal keys resolve identically at the m boundary;
+    * padding entries carry (value -1, subject n): every downstream
+      scatter of a -1-valued entry either writes the buffer's default or
+      is masked by ``place`` — and subject n is out of range, so the
+      write is DROPPED (jax .at[] update semantics), matching the
+      original's harmless in-range no-op writes without introducing
+      duplicate subjects;
+    * if more than C candidates exist (impossible at the headline config;
+      possible in stretch scenarios like 16M nodes x 16k victims), a
+      ``lax.cond`` falls back to the full sort — bit-for-bit the original
+      path, just at the original speed.
+
+    Certified against the dense form by tests/test_lifecycle.py
+    (monkeypatched caps force both branches) and the frozen goldens.
+    """
+    n = cand.shape[0]
+    cap = _SPARSE_TOPK_CAP
+    if n <= max(cap, _SPARSE_TOPK_MIN_N) or m > cap:
+        return jax.lax.top_k(cand, m)
+    is_c = cand >= 0
+    pos = jnp.cumsum(is_c.astype(jnp.int32)) - 1
+    n_c = pos[-1] + 1
+
+    def compressed(_):
+        wr = jnp.where(is_c, pos, cap)  # cap = out of range -> dropped
+        buf = jnp.full((cap,), -1, jnp.int32).at[wr].set(cand, mode="drop")
+        src = jnp.full((cap,), n, jnp.int32).at[wr].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        )
+        v, i = jax.lax.top_k(buf, m)
+        return v, src[i]
+
+    def full(_):
+        v, i = jax.lax.top_k(cand, m)
+        return v, i
+
+    return jax.lax.cond(n_c <= cap, compressed, full, None)
+
+
 def step(
     params: LifecycleParams,
     state: LifecycleState,
@@ -585,7 +650,7 @@ def step(
 
     # -- merge per-subject candidates & allocate into free slots ------------
     cand = jnp.maximum(jnp.maximum(refute_key, susp_key), fire_key)
-    cand_vals, cand_subj = jax.lax.top_k(cand, m)
+    cand_vals, cand_subj = _top_m_sparse(cand, m)
     free_vals, free_slots = jax.lax.top_k((~active).astype(jnp.int32), m)
     place = (cand_vals >= 0) & (free_vals == 1)
 

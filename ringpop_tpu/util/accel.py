@@ -121,10 +121,14 @@ def compile_cache_dir(base: str, create: bool = True) -> str:
                 # execution errors such as SIGILL" loader warning even with
                 # flags-keyed cache dirs.
                 key = line.split(":", 1)[0].strip()
+                # dedup by full LINE, not by key: a heterogeneous
+                # (big.LITTLE) host lists per-core identity lines, and
+                # keeping only the first core's would collide two hosts
+                # that differ in later-listed cores
                 if key in ("flags", "Features", "model name", "vendor_id",
                            "cpu family", "model", "stepping", "CPU part",
-                           "CPU implementer") and key not in seen:
-                    seen.add(key)
+                           "CPU implementer") and line.strip() not in seen:
+                    seen.add(line.strip())
                     bits.append(line.strip())
     except OSError:  # pragma: no cover - non-Linux
         pass

@@ -447,3 +447,81 @@ def test_scale_spot_check_20k():
     faults = make_faults(n, down=victims)
     ticks, ok = sim.run_until_detected(victims, faults, min_status=FAULTY, max_ticks=1200)
     assert ok, f"only partial detection after {ticks} ticks"
+
+
+def test_sparse_topk_paths_bit_identical(monkeypatch):
+    """The sparse candidate selection (compress + top_k, lax.cond overflow
+    fallback) must be BIT-identical to the dense ``lax.top_k`` it replaces
+    — including scatter side effects downstream of padding entries and
+    stable tie order at the m boundary (simultaneous declarations carry
+    equal keys, so which subjects win slots is order-sensitive).
+
+    Caps are monkeypatched so a 512-node run exercises every branch:
+    dense (cap >= n), compressed (candidates < cap < n), and overflow
+    (cap < candidates -> cond falls back to the full sort).
+    """
+    from ringpop_tpu.sim import lifecycle
+
+    n, k = 512, 16
+    # 50 simultaneous victims vs alloc_per_tick=8: tie-heavy boundary
+    victims = list(range(3, 503, 10))
+    faults = make_faults(n, down=victims)
+    params = LifecycleParams(n=n, k=k, alloc_per_tick=8, suspect_ticks=4)
+
+    def run(cap, min_n=0):
+        monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", cap)
+        monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", min_n)
+        state = init_state(params, seed=3)
+        out = []
+        for _ in range(30):
+            state = step(params, state, faults)
+            out.append(state)
+        return out
+
+    dense = run(4096, min_n=1 << 30)  # n <= min_n: full top_k, statically
+    compressed = run(64)  # candidates (<=50ish) < cap < n: compressed path
+    overflow = run(8)  # cap < candidates: cond overflow -> full sort
+
+    for variant, tag in ((compressed, "compressed"), (overflow, "overflow")):
+        for t, (sa, sb) in enumerate(zip(dense, variant)):
+            for f, va, vb in zip(sa._fields, sa, sb):
+                assert np.array_equal(np.asarray(va), np.asarray(vb)), (
+                    f"{tag} diverges from dense at tick {t} field {f}"
+                )
+
+
+def test_sparse_topk_branches_pinned(monkeypatch):
+    """Unit-level pin of WHICH _top_m_sparse branch runs: the step-level
+    test above can't observe branch selection, so a drift in candidate
+    counts could silently turn its 'compressed' run into overflow-fallback
+    coverage.  Here the candidate count is constructed by hand on both
+    sides of the cap, including boundary ties, an empty candidate set,
+    and count == cap exactly."""
+    import jax
+
+    from ringpop_tpu.sim import lifecycle
+
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", 16)
+    n, m = 300, 4
+    rng = np.random.default_rng(7)
+
+    def check(n_cand, tag):
+        cand = np.full(n, -1, np.int32)
+        idx = np.sort(rng.choice(n, n_cand, replace=False))
+        # duplicate keys on purpose: tie order at the m boundary must match
+        cand[idx] = rng.integers(0, 4, n_cand).astype(np.int32)
+        got_v, got_i = lifecycle._top_m_sparse(jnp.asarray(cand), m)
+        exp_v, exp_i = jax.lax.top_k(jnp.asarray(cand), m)
+        # padding entries (value -1) may legitimately differ in subject:
+        # dense uses arbitrary in-range indices, sparse uses n (dropped by
+        # every downstream scatter) — compare values always, indices only
+        # where a real candidate was selected
+        assert np.array_equal(np.asarray(got_v), np.asarray(exp_v)), tag
+        real = np.asarray(exp_v) >= 0
+        assert np.array_equal(np.asarray(got_i)[real], np.asarray(exp_i)[real]), tag
+
+    check(0, "empty")        # no candidates at all
+    check(7, "compressed")   # 7 < cap=16: compressed branch
+    check(16, "boundary")    # == cap: still compressed
+    check(40, "overflow")    # > cap: cond falls back to the full sort
