@@ -127,3 +127,38 @@ def test_delta_convergence_on_accel():
     sim = DeltaSim(n=50_000, k=64, seed=0)
     ticks, ok = sim.run_until_converged(max_ticks=1024)
     assert ok and ticks <= 1024
+
+
+def test_sparse_topk_bitexact_on_accel():
+    """The sparse candidate selection (``lifecycle._top_m_sparse`` —
+    prefix-sum compress + top_k + cond overflow fallback) must lower on
+    the accelerator AND stay bit-identical to the dense ``lax.top_k``
+    there: TPU sorts, scatters with out-of-range drops, and batched conds
+    all have their own lowering paths, and the CPU suite cannot vouch for
+    them.  Shapes are chosen above the static MIN_N floor so the sparse
+    path actually engages."""
+    from ringpop_tpu.sim import lifecycle
+
+    cap, min_n = lifecycle._SPARSE_TOPK_CAP, lifecycle._SPARSE_TOPK_MIN_N
+    # derive n from BOTH static-guard constants, so tuning either one can
+    # never silently park every case on the dense path; n_cand likewise
+    # tracks cap so "compressed" stays compressed and "overflow" overflows
+    n, m = max(131072, min_n * 2, cap * 2), 64
+    assert n > max(cap, min_n), "sparse path must engage at this n"
+    sparse_f = jax.jit(lambda c: lifecycle._top_m_sparse(c, m))
+    dense_f = jax.jit(lambda c: tuple(jax.lax.top_k(c, m)))
+    rng = np.random.default_rng(5)
+    for n_cand, tag in ((0, "empty"), (max(cap // 4, m + 1), "compressed"),
+                        (cap + 512, "overflow")):
+        cand = np.full(n, -1, np.int32)
+        if n_cand:
+            idx = np.sort(rng.choice(n, n_cand, replace=False))
+            cand[idx] = rng.integers(0, 8, n_cand).astype(np.int32)  # ties
+        c = jnp.asarray(cand)
+        got_v, got_i = sparse_f(c)
+        exp_v, exp_i = dense_f(c)
+        assert np.array_equal(np.asarray(got_v), np.asarray(exp_v)), tag
+        real = np.asarray(exp_v) >= 0
+        assert np.array_equal(
+            np.asarray(got_i)[real], np.asarray(exp_i)[real]
+        ), tag
