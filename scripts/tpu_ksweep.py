@@ -19,6 +19,11 @@ every section so a mid-run tunnel death still leaves partial evidence):
 3. Convergence (view-checksum agreement + quiescence) continuing from
    the detected state — the literal BASELINE.md north-star wording.
 4. Delta rumor convergence at 1M and at 16M (16x north-star scale).
+4b. Sparse candidate selection (``lifecycle._top_m_sparse``) vs the
+   dense ``lax.top_k`` full sort it replaced in round 4 — per-call ms
+   for both, a bit-equality cross-check, and whether the sparse branch
+   statically engaged at this n (below the floor both sides are the
+   same dense program and the comparison is vacuous).
 5. Batched ring lookup qps (sustained: 10 batches inside one jitted
    loop — per-dispatch timing through the tunnel would measure the
    tunnel, not the op; methodology per bench.py).
@@ -227,6 +232,47 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             out[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
         flush()
+
+    # -- 4b: sparse candidate selection vs the dense sort it replaced -------
+    # (round 4: lifecycle._top_m_sparse compresses the sparse [N] candidate
+    # vector before top_k; the dense form is a full stable sort.  Quantify
+    # the gap on THIS platform so the on-chip tick model can attribute it.)
+    try:
+        cand_np = np.full(n, -1, np.int32)
+        idx = rng.choice(n, max(2, n // 1000), replace=False)
+        cand_np[idx] = rng.integers(0, 1 << 30, idx.size).astype(np.int32)
+        cand = jnp.asarray(cand_np)
+        m_sel = min(64, n)
+        sparse_f = jax.jit(lambda c: lifecycle._top_m_sparse(c, m_sel))
+        dense_f = jax.jit(lambda c: tuple(jax.lax.top_k(c, m_sel)))
+        sec = {
+            "n": n,
+            "m": m_sel,
+            "n_candidates": int(idx.size),
+            # below the static floor both jits are the same dense program —
+            # a reader must not attribute "no win, verified equal" to a
+            # capture where the sparse branch never ran
+            "sparse_engaged": n
+            > max(lifecycle._SPARSE_TOPK_CAP, lifecycle._SPARSE_TOPK_MIN_N),
+        }
+        for label, fn in (("sparse_ms", sparse_f), ("dense_sort_ms", dense_f)):
+            jax.block_until_ready(fn(cand))  # compile
+            t0 = time.perf_counter()
+            for _ in range(max(reps, 3)):
+                r = fn(cand)
+            jax.block_until_ready(r)
+            sec[label] = round((time.perf_counter() - t0) / max(reps, 3) * 1e3, 3)
+        sv, si = sparse_f(cand)
+        dv, di = dense_f(cand)
+        real = np.asarray(dv) >= 0
+        sec["bit_equal"] = bool(
+            np.array_equal(np.asarray(sv), np.asarray(dv))
+            and np.array_equal(np.asarray(si)[real], np.asarray(di)[real])
+        )
+        out["sparse_topk"] = sec
+    except Exception as e:  # pragma: no cover
+        out["sparse_topk"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    flush()
 
     # -- 5: sustained batched ring lookup -----------------------------------
     try:

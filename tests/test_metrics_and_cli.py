@@ -136,6 +136,11 @@ def test_tpu_ksweep_smoke_cpu(tmp_path):
     assert out["detect_headline"]["ms_per_tick_implied"] > 0
     assert out["converge_after_detect"]["converged"] is True
     assert out["delta_1m"]["converged"] and out["delta_16m"]["converged"]
+    st = out["sparse_topk"]
+    assert st["bit_equal"] is True and st["sparse_ms"] > 0 and st["dense_sort_ms"] > 0
+    # n=2048 sits below the static floor: the section must SAY the sparse
+    # branch didn't engage, so a reader can't mistake the vacuous compare
+    assert st["sparse_engaged"] is False
     assert out["ring_lookup_qps"] > 0
     # the redirected capture file carries the same record
     cap = json.load(open(out_path))
