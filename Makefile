@@ -48,6 +48,11 @@ tpu-watch:
 simbench:
 	$(PY) -m ringpop_tpu.cli.simbench
 
+# judge the newest watcher ksweep capture against PERF.md's cost model
+# (prints CERTIFIES/REFUTES per measurement; rc=2 on refutation)
+certify:
+	$(PY) scripts/certify_cost_model.py
+
 # native FarmHash core (rebuilds the .so the hashing layer loads via ctypes)
 native:
 	$(PY) -c "from ringpop_tpu import native; assert native._build(), 'g++ build failed'; print('native hash core built')"
