@@ -1,0 +1,130 @@
+"""Profile the lifecycle tick on this host: per-tick wall cost plus a
+ranking of the optimized-HLO fusions by (elements x body-ops).
+
+This is the committed form of the methodology that found the round-4
+wins (PERF.md "Round 3"/"Round 4"): ``--xla_hlo_profile`` crashes on the
+step program (XLA-internal check failure), and trace tooling is heavier
+than needed — dumping the optimized HLO and ranking loop fusions by
+output-element count times fusion-body size localizes the expensive
+passes well enough to act on (it is how the heal-DUS full-plane copies
+and the 1M candidate sort were found).
+
+Usage:
+    python scripts/profile_tick.py [n] [k] [ticks]      # defaults 1M 256 8
+
+Prints per-tick wall cost, then the top fusions/ops of the step module.
+CPU-pinned by default (PROFILE_PIN=axon to aim at the tunnel instead —
+but profile on-chip via scripts/tpu_ksweep.py, which the watcher runs).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+
+
+def rank_fusions(hlo_path: str, top: int = 15) -> list[tuple]:
+    lines = open(hlo_path).read().splitlines()
+    comps: dict[str, int] = {}
+    cur = None
+    for line in lines:
+        if line.rstrip().endswith("{") and not line.lstrip().startswith("ROOT"):
+            cur = line.split()[0].lstrip("%")
+            comps[cur] = 0
+        elif cur is not None:
+            if line.strip() == "}":
+                cur = None
+            elif "=" in line:
+                comps[cur] += 1
+    rows = []
+    for line in lines:
+        m = re.search(
+            r"%([\w.\-]+) = (.+?) (fusion|sort|scatter|while|reduce-window)\(", line
+        )
+        if not m:
+            continue
+        c = re.search(r"calls=%([\w.\-]+)", line)
+        body = comps.get(c.group(1), 0) if c else 0
+        elems = 0
+        for dims in re.findall(r"(?:f|s|u|pred)(?:\d+)?\[([\d,]+)\]", m.group(2)):
+            n = 1
+            for d in dims.split(","):
+                n *= int(d)
+            elems = max(elems, n)
+        rows.append((elems * max(body, 1), elems, body, m.group(3), m.group(1)))
+    rows.sort(reverse=True)
+    return rows[:top]
+
+
+def main() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ.get("PROFILE_PIN", "cpu"))
+    except RuntimeError:
+        pass  # backend already initialized (e.g. by the axon site hook)
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+    dump = tempfile.mkdtemp(prefix="tickhlo_")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_dump_to={dump} --xla_dump_hlo_as_text"
+    ).strip()
+
+    try:
+        _profile(jax, jnp, np, lifecycle, DeltaFaults, n, k, ticks, dump)
+    finally:
+        shutil.rmtree(dump, ignore_errors=True)
+
+
+def _profile(jax, jnp, np, lifecycle, DeltaFaults, n, k, ticks, dump):
+    params = lifecycle.LifecycleParams(n=n, k=k)
+    state = lifecycle.init_state(params, seed=0)
+    rng = np.random.default_rng(0)
+    victims = np.sort(rng.choice(n, size=max(1, n // 1000), replace=False))
+    up = np.ones(n, bool)
+    up[victims] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+
+    step = jax.jit(lambda s: lifecycle.step(params, s, faults))
+    t0 = time.perf_counter()
+    state = jax.block_until_ready(step(state))
+    print(f"compile+first tick: {time.perf_counter() - t0:.2f}s")
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        state = step(state)
+    jax.block_until_ready(state.learned)
+    dt = time.perf_counter() - t0
+    print(f"{ticks} ticks in {dt:.2f}s -> {dt / ticks * 1000:.0f} ms/tick (n={n}, k={k})")
+
+    mods = [
+        p
+        for p in glob.glob(os.path.join(dump, "*lambda*after_optimizations.txt"))
+        if "buffer" not in p and "memory" not in p
+    ]
+    if mods:
+        biggest = max(mods, key=os.path.getsize)
+        print(f"\ntop fusions of {os.path.basename(biggest)}")
+        print(f"{'cost~':>12} {'Melem':>8} {'body':>5}  kind      name")
+        for cost, elems, body, kind, name in rank_fusions(biggest):
+            print(f"{cost / 1e6:12.1f} {elems / 1e6:8.1f} {body:5d}  {kind:8s}  {name[:40]}")
+    else:
+        print("no step-module HLO dump found (jit cache hit? rerun in a fresh process)")
+
+
+if __name__ == "__main__":
+    main()
