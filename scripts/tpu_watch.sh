@@ -72,6 +72,17 @@ open(os.path.join(repo, "captures",
      f"tpu_bench_{ts.replace(':', '').replace('-', '')}.json"), "w").write(blob)
 EOF
       echo "[$(ts)] bench captured:"; cat /tmp/tpu_watch_bench_raw.json
+      # commit the bench capture IMMEDIATELY: ksweep + the accel suite
+      # can run another ~30-60 min, and a window caught near the end of
+      # a round must still leave committed evidence even if the rest of
+      # the sweep outlives the session
+      if git add captures .tpu_bench_result.json 2>/dev/null 9>&- \
+          && git commit --only captures --only .tpu_bench_result.json 9>&- \
+               -m "Record TPU watcher bench capture $(ts)" \
+               -m "No-Verification-Needed: data-only capture artifact from make tpu-watch" \
+               2>/dev/null; then
+        echo "[$(ts)] bench capture committed (early)"
+      fi
       echo "[$(ts)] running ksweep"
       timeout "$KSWEEP_TIMEOUT" python scripts/tpu_ksweep.py 9>&- \
         2>/tmp/tpu_watch_ksweep_stderr.log
