@@ -111,7 +111,10 @@ EOF
     echo "[$(ts)] bench attempt failed or fell back to cpu; stderr tail:"
     tail -3 /tmp/tpu_watch_bench_stderr.log
   fi
-  sleep "$INTERVAL"
+  # 9>&- here too: an orphaned interval sleep would otherwise hold the
+  # flock for up to INTERVAL seconds after the watcher itself dies,
+  # blocking an immediate relaunch
+  sleep "$INTERVAL" 9>&-
 done
 echo "[$(ts)] tunnel never revived after $ATTEMPTS attempts"
 exit 1
