@@ -62,30 +62,34 @@ def rank_fusions(hlo_path: str, top: int = 15) -> list[tuple]:
 
 
 def main() -> None:
-    import jax
-
-    try:
-        jax.config.update("jax_platforms", os.environ.get("PROFILE_PIN", "cpu"))
-    except RuntimeError:
-        pass  # backend already initialized (e.g. by the axon site hook)
-    import jax.numpy as jnp
-    import numpy as np
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from ringpop_tpu.sim import lifecycle
-    from ringpop_tpu.sim.delta import DeltaFaults
-
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    k = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 8
-
+    # XLA reads XLA_FLAGS once, when the backend client is created — the
+    # dump flags must be in the environment BEFORE jax is imported, or a
+    # pre-initialized backend (e.g. the axon site hook importing jax at
+    # interpreter start) silently ignores them and no HLO is dumped.
     dump = tempfile.mkdtemp(prefix="tickhlo_")
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_dump_to={dump} --xla_dump_hlo_as_text"
-    ).strip()
-
     try:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_dump_to={dump} --xla_dump_hlo_as_text"
+        ).strip()
+
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ.get("PROFILE_PIN", "cpu"))
+        except RuntimeError:
+            pass  # backend already initialized (e.g. by the axon site hook)
+        import jax.numpy as jnp
+        import numpy as np
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from ringpop_tpu.sim import lifecycle
+        from ringpop_tpu.sim.delta import DeltaFaults
+
+        n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+        k = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+        ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
         _profile(jax, jnp, np, lifecycle, DeltaFaults, n, k, ticks, dump)
     finally:
         shutil.rmtree(dump, ignore_errors=True)
@@ -123,7 +127,11 @@ def _profile(jax, jnp, np, lifecycle, DeltaFaults, n, k, ticks, dump):
         for cost, elems, body, kind, name in rank_fusions(biggest):
             print(f"{cost / 1e6:12.1f} {elems / 1e6:8.1f} {body:5d}  {kind:8s}  {name[:40]}")
     else:
-        print("no step-module HLO dump found (jit cache hit? rerun in a fresh process)")
+        print(
+            "no step-module HLO dump found (jit cache hit, or the backend was "
+            "initialized before this script set the dump flags — e.g. a site "
+            "hook importing jax at interpreter start; rerun in a fresh process)"
+        )
 
 
 if __name__ == "__main__":

@@ -246,8 +246,6 @@ def test_headline_scale_snapshot_roundtrip_and_resume(tmp_path):
     uninterrupted one — the at-scale analog of the reference's restart
     path.  Also pins the cost class: the packed planes compress a 1M-node
     mid-dissemination state to ~MBs, seconds to write on one core."""
-    from ringpop_tpu.sim.delta import DeltaFaults
-
     n, k = 1_000_000, 256
     params = lifecycle.LifecycleParams(n=n, k=k)
     rng = np.random.default_rng(0)
