@@ -159,8 +159,22 @@ def main() -> None:
         attempt_plan.append((None, float(os.environ.get("BENCH_ACCEL_TIMEOUT_S", "1500"))))
     attempt_plan.append(("cpu", float(os.environ.get("BENCH_CPU_TIMEOUT_S", "2700"))))
 
+    # XLA:CPU AOT loader warning: a persistent-cache entry whose embedded
+    # target-machine features don't match this machine's.  The fingerprinted
+    # cache dir (util/accel.py) should make this unreachable; if it still
+    # fires (unknown future environment skew), the entries are evidence of a
+    # real mismatch — purge that cache dir and rerun the attempt once so the
+    # artifact records a cleanly-compiled run, not 100kB of loader warnings.
+    aot_mismatch_texts = (
+        "doesn't match the machine type",
+        "could lead to execution errors such as SIGILL",
+    )
+    aot_purged = False
+
     failures = []
-    for platform_pin, timeout_s in attempt_plan:
+    attempts = list(attempt_plan)
+    while attempts:
+        platform_pin, timeout_s = attempts.pop(0)
         env = dict(os.environ, BENCH_CHILD="1")
         if platform_pin:
             # BENCH_PIN makes the child call jax.config.update("jax_platforms")
@@ -180,8 +194,6 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             failures.append(f"{platform_pin or 'accel'}: timeout after {timeout_s:.0f}s")
             continue
-        if r.stderr:
-            sys.stderr.write(r.stderr)
         line = next(
             (ln for ln in reversed(r.stdout.strip().splitlines()) if ln.startswith("{")),
             None,
@@ -190,9 +202,27 @@ def main() -> None:
             try:
                 result = json.loads(line)
             except json.JSONDecodeError as e:
+                sys.stderr.write(r.stderr or "")
                 failures.append(f"{platform_pin or 'accel'}: bad child output: {e}")
                 continue
+            stale = r.stderr and any(t in r.stderr for t in aot_mismatch_texts)
+            if stale and not aot_purged and result.get("compile_cache_dir"):
+                import shutil
+
+                shutil.rmtree(result["compile_cache_dir"], ignore_errors=True)
+                aot_purged = True
+                sys.stderr.write(
+                    "bench: AOT target-feature mismatch warning in child stderr; "
+                    f"purged stale cache {result['compile_cache_dir']} and rerunning\n"
+                )
+                attempts.insert(0, (platform_pin, timeout_s))
+                continue
+            # only the kept attempt's stderr reaches the artifact tail — a
+            # discarded (purged) attempt leaves the one-line note above
+            if r.stderr:
+                sys.stderr.write(r.stderr)
             result["probe"] = probe
+            result["aot_cache_purged"] = aot_purged
             result["fallback_reason"] = (
                 fallback_reason
                 if result.get("platform") == "cpu" and probe["alive"] is False
@@ -202,10 +232,33 @@ def main() -> None:
                 result["tpu_watcher_capture"] = _watcher_capture()
             print(json.dumps(result))
             return
+        if r.stderr:
+            sys.stderr.write(r.stderr)
         tail = (r.stderr or "").strip().splitlines()[-3:]
         failures.append(
             f"{platform_pin or 'accel'}: rc={r.returncode} {' | '.join(tail)[-300:]}"
         )
+        # a stale AOT entry can also CRASH the child (the SIGILL the warning
+        # text is about) — no JSON to read a cache dir from, so purge the
+        # whole cache base and retry the attempt once
+        if (
+            r.stderr
+            and any(t in r.stderr for t in aot_mismatch_texts)
+            and not aot_purged
+        ):
+            import shutil
+
+            # same default base as configure_compile_cache (util/accel.py)
+            base = os.environ.get("BENCH_COMPILE_CACHE") or os.environ.get(
+                "RINGPOP_TPU_COMPILE_CACHE"
+            ) or os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+            shutil.rmtree(base, ignore_errors=True)
+            aot_purged = True
+            sys.stderr.write(
+                "bench: AOT target-feature mismatch in failed child stderr; "
+                f"purged cache base {base} and rerunning\n"
+            )
+            attempts.insert(0, (platform_pin, timeout_s))
 
     # both attempts failed — still emit one diagnostic JSON line.
     # vs_baseline is null (not 0.0): null means "no comparable number",
@@ -248,7 +301,7 @@ def run_bench() -> None:
     from ringpop_tpu.util.accel import configure_compile_cache
 
     # BENCH_COMPILE_CACHE overrides; otherwise the shared default base
-    configure_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
+    cache_dir = configure_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -454,6 +507,9 @@ def run_bench() -> None:
         "ring_lookup_qps": round(ring_qps, 0),
         "view_checksum_s": round(checksum_s, 4),
         "platform": platform,
+        # lets the parent purge exactly this dir if the XLA:CPU AOT loader
+        # reported a target-feature mismatch while loading cached entries
+        "compile_cache_dir": cache_dir,
     }
     print(json.dumps(result))
 

@@ -316,11 +316,20 @@ equal = all(bool((np.asarray(a) == np.asarray(b)).all())
 subjects = jnp.asarray(victims, jnp.int32)
 detect_kw = dict(min_status=lifecycle.FAULTY, block_ticks=32, max_blocks=jnp.int32(16))
 detect_block_ticks = detect_kw["block_ticks"]
+# first call = compile (unless the persistent cache covers it) + execute;
+# second call on the SAME inputs = execute only.  Round-4's single-call
+# timings swung 6x with cache state and read as perf evidence they were
+# not (VERDICT r4 weak #2) — exec_s is the comparable number.
 t0 = time.perf_counter()
 dref, ref_blocks, ref_done = lifecycle._run_until_detected_device(
     params, lifecycle.init_state(params, seed=seed), faults, subjects, **detect_kw)
 jax.block_until_ready(dref.learned)
 detect_unsharded_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+dref2, _, _ = lifecycle._run_until_detected_device(
+    params, lifecycle.init_state(params, seed=seed), faults, subjects, **detect_kw)
+jax.block_until_ready(dref2.learned)
+detect_unsharded_exec_s = time.perf_counter() - t0
 
 t0 = time.perf_counter()
 dsh, sh_blocks, sh_done = lifecycle._run_until_detected_device(
@@ -329,6 +338,13 @@ dsh, sh_blocks, sh_done = lifecycle._run_until_detected_device(
     faults, subjects, **detect_kw)
 jax.block_until_ready(dsh.learned)
 detect_sharded_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+dsh2, _, _ = lifecycle._run_until_detected_device(
+    params,
+    jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed), shardings),
+    faults, subjects, **detect_kw)
+jax.block_until_ready(dsh2.learned)
+detect_sharded_exec_s = time.perf_counter() - t0
 
 detect_equal = all(bool((np.asarray(a) == np.asarray(b)).all())
                    for a, b in zip(jax.tree.leaves(dref), jax.tree.leaves(dsh)))
@@ -337,7 +353,9 @@ detect = dict(detected=bool(ref_done), ticks=int(ref_blocks) * detect_block_tick
               verdict_equal=bool(ref_done) == bool(sh_done),
               state_equal=detect_equal,
               unsharded_s=round(detect_unsharded_s, 2),
-              sharded_s=round(detect_sharded_s, 2))
+              sharded_s=round(detect_sharded_s, 2),
+              unsharded_exec_s=round(detect_unsharded_exec_s, 2),
+              sharded_exec_s=round(detect_sharded_exec_s, 2))
 
 # print the certificate BEFORE attempting the 1M step: a non-Python
 # death there (OOM SIGKILL) must not destroy the already-computed 100k
@@ -358,10 +376,22 @@ try:
     s1m = jax.tree.map(jax.device_put, lifecycle.init_state(p1m, seed=seed),
                        lifecycle.state_shardings(mesh, k=p1m.k))
     blk1m = jax.jit(functools.partial(lifecycle._run_block, p1m), static_argnames="ticks")
+    # split compile from execute (VERDICT r4 item 2): the first call pays
+    # XLA compile of the sharded 1M program UNLESS the persistent cache
+    # (configure_compile_cache above) already holds it — round-4's single
+    # wall_s swung 9.08 s -> 362.98 s purely on cache state.  The second
+    # call on the same inputs is execute-only, the reproducible number.
     t0 = time.perf_counter()
     o1m = blk1m(s1m, f1m, ticks=1)
     jax.block_until_ready(o1m.learned)
-    step1m = dict(ok=True, wall_s=round(time.perf_counter() - t0, 2),
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    o1m2 = blk1m(s1m, f1m, ticks=1)
+    jax.block_until_ready(o1m2.learned)
+    execute_s = time.perf_counter() - t0
+    step1m = dict(ok=True, first_call_s=round(first_s, 2),
+                  compile_s=round(max(first_s - execute_s, 0.0), 2),
+                  execute_s=round(execute_s, 2),
                   tick=int(o1m.tick))
 except Exception as e:
     step1m = dict(ok=False, error=(type(e).__name__ + ": " + str(e))[:300])
@@ -421,6 +451,10 @@ print(json.dumps(dict(tick_equal=equal, n_devices=len(jax.devices("cpu")),
         "detect_equal": detect_equal,
         "detect_sharded_s": detect["sharded_s"],
         "detect_unsharded_s": detect["unsharded_s"],
+        # execute-only (second call, same inputs): the comparable pair —
+        # the *_s fields above include compile on a cold persistent cache
+        "detect_sharded_exec_s": detect.get("sharded_exec_s"),
+        "detect_unsharded_exec_s": detect.get("unsharded_exec_s"),
         # one sharded 1M x 256 step on the same mesh (headline scale)
         "step1m": child["step1m"],
         "equal": child["tick_equal"] and detect_equal,
@@ -685,6 +719,109 @@ def bench_partition1m(seed: int, full: bool) -> dict:
     }
 
 
+def bench_partition_lifecycle(seed: int, full: bool) -> dict:
+    """Detection and convergence SEPARATED, at scale (VERDICT r4 item 6):
+    the headline bench always reports ``converge_extra_ticks: 0`` because
+    at that config quiescence coincides with detection — this row makes
+    the two criteria discriminate.
+
+    Crash 0.1% of the cluster and run the headline detection to
+    completion; then, before the views are left to quiesce, a 30%
+    partition blips for ``blip_ticks`` and heals.  During the blip every
+    cross-partition probe fails, so the cluster admits (budget-bounded)
+    FALSE suspicions about live nodes.  Detection of the true victims is
+    already done — but literal convergence (the reference's
+    waitForConvergence criterion, ``swim/test_utils.go:164-199``: NO
+    rumors in flight and every live view checksum equal) must now wait
+    for every falsely-accused node to learn of its accusation, refute by
+    reincarnation, and for the refutations to disseminate and quiesce:
+    ``converge_extra_ticks > 0``, measured at 4-tick granularity.
+
+    Why the blip comes AFTER detection: a partition held across the
+    whole detection episode wedges the bounded global rumor table —
+    cross-partition rumors can never reach full coverage, the full-sync
+    re-seeder keeps them alive, admission stalls, and the true victims'
+    accusations queue behind ~0.3·N false candidates at 64 admissions/
+    tick (measured: 20k-node smoke never detected within 1024 partition
+    ticks).  That wedge is a real property of bounded-slot dissemination
+    under partition (the reference's per-node piggyback maps are
+    unbounded, ``swim/disseminator.go``), and the committed row's fields
+    record the post-heal reconciliation instead of fighting it.
+
+    Reference analog: partition tests build partitions by fiat then heal
+    (``swim/heal_partition_test.go:15-53``); refutation-by-reincarnation
+    is ``swim/memberlist.go:337-354``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    n = 1_000_000 if full else 20_000
+    k = 256 if full else 64
+    blip_ticks = 24  # < suspect_ticks (25): accusations stay refutable suspects
+    rng = np.random.default_rng(seed)
+    victims = np.sort(rng.choice(n, size=max(4, n // 1000), replace=False))
+    up = np.ones(n, bool)
+    up[victims] = False
+    group = np.zeros(n, np.int32)
+    group[: int(0.3 * n)] = 1
+    plain = DeltaFaults(up=jnp.asarray(up))
+    blip = DeltaFaults(up=jnp.asarray(up), group=jnp.asarray(group))
+
+    sim = lifecycle.LifecycleSim(n=n, k=k, seed=seed)
+    # phase 1: headline failure detection, no partition
+    t0 = time.perf_counter()
+    detect_ticks, detected = sim.run_until_detected(
+        victims, plain, max_ticks=4096, check_every=16, blocks_per_dispatch=8,
+        time_budget_s=2400.0,
+    )
+    jax.block_until_ready(sim.state.learned)
+    detect_s = time.perf_counter() - t0
+
+    # phase 2: the 30% partition blips and heals late
+    t0 = time.perf_counter()
+    sim.run(blip_ticks, blip)
+    jax.block_until_ready(sim.state.learned)
+    blip_s = time.perf_counter() - t0
+
+    # the blip left the cluster detected-but-not-converged: false
+    # accusations are in flight and views diverge across nodes
+    cs = np.asarray(lifecycle.view_checksums(sim.state, plain))
+    views_agree_after_blip = bool(len(np.unique(cs[np.asarray(plain.up)])) == 1)
+
+    # phase 3 (healed): literal convergence — refutations must disseminate
+    # and quiesce; 4-tick checks so a short tail still resolves as > 0
+    t0 = time.perf_counter()
+    extra_ticks, converged = sim.run_until_converged(
+        plain, max_ticks=4096, check_every=4, blocks_per_dispatch=8,
+        time_budget_s=2400.0,
+    )
+    jax.block_until_ready(sim.state.learned)
+    converge_s = time.perf_counter() - t0
+
+    return {
+        "metric": f"lifecycle_{n // 1000}k_30pct_partition_blip_heal",
+        "value": round(detect_s + blip_s + converge_s, 3),
+        "unit": "s",
+        "n_nodes": n,
+        "n_rumor_slots": k,
+        "n_victims": int(len(victims)),
+        "detect_ticks": detect_ticks,
+        "detected": detected,
+        "detect_s": round(detect_s, 3),
+        "blip_ticks": blip_ticks,
+        "blip_s": round(blip_s, 3),
+        # detection is NOT convergence here: views differ after the blip
+        "views_agree_after_blip": views_agree_after_blip,
+        # the deliverable: convergence lands strictly AFTER detection
+        "converge_extra_ticks": extra_ticks,
+        "converged": converged,
+        "converge_s": round(converge_s, 3),
+    }
+
+
 def bench_ring1m(seed: int, full: bool) -> dict:
     import jax
     import jax.numpy as jnp
@@ -859,6 +996,7 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
     real distribution to summarize."""
     import numpy as np
 
+    from ringpop_tpu.sim.lifecycle import LifecycleParams
     from ringpop_tpu.sim.montecarlo import detection_latency_under_churn
 
     n = 4096 if full else 512
@@ -880,6 +1018,37 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
         if out["ticks_median"] is None or out["ticks_p90"] is None
         else out["ticks_p90"] - out["ticks_median"]
     )
+    # locate the cliff (VERDICT r4 item 5): the dose at the largest jump
+    # between consecutive points of the dose-response curve.  The round-4
+    # curve was stepwise (36 -> 46 -> 56-63) with one dominating jump
+    # (63 -> 96 between doses 103 and 107) that the summary stats hid.
+    curve = [(c, t) for c, t in out["churn_ticks"] if t is not None]
+    cliff_at = cliff_jump = None
+    if len(curve) >= 2:
+        cliff_jump, cliff_at = max(
+            (t2 - t1, c2) for (_, t1), (c2, t2) in zip(curve, curve[1:])
+        )
+    # mechanism contrast at the saturating dose (2 replicas each: dose 0 +
+    # dose churn_max).  Tripling maxP leaves the saturated latency
+    # unchanged while doubling K collapses it — the binding constraint is
+    # rumor-SLOT capacity, not the maxP propagation budget (the analog of
+    # swim/disseminator.go:75-97, which in the reference governs an
+    # UNBOUNDED piggyback map and therefore cannot produce this cliff).
+    contrast = None
+    if full:
+        base_p = LifecycleParams(n=n, k=32)
+        contrast = {"maxp_default": base_p.resolved_max_p()}
+        for label, kw in (
+            ("k32_maxp_default", dict(k=32)),
+            ("k32_maxp_x3", dict(k=32, max_p=3 * base_p.resolved_max_p())),
+            ("k64_maxp_default", dict(k=64)),
+        ):
+            o = detection_latency_under_churn(
+                n=n, seeds=[seed, seed + 1], victims=victims,
+                churn_max=churn_max, max_ticks=4096,
+                churn_seed=seed + 778, **kw,
+            )
+            contrast[label] = o["churn_ticks"]
     return {
         "metric": f"mc_churn_detection_n{n}_x{b}",
         "value": -1.0 if out["ticks_median"] is None else out["ticks_median"],
@@ -893,6 +1062,10 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
         "detected": out["detected"],
         # the dose-response curve: per-replica [background_churn, ticks]
         "churn_ticks": out["churn_ticks"],
+        "churn_cliff_at": cliff_at,
+        "cliff_jump_ticks": cliff_jump,
+        "k": 32,
+        "cliff_contrast": contrast,
     }
 
 
@@ -907,6 +1080,7 @@ BENCHES = {
     "forward_comparator": bench_forward_comparator,
     "forward_ab": bench_forward_ab,
     "mc_churn": bench_mc_churn,
+    "partition_lc": bench_partition_lifecycle,
     "sharded100k": bench_sharded100k,
     "delta16m": bench_delta16m,
 }

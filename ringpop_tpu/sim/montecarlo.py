@@ -258,6 +258,7 @@ def detection_latency_under_churn(
     churn_max: int,
     k: int = 32,
     suspect_ticks: Optional[int] = None,
+    max_p: Optional[int] = None,
     max_ticks: int = 2048,
     check_every: int = 1,
     churn_seed: int = 1234,
@@ -277,6 +278,10 @@ def detection_latency_under_churn(
     (``swim/stats.go:81-104``); the scenario itself (failure detection
     under load) is the product, ``swim/node.go:470-513``."""
     kw = {} if suspect_ticks is None else {"suspect_ticks": suspect_ticks}
+    if max_p is not None:
+        # study knob: the mc_churn cliff analysis varies maxP to show the
+        # saturated plateau tracks baseline + maxP (slot-expiry wait)
+        kw["max_p"] = max_p
     params = LifecycleParams(n=n, k=k, **kw)
     tick_s = params.tick_ms / 1000.0
     seeds = list(seeds)  # consumed twice below — a generator must not exhaust

@@ -84,6 +84,124 @@ def ensure_live_backend(timeouts_s: Sequence[float] = (90.0, 240.0)) -> dict:
     return info
 
 
+_XLA_TARGET_BITS: Optional[list] = None
+
+
+def _xla_detected_target_bits() -> list:
+    """XLA:CPU's OWN detected target-machine feature string, extracted by
+    compiling a tiny canary into a throwaway persistent-cache dir and
+    scanning the zstd-compressed AOT entry it writes.
+
+    Why not ``/proc/cpuinfo``: two containers can present identical cpuinfo
+    text while XLA's cpuid-based detection (which also bakes in per-model
+    tuning preferences like ``+prefer-no-gather``) differs — observed as
+    the round-4 driver artifacts' "Target machine feature ... doesn't
+    match the machine type" / "could lead to execution errors such as
+    SIGILL" loader warnings surviving a cpuinfo-keyed cache split.  The
+    string XLA embeds in the entry is exactly the string its loader later
+    compares against the current machine, so hashing it keys the cache by
+    the comparison that actually decides compatibility.
+
+    Returns a (possibly empty) list of fingerprint bits; memoized per
+    process (XLA detection is deterministic within one process).  On a
+    non-CPU backend returns a platform tag only — the AOT loader warning
+    class is XLA:CPU-specific."""
+    global _XLA_TARGET_BITS
+    if _XLA_TARGET_BITS is not None:
+        return _XLA_TARGET_BITS
+    import glob
+    import re
+    import shutil
+    import tempfile
+
+    import jax
+
+    bits: list = []
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - backendless environments
+        _XLA_TARGET_BITS = ["xla-fp-no-backend"]
+        return _XLA_TARGET_BITS
+    if platform != "cpu":
+        _XLA_TARGET_BITS = [f"xla-fp-accel:{platform}"]
+        return _XLA_TARGET_BITS
+    tmp = tempfile.mkdtemp(prefix="xla_target_probe_")
+    saved = {}
+    try:
+        for key in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        ):
+            saved[key] = getattr(jax.config, key)
+        # the compilation-cache singleton binds its directory at FIRST use:
+        # if anything in this process already compiled against a configured
+        # cache, the tmp-dir redirect below would be ignored and the canary
+        # entry would land in the real cache — reset so the canary binds tmp
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+        jax.config.update("jax_compilation_cache_dir", tmp)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        import jax.numpy as jnp
+
+        x = jnp.arange(64.0).reshape(8, 8)
+        jax.block_until_ready(jax.jit(lambda a: (a @ a).sum())(x))
+        feat = set()
+        for path in glob.glob(os.path.join(tmp, "*")):
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            blobs = [raw]
+            try:
+                import zstandard
+
+                blobs.append(zstandard.ZstdDecompressor().decompress(raw))
+            except Exception:
+                pass
+            try:
+                import zlib
+
+                # jax falls back to zlib entries when zstandard is absent
+                blobs.append(zlib.decompress(raw))
+            except Exception:
+                pass
+            for blob in blobs:
+                feat.update(
+                    re.findall(
+                        rb"[+\-][a-z0-9][a-z0-9.\-]*(?:,[+\-][a-z0-9][a-z0-9.\-]*){10,}",
+                        blob,
+                    )
+                )
+        if feat:
+            bits = ["xla-fp:" + b.decode("ascii", "replace") for b in sorted(feat)]
+        else:
+            bits = ["xla-fp-none"]
+    except Exception:  # pragma: no cover - never block cache setup on the probe
+        bits = ["xla-fp-error"]
+    finally:
+        for key, val in saved.items():
+            try:
+                jax.config.update(key, val)
+            except Exception:  # pragma: no cover
+                pass
+        # and reset again: the canary bound the singleton to the (deleted)
+        # probe dir — without this, every later write in this process would
+        # still target it and persistent caching would silently stop working
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover - private API moved
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    _XLA_TARGET_BITS = bits
+    return bits
+
+
 def compile_cache_dir(base: str, create: bool = True) -> str:
     """Return a per-platform-fingerprint subdirectory of ``base`` for the
     persistent XLA compilation cache.
@@ -93,7 +211,9 @@ def compile_cache_dir(base: str, create: bool = True) -> str:
     on a host missing those features "could lead to execution errors such
     as SIGILL" (LLVM's own warning, observed in the round-3 bench artifact
     when a shared ``.jax_cache`` crossed containers).  Keying the directory
-    by platform + device kind + jax version + the host CPU flag set makes a
+    by platform + device kind + jax version + XLA's own detected target
+    features (:func:`_xla_detected_target_bits` — the very string the AOT
+    loader compares at load time) + the host CPU flag set makes a
     mismatched entry unreachable instead of trusted.
 
     Requires jax to be importable; initializes the backend (callers set
@@ -102,12 +222,17 @@ def compile_cache_dir(base: str, create: bool = True) -> str:
 
     import jax
 
-    bits = ["cache-v1", jax.__version__]
+    bits = ["cache-v2", jax.__version__]
     try:
         dev = jax.devices()[0]
         bits += [dev.platform, str(getattr(dev, "device_kind", ""))]
     except Exception:  # pragma: no cover - backendless environments
         bits.append("no-backend")
+    # XLA's own detected target features — the exact string its AOT loader
+    # compares at entry-load time; see _xla_detected_target_bits.  The
+    # cpuinfo lines below stay as additional segmentation (they cost only
+    # extra cache dirs, never a false share).
+    bits += _xla_detected_target_bits()
     try:
         with open("/proc/cpuinfo") as f:
             seen = set()

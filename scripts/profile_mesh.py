@@ -1,0 +1,267 @@
+"""Multi-chip collective cost model for the sharded lifecycle engine
+(VERDICT r4 item 4): compile the sharded programs on the 8-virtual-device
+CPU mesh, dump optimized HLO, and count + size every cross-device
+collective — the evidence behind PERF.md's bytes-per-tick-per-chip table.
+
+Two programs are profiled:
+
+1. the one-tick 1M x 256 lifecycle step over the 4x2 ("node" x "rumor")
+   mesh — the per-tick ICI traffic of the headline config;
+2. the 100k sharded detect program (`_run_until_detected_device`) — to
+   answer whether `detection_complete`'s K-iteration slot walk
+   serializes under sharding (it holds a fori_loop whose body touches
+   [N]-sharded planes one rumor column at a time).
+
+Compile-only (`.lower(...).compile()`); nothing executes, so the run is
+CPU-compile-bound (~minutes for the 1M program).  Collectives are read
+from the after-optimizations HLO per computation, so while-loop bodies
+(executed once per tick / per walk iteration) are reported separately
+from one-shot entry computations.
+
+Usage:
+    python scripts/profile_mesh.py [--step-n N] [--detect-n N] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+    "reduce-scatter",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of every array in an HLO result type string (handles
+    tuples; layout annotations ignored)."""
+    total = 0
+    for dtype, dims in re.findall(r"(pred|[suf]\d+|bf16)\[([\d,]*)\]", shape_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def parse_collectives(hlo_path: str) -> dict:
+    """Per-computation collective census of one optimized HLO module.
+
+    Returns {computation_name: [{op, kind, bytes}...]} plus, for loop
+    attribution, each computation's while-loop depth: a collective inside
+    a while BODY executes once per iteration, so depth distinguishes the
+    one-shot entry collectives from the per-tick / per-walk-step ones."""
+    comps: dict = {}
+    bodies: dict = {}  # while-body computation -> owning computation
+    calls: dict = {}  # computation -> called computations (non-while)
+    cur = None
+    for line in open(hlo_path):
+        stripped = line.rstrip()
+        if stripped.endswith("{") and not line.lstrip().startswith("ROOT"):
+            cur = stripped.split()[0].lstrip("%")
+            comps.setdefault(cur, [])
+        elif cur is not None and line.strip() == "}":
+            cur = None
+        elif cur is not None:
+            m = re.search(
+                r"%([\w.\-]+) = (.+?) (" + "|".join(COLLECTIVES) + r")(?:-start)?\(",
+                line,
+            )
+            if m and "-done" not in line.split("=", 1)[1][:60]:
+                comps[cur].append(
+                    {
+                        "op": m.group(1),
+                        "kind": m.group(3),
+                        "bytes": _shape_bytes(m.group(2)),
+                    }
+                )
+            b = re.search(r"body=%([\w.\-]+)", line)
+            if b:
+                bodies[b.group(1)] = cur
+            for callee in re.findall(r"(?:calls|to_apply|condition)=%([\w.\-]+)", line):
+                calls.setdefault(callee, set()).add(cur)
+
+    def loop_depth(name: str, seen=()) -> int:
+        if name in seen:
+            return 0
+        best = 0
+        if name in bodies:
+            best = 1 + loop_depth(bodies[name], seen + (name,))
+        for owner in calls.get(name, ()):
+            best = max(best, loop_depth(owner, seen + (name,)))
+        return best
+
+    return {
+        "computations": {k: v for k, v in comps.items() if v},
+        "loop_depth": {k: loop_depth(k) for k, v in comps.items() if v},
+    }
+
+
+def _newest_module(dump: str, marker: str) -> str | None:
+    mods = [
+        p
+        for p in glob.glob(os.path.join(dump, "*after_optimizations.txt"))
+        if marker in os.path.basename(p) and "buffer" not in p and "memory" not in p
+    ]
+    return max(mods, key=os.path.getsize) if mods else None
+
+
+def _summarize(census: dict) -> dict:
+    by_kind: dict = {}
+    for rows in census["computations"].values():
+        for r in rows:
+            e = by_kind.setdefault(r["kind"], {"count": 0, "bytes": 0})
+            e["count"] += 1
+            e["bytes"] += r["bytes"]
+    return by_kind
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--step-n", type=int, default=1_000_000)
+    ap.add_argument("--step-k", type=int, default=256)
+    ap.add_argument("--detect-n", type=int, default=100_000)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    dump = tempfile.mkdtemp(prefix="meshhlo_")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count=8"
+        + f" --xla_dump_to={dump} --xla_dump_hlo_as_text"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        _run(args, dump)
+    finally:
+        shutil.rmtree(dump, ignore_errors=True)
+
+
+def _run(args, dump: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import functools
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("node", "rumor"))
+    report: dict = {"mesh": "4x2 (node x rumor), virtual CPU devices"}
+
+    # -- 1) one-tick step at headline scale --------------------------------
+    n, k = args.step_n, args.step_k
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10)
+    up = np.ones(n, bool)
+    up[:: max(n // 1000, 1)] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    state = jax.tree.map(
+        jax.device_put, lifecycle.init_state(params, seed=0),
+        lifecycle.state_shardings(mesh, k=k),
+    )
+    blk = jax.jit(functools.partial(lifecycle._run_block, params),
+                  static_argnames="ticks")
+    t0 = time.perf_counter()
+    blk.lower(state, faults, ticks=1).compile()
+    step_compile_s = time.perf_counter() - t0
+    mod = _newest_module(dump, "_run_block")
+    if mod is None:
+        mod = _newest_module(dump, "")
+    census = parse_collectives(mod) if mod else {"computations": {}, "loop_depth": {}}
+    report["step"] = {
+        "n": n, "k": k, "compile_s": round(step_compile_s, 1),
+        "module": os.path.basename(mod) if mod else None,
+        "by_kind": _summarize(census),
+        "by_computation": {
+            c: {
+                "count": len(rows),
+                "bytes": sum(r["bytes"] for r in rows),
+                "loop_depth": census["loop_depth"].get(c, 0),
+            }
+            for c, rows in census["computations"].items()
+        },
+    }
+
+    # -- 2) the sharded detect program (serialization question) ------------
+    for f in glob.glob(os.path.join(dump, "*")):
+        shutil.rmtree(f) if os.path.isdir(f) else os.remove(f)
+    nd = args.detect_n
+    dparams = lifecycle.LifecycleParams(n=nd, k=256, suspect_ticks=10)
+    dup = np.ones(nd, bool)
+    dup[:: max(nd // 100, 1)] = False
+    dfaults = DeltaFaults(up=jnp.asarray(dup))
+    dstate = jax.tree.map(
+        jax.device_put, lifecycle.init_state(dparams, seed=0),
+        lifecycle.state_shardings(mesh, k=256),
+    )
+    subjects = jnp.asarray(np.flatnonzero(~dup), jnp.int32)
+    t0 = time.perf_counter()
+    lifecycle._run_until_detected_device.lower(
+        dparams, dstate, dfaults, subjects,
+        min_status=lifecycle.FAULTY, block_ticks=32, max_blocks=jnp.int32(16),
+    ).compile()
+    detect_compile_s = time.perf_counter() - t0
+    mod = _newest_module(dump, "")
+    census = parse_collectives(mod) if mod else {"computations": {}, "loop_depth": {}}
+    report["detect"] = {
+        "n": nd, "k": 256, "compile_s": round(detect_compile_s, 1),
+        "module": os.path.basename(mod) if mod else None,
+        "by_kind": _summarize(census),
+        "by_computation": {
+            c: {
+                "count": len(rows),
+                "bytes": sum(r["bytes"] for r in rows),
+                "loop_depth": census["loop_depth"].get(c, 0),
+            }
+            for c, rows in census["computations"].items()
+        },
+    }
+
+    for name in ("step", "detect"):
+        sec = report[name]
+        print(f"\n== {name} (n={sec['n']}, k={sec['k']}, "
+              f"compile {sec['compile_s']}s) ==")
+        print(f"{'kind':>22} {'count':>6} {'MB total':>10}")
+        for kind, e in sorted(sec["by_kind"].items()):
+            print(f"{kind:>22} {e['count']:>6} {e['bytes'] / 1e6:>10.2f}")
+        print("  per computation (collective-bearing only; depth = enclosing "
+              "while-loop nesting):")
+        for c, e in sorted(sec["by_computation"].items(),
+                           key=lambda kv: -kv[1]["bytes"])[:12]:
+            print(f"    d{e['loop_depth']} {c[:54]:54s} {e['count']:>4}  "
+                  f"{e['bytes'] / 1e6:>8.2f} MB")
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"\nwrote {args.out}")
+    print(json.dumps({"profile_mesh": {k2: report[k2]["by_kind"]
+                                       for k2 in ("step", "detect")}}))
+
+
+if __name__ == "__main__":
+    main()

@@ -187,3 +187,9 @@ def test_bench_fast_artifact_schema():
     assert out["ring_lookup_qps"] > 0
     assert out["platform"] == "cpu"
     assert "probe" in out and "tpu_watcher_capture" in out
+    # the driver's artifact tail is this process's stderr: the XLA:CPU AOT
+    # loader's target-feature mismatch warning must never reach it — the
+    # cache dir is keyed by XLA's own detected features and the parent
+    # purges + reruns if the warning fires anyway (VERDICT r4 item 3)
+    assert "doesn't match the machine type" not in r.stderr
+    assert "could lead to execution errors such as SIGILL" not in r.stderr
