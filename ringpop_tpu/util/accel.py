@@ -286,6 +286,16 @@ def configure_compile_cache(base: Optional[str] = None) -> Optional[str]:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # the cache singleton binds its directory at FIRST use: if this
+        # process already compiled against an earlier dir (e.g. a second
+        # configure call with a different base), the update above would be
+        # silently ignored without a reset — rebinds lazily on next compile
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover - private API moved
+            pass
         return path
     except Exception:
         return None

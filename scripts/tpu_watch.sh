@@ -48,6 +48,9 @@ from ringpop_tpu.util.accel import probe_accelerator
 p = probe_accelerator(timeouts_s=(75,))
 print('yes' if p['alive'] and p.get('platform') not in ('cpu', None) else 'no')
 " 2>/dev/null | tail -1)
+  # one line per probe: the committed log must be auditable evidence of
+  # "N probes over M hours, zero windows", not silence (VERDICT r4 item 1)
+  echo "[$(ts)] probe $i/$ATTEMPTS: ${alive:-no}"
   if [ "${alive:-no}" = "yes" ]; then
     echo "[$(ts)] tunnel alive at attempt $i; running bench.py"
     BENCH_PROBE_TIMEOUTS_S=75 timeout "$BENCH_TIMEOUT" python bench.py 9>&- \

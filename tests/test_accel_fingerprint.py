@@ -1,0 +1,72 @@
+"""The XLA-detection cache fingerprint (VERDICT r4 item 3).
+
+The persistent-cache dir is keyed by the target-machine feature string
+XLA embeds in its own AOT entries — the exact string its loader compares
+at entry-load time — so environments whose XLA detection differs can
+never share entries (the round-3/4 "doesn't match the machine type /
+SIGILL" warnings survived two rounds of /proc/cpuinfo-based keying).
+
+Also regression-covers the probe's nastiest side effect: jax's
+compilation-cache singleton binds its directory at FIRST use, so the
+canary compile must reset it or every later cache write in the process
+silently targets the deleted probe dir (observed as 'Error writing
+persistent compilation cache entry ... xla_target_probe_*' warnings).
+"""
+
+from __future__ import annotations
+
+import glob
+
+from ringpop_tpu.util import accel
+
+
+def test_probe_extracts_xla_feature_string():
+    bits = accel._xla_detected_target_bits()
+    assert bits, "probe returned no fingerprint bits"
+    # on the CPU backend the canary must surface the canonical feature
+    # string (dozens of comma-separated +/-flags) — a fallback marker
+    # ("xla-fp-none"/"xla-fp-error") means the probe is broken here
+    assert bits[0].startswith("xla-fp:"), bits[0]
+    assert bits[0].count(",") > 10, "feature string suspiciously short"
+    # memoized per process: detection is deterministic, probe runs once
+    assert accel._xla_detected_target_bits() is bits
+
+
+def test_fingerprint_dir_stable_and_versioned(tmp_path):
+    d1 = accel.compile_cache_dir(str(tmp_path), create=False)
+    d2 = accel.compile_cache_dir(str(tmp_path), create=False)
+    assert d1 == d2, "fingerprint must be deterministic within one process"
+
+
+def test_cache_write_lands_in_configured_dir_after_probe(tmp_path):
+    """The probe's canary compile must not leave the cache singleton bound
+    to the (deleted) probe dir: a post-probe compile that crosses the 1 s
+    write threshold must land its entry in the *configured* directory."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    d = accel.configure_compile_cache(str(tmp_path))
+    assert d and d.startswith(str(tmp_path))
+    # remove the 1 s write-threshold timing dependence: the assertion is
+    # about WHERE the entry lands, not how slow the compile was
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    # a genuinely slow-to-compile program (the real engine step at a tiny
+    # scale compiles in seconds; toy matmul stacks dedup below the 1 s
+    # write threshold and prove nothing)
+    params = lifecycle.LifecycleParams(n=1500, k=32)
+    state = lifecycle.init_state(params, seed=3)
+    up = np.ones(1500, bool)
+    up[7] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    step = jax.jit(lambda s: lifecycle.step(params, s, faults))
+    jax.block_until_ready(step(state).learned)
+
+    assert glob.glob(d + "/*"), (
+        "no cache entry in the configured dir — the compilation-cache "
+        "singleton is still bound elsewhere (probe reset regression)"
+    )
