@@ -5,15 +5,29 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean profile-mesh
 
 all: native test
 
 # full unit+functional suite (CPU, virtual 8-device mesh via tests/conftest.py;
 # XLA compiles hit the persistent .jax_cache — cold first run pays compile
 # once, warm runs are compile-free.  --durations prints the tier timings.)
-test:
+# profile-mesh runs first so CI exercises the sharded compile + collective
+# budget ratchet without the slow 1M program; tests/test_mesh_budget.py
+# re-asserts the while-body budgets from inside pytest.
+test: profile-mesh
 	$(PY) -m pytest tests/ -q --durations=15
+
+# compile the sharded programs at CI scale (8k, hierarchical select forced
+# on) and diff the collective census against the committed budget capture —
+# non-zero exit if any collective class regressed beyond tolerance.
+# Re-baseline (after an INTENDED budget change, with PERF.md updated):
+#   $(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
+#     --force-sparse --out captures/mesh_profile_small_budget.json
+profile-mesh:
+	$(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
+	  --force-sparse --compare captures/mesh_profile_small_budget.json \
+	  --out /tmp/mesh_profile_small.json
 
 # skip the scale spot-checks
 test-fast:

@@ -331,18 +331,24 @@ dref2, _, _ = lifecycle._run_until_detected_device(
 jax.block_until_ready(dref2.learned)
 detect_unsharded_exec_s = time.perf_counter() - t0
 
+# the sharded detect passes the rumor-axis replication hint so each
+# check's slot walk pays ONE learned-plane gather instead of collectives
+# every fori iteration (r6 tentpole); the hint is a layout constraint
+# only — the bit-equality assertion below is what certifies that
+from jax.sharding import NamedSharding, PartitionSpec as P
+sh_detect_kw = dict(detect_kw, learned_sharding=NamedSharding(mesh, P("node", None)))
 t0 = time.perf_counter()
 dsh, sh_blocks, sh_done = lifecycle._run_until_detected_device(
     params,
     jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed), shardings),
-    faults, subjects, **detect_kw)
+    faults, subjects, **sh_detect_kw)
 jax.block_until_ready(dsh.learned)
 detect_sharded_s = time.perf_counter() - t0
 t0 = time.perf_counter()
 dsh2, _, _ = lifecycle._run_until_detected_device(
     params,
     jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed), shardings),
-    faults, subjects, **detect_kw)
+    faults, subjects, **sh_detect_kw)
 jax.block_until_ready(dsh2.learned)
 detect_sharded_exec_s = time.perf_counter() - t0
 

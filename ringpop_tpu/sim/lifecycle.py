@@ -74,12 +74,14 @@ from ringpop_tpu.sim.delta import (
 from ringpop_tpu.sim.packbits import (
     and_reduce_rows,
     bit_column,
+    block_count,
     check_rumor_shardable,
     n_words,
     or_reduce_rows,
     pack_bool,
     row_mask,
     set_bit,
+    set_bit_per_row,
     unpack_bits,
 )
 from ringpop_tpu.swim.member import (
@@ -208,9 +210,11 @@ def _bel_rumor_dense(learned_b, r_subject, rkey, active, targets):
     )
 
 
-# candidate-compression capacity for _top_m_sparse, and the minimum n at
-# which the sparse path engages at all.  Module-level so tests can
-# monkeypatch them down to force both the compressed path and the overflow
+# candidate-compression capacity for _top_m_sparse (per node-block since
+# the hierarchical rewrite), the minimum n at which the sparse path
+# engages at all, and the node-block count of the hierarchical select.
+# Module-level so tests can monkeypatch them down to force the
+# shard-local select, the cross-block merge tie-break, and the overflow
 # fallback at small n.  MIN_N matters because ``lax.cond`` under vmap
 # (the Monte-Carlo engine vmaps step over a replica axis) lowers to a
 # select that executes BOTH branches — the sparse path there would pay
@@ -220,57 +224,134 @@ def _bel_rumor_dense(learned_b, r_subject, rkey, active, targets):
 # shapes that actually suffer the sort get the sparse win.
 _SPARSE_TOPK_CAP = 4096
 _SPARSE_TOPK_MIN_N = 65536
+# node-axis block count: candidates are selected per contiguous block of
+# n/B subjects, then merged.  A multiple of every plausible node-shard
+# count, so each mesh shard owns whole blocks and the per-block cumsum /
+# compress / top_k stay shard-LOCAL under the SPMD partitioner — the
+# global-index formulation this replaces forced ~25 all-gathers (90
+# MB/chip) per sharded 1M tick (PERF.md "Multi-chip collective cost
+# model").  Falls back to the largest power of two that divides n
+# (packbits.block_count — the shared rule of every blocked-for-SPMD path).
+_TOPK_BLOCKS = 16
+# same rule for the two-level row gathers (_gather_rows) — a separate
+# knob so tuning the candidate-select fan-out for a bigger mesh doesn't
+# silently change the gather paths' traffic shape
+_GATHER_BLOCKS = 16
 
 
 def _top_m_sparse(cand: jax.Array, m: int):
-    """Exact ``lax.top_k(cand, m)`` for a sparse candidate vector.
+    """Exact ``lax.top_k(cand, m)`` for a sparse candidate vector —
+    hierarchical: per-node-block compress + select, then a tiny merge.
 
     ``top_k`` over [N] lowers to a full stable SORT — measured 446 ms of
     the 1M-node tick on XLA:CPU, ~20% of the whole step — but at most
     ~(victims + K + refuters) entries of ``cand`` are ever >= 0 (every
-    other subject carries the -1 sentinel).  So: prefix-sum the candidate
-    mask, scatter the candidates (in index order) into a fixed [C] buffer,
-    and top_k THAT.  Value-identity with the full top_k, including scatter
-    side effects downstream:
+    other subject carries the -1 sentinel).  So: split the subject axis
+    into B contiguous blocks, prefix-sum each block's candidate mask
+    LOCALLY, scatter its candidates (in index order) into a per-block
+    [C] buffer (a vmapped scatter — the batched form the SPMD
+    partitioner keeps shard-local, unlike the global-index scatter it
+    could only all-gather), top_k each buffer, and merge the B×m
+    (value, subject) pairs with one final top_k over B·m elements.  The
+    only cross-shard traffic is that B×m-pair merge — versus the
+    [N]-sized cumsum/scatter/sort globals of the flat form.
 
-    * real candidates keep their original index order, and top_k is a
-      stable sort, so equal keys resolve identically at the m boundary;
+    Value-identity with the full ``lax.top_k(cand, m)``, including
+    scatter side effects downstream:
+
+    * within a block, candidates keep their original index order through
+      the compress, and top_k is a stable sort, so a block's survivors
+      are its lowest-indexed among equal keys;
+    * across blocks, the merge buffer concatenates blocks in ascending
+      block order, each internally (value desc, index asc) — so the
+      final stable top_k resolves equal keys at the m boundary by
+      (block asc, local index asc) = ascending global index, exactly the
+      dense sort's tie order;
+    * hierarchical exactness: any global top-m element is in its own
+      block's top-m under the same (value desc, index asc) order, so
+      per-block truncation to m cannot drop a winner — including tie
+      groups straddling the boundary;
     * padding entries carry (value -1, subject n): every downstream
       scatter of a -1-valued entry either writes the buffer's default or
       is masked by ``place`` — and subject n is out of range, so the
       write is DROPPED (jax .at[] update semantics), matching the
       original's harmless in-range no-op writes without introducing
       duplicate subjects;
-    * if more than C candidates exist (impossible at the headline config;
-      possible in stretch scenarios like 16M nodes x 16k victims), a
-      ``lax.cond`` falls back to the full sort — bit-for-bit the original
-      path, just at the original speed.
+    * if any block holds more than C candidates (impossible at the
+      headline config; possible in stretch scenarios like 16M nodes x
+      16k victims in one block), a ``lax.cond`` falls back to the full
+      sort — bit-for-bit the original path, just at the original speed.
 
     Certified against the dense form by tests/test_lifecycle.py
-    (monkeypatched caps force both branches) and the frozen goldens.
+    (monkeypatched caps force every branch, sharded and not) and the
+    frozen goldens.
     """
     n = cand.shape[0]
     cap = _SPARSE_TOPK_CAP
     if n <= max(cap, _SPARSE_TOPK_MIN_N) or m > cap:
         return jax.lax.top_k(cand, m)
-    is_c = cand >= 0
-    pos = jnp.cumsum(is_c.astype(jnp.int32)) - 1
-    n_c = pos[-1] + 1
+    b = block_count(n, _TOPK_BLOCKS)
+    nb = n // b
+    cap = min(cap, nb)
+    sel = min(m, cap)  # a block with <= cap candidates has <= cap to offer
+    cand2 = cand.reshape(b, nb)
+    is_c = cand2 >= 0
+    pos = jnp.cumsum(is_c.astype(jnp.int32), axis=1) - 1
+    n_c = pos[:, -1] + 1  # per-block candidate count
 
-    def compressed(_):
+    def hierarchical(_):
         wr = jnp.where(is_c, pos, cap)  # cap = out of range -> dropped
-        buf = jnp.full((cap,), -1, jnp.int32).at[wr].set(cand, mode="drop")
-        src = jnp.full((cap,), n, jnp.int32).at[wr].set(
-            jnp.arange(n, dtype=jnp.int32), mode="drop"
-        )
-        v, i = jax.lax.top_k(buf, m)
-        return v, src[i]
+        gidx = jnp.arange(n, dtype=jnp.int32).reshape(b, nb)
+
+        def compress_row(c_row, w_row, g_row):
+            buf = jnp.full((cap,), -1, jnp.int32).at[w_row].set(c_row, mode="drop")
+            src = jnp.full((cap,), n, jnp.int32).at[w_row].set(g_row, mode="drop")
+            return buf, src
+
+        buf, src = jax.vmap(compress_row)(cand2, wr, gidx)
+        lv, li = jax.lax.top_k(buf, sel)
+        ls = jnp.take_along_axis(src, jnp.asarray(li), axis=1)
+        lv = jnp.asarray(lv)
+        if sel < m:  # cap < m: pad each block's offer out to m
+            pad_v = jnp.full((b, m - sel), -1, jnp.int32)
+            pad_s = jnp.full((b, m - sel), n, jnp.int32)
+            lv = jnp.concatenate([lv, pad_v], axis=1)
+            ls = jnp.concatenate([ls, pad_s], axis=1)
+        v, i = jax.lax.top_k(lv.reshape(-1), m)
+        return jnp.asarray(v), ls.reshape(-1)[jnp.asarray(i)]
 
     def full(_):
         v, i = jax.lax.top_k(cand, m)
         return v, i
 
-    return jax.lax.cond(n_c <= cap, compressed, full, None)
+    return jax.lax.cond((n_c <= cap).all(), hierarchical, full, None)
+
+
+def _gather_rows(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """``plane[idx]`` (row gather at traced indices) as a two-level block
+    pick: take within each of B contiguous node blocks along the
+    UNsharded in-block axis (local on every shard), then pick each row's
+    owning block from the [B, ...] block stack (B × rows × cols of
+    cross-shard traffic, independent of N).  A direct gather at traced
+    row indices makes the SPMD partitioner all-gather the whole operand —
+    the heal pair-swap's 2-row reads alone cost a full packed-plane
+    gather (~16 MB/chip/tick at 1M) that way.  Identical values: row
+    ``i`` IS block ``i // nb`` offset ``i % nb``.  On one core the extra
+    work is B rows read instead of 1 — noise.  Callers must pass in-range
+    indices (scalar or [S]); B falls back to the largest power of two
+    dividing n."""
+    n = plane.shape[0]
+    g = block_count(n, _GATHER_BLOCKS)
+    if g == 1 or n <= g:
+        return plane[idx]
+    nb = n // g
+    blocks = plane.reshape((g, nb) + plane.shape[1:])
+    within = jnp.take(blocks, idx % nb, axis=1)  # [g, *idx.shape, cols...]
+    if jnp.ndim(idx) == 0:
+        return jnp.take(within, idx // nb, axis=0)
+    pick = (idx // nb).reshape((1,) + idx.shape + (1,) * (plane.ndim - 1))
+    pick = jnp.broadcast_to(pick, (1,) + within.shape[1:])
+    return jnp.take_along_axis(within, pick, axis=0)[0]
 
 
 def step(
@@ -329,7 +410,7 @@ def step(
         # reduce collapses to K bit-gathers + one scatter-max (identical
         # values; the dense form is O(N·K))
         prober = jnp.mod(state.r_subject - shift, n)
-        pbit = bit_column(state.learned[jnp.clip(prober, 0, n - 1)], jnp.arange(k))
+        pbit = bit_column(_gather_rows(state.learned, jnp.clip(prober, 0, n - 1)), jnp.arange(k))
         bel_vals = jnp.where(active & pbit, rkey, jnp.int32(-1))
         bel_rumor = jnp.full((n,), -1, jnp.int32).at[
             jnp.where(active, prober, jnp.int32(n))
@@ -403,7 +484,12 @@ def step(
             & up[p]
             & _pair_connected(faults, h[None], p[None])[0]
         )
-        merged_row = (learned2_w[h] | learned2_w[p]) & active_w  # [W]
+        # row reads via the two-level block pick (_gather_rows): a direct
+        # plane[h] at a traced index is a gather the SPMD partitioner can
+        # only serve by all-gathering the whole packed plane
+        heal_rows2 = jnp.stack([h, p])  # int32[2]
+        rows_hp = _gather_rows(learned2_w, heal_rows2)  # [2, W]
+        merged_row = (rows_hp[0] | rows_hp[1]) & active_w  # [W]
         # apply the pair swap as a 2-row SCATTER, not dynamic_update_slices
         # or a plane-wide select: a DUS whose operand is a fused producer
         # makes XLA:CPU emit a full-plane copy fusion whose body RE-DERIVES
@@ -414,9 +500,8 @@ def step(
         # A scatter is not elementwise, so XLA wraps it instead of fusing:
         # the producer materializes once with a thin body and the 2-row
         # update is O(2·K), in-place when the input buffer is dead.
-        heal_rows2 = jnp.stack([h, p])  # int32[2]
         learned2h_w = learned2_w.at[heal_rows2].set(
-            jnp.where(attempt, merged_row[None, :], learned2_w[heal_rows2])
+            jnp.where(attempt, merged_row[None, :], rows_hp)
         )
         merged_bits = unpack_bits(merged_row, k)  # [K]
     else:
@@ -449,7 +534,9 @@ def step(
         # write zero
         pcount_a = pcount_a.at[heal_rows2].set(
             jnp.where(
-                attempt & merged_bits[None, :], jnp.int8(0), pcount_a[heal_rows2]
+                attempt & merged_bits[None, :],
+                jnp.int8(0),
+                _gather_rows(pcount_a, heal_rows2),
             )
         )
 
@@ -690,7 +777,10 @@ def step(
     )
     decl_slot = subj_to_slot[targets]
     decl_ok = declare & (decl_slot >= 0)
-    learned6_w = set_bit(learned5_w, i_all, jnp.clip(decl_slot, 0, k - 1), decl_ok)
+    # every-row seeding (rows == iota): the elementwise one-hot form — a
+    # scatter here made the partitioner all-gather [N]-sized index/update
+    # tensors (see packbits.set_bit_per_row)
+    learned6_w = set_bit_per_row(learned5_w, jnp.clip(decl_slot, 0, k - 1), decl_ok)
 
     # -- pcount pass B: the deferred stuck/freed/placed clears (one fused
     # read/write; all resets-to-zero commute with pass A's) ----------------
@@ -937,6 +1027,8 @@ def detection_complete(
     subjects,
     faults: DeltaFaults = DeltaFaults(),
     min_status: int = FAULTY,
+    *,
+    learned_sharding=None,
 ) -> jax.Array:
     """bool scalar, fully ON-DEVICE: does every live observer believe every
     subject has reached ``min_status`` (or see it evicted)?
@@ -954,6 +1046,13 @@ def detection_complete(
     the jitted loop: round-1 profiling showed the 1M-node TPU bench spending
     ~90% of wall-clock in the HOST-side per-subject detection walk between
     device blocks (~2k tunnel dispatches per check at S=1000).
+
+    ``learned_sharding`` (optional, a ``NamedSharding`` like
+    ``P("node", None)`` over the run's mesh): pre-replicate the packed
+    ``learned`` plane across the rumor axis before the K-iteration slot
+    walk — one all-gather per check instead of ~6 collectives per walk
+    iteration (see :func:`_walk_subject_slots`).  Purely a layout hint;
+    values are bit-identical with or without it.
     """
     n, _ = state.learned.shape
     subjects = jnp.asarray(subjects, jnp.int32)
@@ -974,7 +1073,10 @@ def detection_complete(
             jnp.where(fin, bad_any, False), mode="drop"
         )
 
-    anybad = _walk_subject_slots(state, base_key, jnp.zeros(n, bool), finalize)
+    anybad = _walk_subject_slots(
+        state, base_key, jnp.zeros(n, bool), finalize,
+        learned_sharding=learned_sharding,
+    )
     not_detected = jnp.where(
         _slot_covered(state), anybad, base_bad
     )[subjects]
@@ -990,7 +1092,8 @@ def _slot_covered(state: LifecycleState) -> jax.Array:
     ].set(True, mode="drop")
 
 
-def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
+def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize,
+                        learned_sharding=None):
     """The shared O(N·K) per-subject slot walk under ``detection_complete``
     and ``view_checksums``: iterate the K rumor slots sorted by (subject
     asc, key desc) — free slots pushed past the end; the lexsort is
@@ -1000,9 +1103,22 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
     governing key for clamped subject id ``s`` and ``fin`` marks the
     subject's last slot (callbacks must gate their update on ``fin``).
     Returns the final carry.  Subjects with no in-flight slot never reach
-    ``finalize`` — callers handle them via :func:`_slot_covered`."""
+    ``finalize`` — callers handle them via :func:`_slot_covered`.
+
+    ``learned_sharding`` (a ``NamedSharding`` replicating the packed
+    plane's rumor/word axis, e.g. ``P("node", None)``): under a device
+    mesh, the loop body's per-iteration ``bit_column`` gather at a traced
+    word index cannot stay shard-local along a sharded rumor axis — the
+    partitioner emitted ~6 collectives PER ITERATION (~1,536 sequential
+    tiny collectives per check at K=256; PERF.md "Why the sharded detect
+    path is slow").  The constraint pre-replicates ``learned`` across the
+    rumor shards ONCE (an all-gather of packed-plane-bytes ÷ rumor-shards)
+    and pins the [K] walk metadata + ``base_key`` replicated, so every
+    iteration's gathers are local and only ``finalize``'s scalar reduce
+    crosses shards.  Pure layout hint — bit-identical values either way."""
     n = state.learned.shape[0]
     k = state.r_subject.shape[0]
+    learned = state.learned
     active = state.r_subject >= 0
     rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
     subj_or_sentinel = jnp.where(active, state.r_subject, jnp.int32(n))
@@ -1012,6 +1128,15 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
     is_last = sorted_subj != jnp.concatenate(
         [sorted_subj[1:], jnp.full((1,), n + 1, jnp.int32)]
     )
+    if learned_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        learned = jax.lax.with_sharding_constraint(learned, learned_sharding)
+        rep = NamedSharding(learned_sharding.mesh, PartitionSpec())
+        order, sorted_subj, sorted_key, is_last, base_key = (
+            jax.lax.with_sharding_constraint(x, rep)
+            for x in (order, sorted_subj, sorted_key, is_last, base_key)
+        )
 
     def body(j, c):
         best, carry = c
@@ -1019,7 +1144,7 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize):
         valid = s < n
         # slot order[j]'s learned column, extracted from the packed plane
         # (the pre-pack code materialized a [K, N] transpose here)
-        lcol = bit_column(state.learned, order[j])
+        lcol = bit_column(learned, order[j])
         best = jnp.where(lcol & valid, jnp.maximum(best, sorted_key[j]), best)
         m = jnp.maximum(best, base_key[jnp.minimum(s, n - 1)])
         fin = is_last[j] & valid
@@ -1145,7 +1270,8 @@ def _run_until_converged_device(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("params", "min_status", "block_ticks")
+    jax.jit,
+    static_argnames=("params", "min_status", "block_ticks", "learned_sharding"),
 )
 def _run_until_detected_device(
     params: LifecycleParams,
@@ -1156,15 +1282,24 @@ def _run_until_detected_device(
     min_status: int,
     block_ticks: int,
     max_blocks: jax.Array,
+    learned_sharding=None,
 ):
     """Up to ``max_blocks`` blocks of ``block_ticks`` ticks with the
     detection test INSIDE the jitted loop — one dispatch, one readback.
     Returns (state, blocks_run, detected); 0 blocks when the subjects are
     already detected on entry.  ``max_blocks`` is traced (not static) so
-    varying final-chunk sizes reuse one compilation."""
+    varying final-chunk sizes reuse one compilation.  ``learned_sharding``
+    (static; hashable ``NamedSharding``) is the mesh hint forwarded to
+    :func:`detection_complete` so the per-check slot walk replicates the
+    packed ``learned`` plane across the rumor shards once instead of
+    paying ~6 collectives per walk iteration — sharded callers pass
+    ``NamedSharding(mesh, P("node", None))``; values are identical with
+    or without it."""
 
     def detected(s):
-        return detection_complete(s, subjects, faults, min_status)
+        return detection_complete(
+            s, subjects, faults, min_status, learned_sharding=learned_sharding
+        )
 
     return until_loop(
         lambda s: _run_block(params, s, faults, block_ticks), state, max_blocks, detected
@@ -1275,12 +1410,16 @@ class LifecycleSim:
         check_every: int = 8,
         time_budget_s: Optional[float] = None,
         blocks_per_dispatch: int = 4,
+        learned_sharding=None,
     ):
         """Tick until every live observer believes every subject has reached
         ``min_status``.  Returns (ticks_used, detected).  The loop AND its
         detection test run on-device (``_run_until_detected_device``) so
         the host reads back one (blocks, done) pair per dispatch instead
-        of walking rumor slots over the interconnect.  Loop/budget
+        of walking rumor slots over the interconnect.  Sharded runs pass
+        ``learned_sharding=NamedSharding(mesh, P("node", None))`` so the
+        per-check walk replicates the learned plane across the rumor
+        shards once per check (bit-identical either way).  Loop/budget
         semantics: :meth:`_run_until`."""
         subjects = jnp.asarray(list(subjects), jnp.int32)
 
@@ -1293,6 +1432,7 @@ class LifecycleSim:
                 min_status=min_status,
                 block_ticks=check_every,
                 max_blocks=jnp.int32(max_blocks),
+                learned_sharding=learned_sharding,
             )
             return blocks, done
 

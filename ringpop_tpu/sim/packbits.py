@@ -79,23 +79,66 @@ def row_mask(rows: jax.Array) -> jax.Array:
     return jnp.where(rows, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[..., None]
 
 
-def _tree_reduce_rows(p: jax.Array, op, identity: int) -> jax.Array:
-    """Unrolled halving tree over the node axis — ``lax.reduce`` with a
+# node-axis block count for the row reduces: reduce WITHIN each of G
+# contiguous blocks first (slices along the unpartitioned in-block axis —
+# shard-local under SPMD), then combine the G block results (G×W words of
+# cross-shard traffic).  The flat halving tree this replaces sliced the
+# NODE axis in half every step, which the partitioner could only lower to
+# ~log2(N) collective-permutes of half-plane slices — measured as the
+# single largest collective class of the sharded 1M lifecycle tick
+# (~197 permutes, ~37% of all cross-chip bytes; PERF.md r6).  Bitwise
+# OR/AND are exact under reassociation, so the result is bit-identical.
+# A multiple of every plausible node-shard count; must divide n (falls
+# back to the largest power of two that does).
+_REDUCE_BLOCKS = 16
+
+
+def block_count(n: int, b: int) -> int:
+    """Largest power of two <= ``b`` that divides ``n`` — the shared
+    node-block fallback rule of every blocked-for-SPMD path (the row
+    reduces here, lifecycle's hierarchical top-M and row gathers)."""
+    while b > 1 and n % b:
+        b //= 2
+    return b
+
+
+def _halving_tree(p: jax.Array, op, identity: int, axis: int) -> jax.Array:
+    """Unrolled halving tree along ``axis`` — ``lax.reduce`` with a
     bitwise combiner would be one op, but XLA's SPMD partitioner rejects
     custom reduction computations ("Unsupported reduction computation"),
-    and the sharded step must run on device meshes.  log2(N) elementwise
-    combines touch ~2N words total — same traffic class as the reduce."""
-    n = p.shape[0]
+    and the sharded step must run on device meshes.  log2(n) elementwise
+    combines touch ~2n words total — same traffic class as the reduce."""
+    n = p.shape[axis]
     pow2 = 1 << max(n - 1, 1).bit_length()
     if pow2 == 2 * n:
         pow2 = n  # n was already a power of two
     if pow2 != n:
-        pad = jnp.full((pow2 - n,) + p.shape[1:], jnp.uint32(identity))
-        p = jnp.concatenate([p, pad], axis=0)
+        shape = list(p.shape)
+        shape[axis] = pow2 - n
+        pad = jnp.full(shape, jnp.uint32(identity))
+        p = jnp.concatenate([p, pad], axis=axis)
+    ix = [slice(None)] * p.ndim
     while pow2 > 1:
         pow2 //= 2
-        p = op(p[:pow2], p[pow2:])
-    return p[0]
+        lo, hi = list(ix), list(ix)
+        lo[axis] = slice(0, pow2)
+        hi[axis] = slice(pow2, 2 * pow2)
+        p = op(p[tuple(lo)], p[tuple(hi)])
+    return jnp.squeeze(p, axis=axis)
+
+
+def _tree_reduce_rows(p: jax.Array, op, identity: int) -> jax.Array:
+    """Bitwise reduce over the node axis: blocked halving tree (see
+    ``_REDUCE_BLOCKS``) — in-block combines are shard-local, only the
+    [G, W] block results cross shards.  Identical bits to the flat tree
+    (bitwise ops reassociate exactly); identical word count on one core."""
+    n = p.shape[0]
+    g = block_count(n, _REDUCE_BLOCKS)
+    if g > 1 and n > g:
+        p = _halving_tree(
+            p.reshape((g, n // g) + p.shape[1:]), op, identity, axis=1
+        )
+    return _halving_tree(p, op, identity, axis=0)
 
 
 def or_reduce_rows(p: jax.Array) -> jax.Array:
@@ -136,6 +179,25 @@ def set_bit(p: jax.Array, rows: jax.Array, slots: jax.Array, on: jax.Array) -> j
     vals = jnp.where(on, jnp.uint32(1) << (slots & 31).astype(jnp.uint32), jnp.uint32(0))
     upd = jnp.zeros((n, w), jnp.uint32).at[rows, slots >> 5].add(vals, mode="drop")
     return p | upd
+
+
+def set_bit_per_row(p: jax.Array, slots: jax.Array, on: jax.Array) -> jax.Array:
+    """Row ``i`` ORs in bit ``slots[i]`` where ``on[i]`` — the
+    ``rows == arange(n)`` special case of :func:`set_bit`, written as a
+    pure elementwise one-hot against the word index instead of a scatter.
+    A scatter whose row coordinates are an iota still made the SPMD
+    partitioner all-gather its [N, 2] index and [N] update tensors
+    (~12 MB/chip/tick at 1M); the compare-and-OR form is elementwise over
+    the [N, W] plane, so it partitions (and fuses) trivially.  W is a
+    handful of words, so the extra N·W compares are noise on one core.
+    Out-of-range slots: callers clamp (identical to the engine's previous
+    ``set_bit(..., i_all, clip(slots), on)`` contract — the clamped write
+    lands in a real word but is masked by ``on``)."""
+    w = p.shape[1]
+    slots = jnp.asarray(slots, jnp.int32)
+    hit = (slots[:, None] >> 5) == jnp.arange(w, dtype=jnp.int32)[None, :]
+    bit = (jnp.uint32(1) << (slots & 31).astype(jnp.uint32))[:, None]
+    return p | jnp.where(hit & on[:, None], bit, jnp.uint32(0))
 
 
 def check_rumor_shardable(k: int, rumor_shards: int) -> None:

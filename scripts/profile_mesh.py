@@ -20,6 +20,15 @@ from one-shot entry computations.
 
 Usage:
     python scripts/profile_mesh.py [--step-n N] [--detect-n N] [--out FILE]
+                                   [--compare BASE.json] [--force-sparse]
+
+``--compare BASE.json`` diffs this run against a prior capture (same n/k
+config) and prints a per-collective-class delta table — count and
+MB/chip/tick — exiting non-zero if any class regressed beyond the
+tolerance, so the collective budget is a ratchet, not a trivia table.
+``--force-sparse`` drops the sparse candidate path's engagement floor so
+a small --step-n profile exercises the same hierarchical-select code
+path as the 1M headline (CI-speed budget checks).
 """
 
 from __future__ import annotations
@@ -72,6 +81,10 @@ def parse_collectives(hlo_path: str) -> dict:
     bodies: dict = {}  # while-body computation -> owning computation
     calls: dict = {}  # computation -> called computations (non-while)
     cur = None
+    # instruction/computation names carry a "%" sigil in older XLA text
+    # dumps and none in current ones — accept both, or a format rotation
+    # silently reports an empty census (bit us once: the r6 'before'
+    # capture came out all-zero against a 297-collective program)
     for line in open(hlo_path):
         stripped = line.rstrip()
         if stripped.endswith("{") and not line.lstrip().startswith("ROOT"):
@@ -81,7 +94,7 @@ def parse_collectives(hlo_path: str) -> dict:
             cur = None
         elif cur is not None:
             m = re.search(
-                r"%([\w.\-]+) = (.+?) (" + "|".join(COLLECTIVES) + r")(?:-start)?\(",
+                r"%?([\w.\-]+) = (.+?) (" + "|".join(COLLECTIVES) + r")(?:-start)?\(",
                 line,
             )
             if m and "-done" not in line.split("=", 1)[1][:60]:
@@ -92,10 +105,10 @@ def parse_collectives(hlo_path: str) -> dict:
                         "bytes": _shape_bytes(m.group(2)),
                     }
                 )
-            b = re.search(r"body=%([\w.\-]+)", line)
+            b = re.search(r"body=%?([\w.\-]+)", line)
             if b:
                 bodies[b.group(1)] = cur
-            for callee in re.findall(r"(?:calls|to_apply|condition)=%([\w.\-]+)", line):
+            for callee in re.findall(r"(?:calls|to_apply|condition)=%?([\w.\-]+)", line):
                 calls.setdefault(callee, set()).add(cur)
 
     def loop_depth(name: str, seen=()) -> int:
@@ -139,6 +152,21 @@ def main() -> None:
     ap.add_argument("--step-k", type=int, default=256)
     ap.add_argument("--detect-n", type=int, default=100_000)
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--compare", metavar="BASE.json", default=None,
+        help="diff this run against a prior capture of the SAME config and "
+        "exit non-zero if any collective class regressed beyond --tolerance",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.05,
+        help="allowed relative growth per collective class before the "
+        "compare fails (default 0.05 = 5%%)",
+    )
+    ap.add_argument(
+        "--force-sparse", action="store_true",
+        help="drop the sparse candidate path's n floor so small --step-n "
+        "profiles exercise the hierarchical select like the 1M step does",
+    )
     args = ap.parse_args()
 
     dump = tempfile.mkdtemp(prefix="meshhlo_")
@@ -149,12 +177,12 @@ def main() -> None:
     ).strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
-        _run(args, dump)
+        sys.exit(_run(args, dump))
     finally:
         shutil.rmtree(dump, ignore_errors=True)
 
 
-def _run(args, dump: str) -> None:
+def _run(args, dump: str) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -163,11 +191,14 @@ def _run(args, dump: str) -> None:
 
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ringpop_tpu.sim import lifecycle
     from ringpop_tpu.sim.delta import DeltaFaults
+
+    if args.force_sparse:
+        lifecycle._SPARSE_TOPK_MIN_N = 0
 
     devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
     mesh = Mesh(devs, ("node", "rumor"))
@@ -219,11 +250,22 @@ def _run(args, dump: str) -> None:
         lifecycle.state_shardings(mesh, k=256),
     )
     subjects = jnp.asarray(np.flatnonzero(~dup), jnp.int32)
+    # the rumor-axis replication hint for the per-check slot walk — the
+    # same static arg the sharded bench paths pass (older engine
+    # revisions don't take it; fall back so --compare can profile them)
+    detect_kw = dict(
+        min_status=lifecycle.FAULTY, block_ticks=32, max_blocks=jnp.int32(16)
+    )
     t0 = time.perf_counter()
-    lifecycle._run_until_detected_device.lower(
-        dparams, dstate, dfaults, subjects,
-        min_status=lifecycle.FAULTY, block_ticks=32, max_blocks=jnp.int32(16),
-    ).compile()
+    try:
+        lifecycle._run_until_detected_device.lower(
+            dparams, dstate, dfaults, subjects,
+            learned_sharding=NamedSharding(mesh, P("node", None)), **detect_kw,
+        ).compile()
+    except TypeError:
+        lifecycle._run_until_detected_device.lower(
+            dparams, dstate, dfaults, subjects, **detect_kw
+        ).compile()
     detect_compile_s = time.perf_counter() - t0
     mod = _newest_module(dump, "")
     census = parse_collectives(mod) if mod else {"computations": {}, "loop_depth": {}}
@@ -261,6 +303,57 @@ def _run(args, dump: str) -> None:
         print(f"\nwrote {args.out}")
     print(json.dumps({"profile_mesh": {k2: report[k2]["by_kind"]
                                        for k2 in ("step", "detect")}}))
+    if args.compare:
+        return _compare(report, args.compare, args.tolerance)
+    return 0
+
+
+def _compare(report: dict, base_path: str, tol: float) -> int:
+    """Per-collective-class delta vs a prior capture; non-zero on any
+    regression beyond ``tol`` (relative count/bytes growth, with a small
+    absolute slack so zero-byte classes don't trip on rounding)."""
+    with open(base_path) as f:
+        base = json.load(f)
+    rc = 0
+    slack_bytes = 64 * 1024  # one stray [16, cap]-class buffer, not an [N]
+    for prog in ("step", "detect"):
+        cur, old = report.get(prog, {}), base.get(prog, {})
+        for field in ("n", "k"):
+            if cur.get(field) != old.get(field):
+                print(f"compare: {prog} config mismatch vs {base_path}: "
+                      f"{field}={cur.get(field)} baseline {old.get(field)} — "
+                      "per-class deltas would be meaningless")
+                return 3
+        kinds = sorted(set(cur["by_kind"]) | set(old["by_kind"]))
+        print(f"\n== {prog} delta vs {os.path.basename(base_path)} "
+              f"(n={cur['n']}, k={cur['k']}; tolerance {tol:.0%}) ==")
+        print(f"{'kind':>22} {'count':>11} {'MB/chip':>17}  verdict")
+        for kind in kinds:
+            c = cur["by_kind"].get(kind, {"count": 0, "bytes": 0})
+            o = old["by_kind"].get(kind, {"count": 0, "bytes": 0})
+            worse_count = c["count"] > o["count"] + max(2, tol * o["count"])
+            worse_bytes = c["bytes"] > o["bytes"] * (1 + tol) + slack_bytes
+            verdict = "REGRESSED" if (worse_count or worse_bytes) else "ok"
+            if verdict == "REGRESSED":
+                rc = 2
+            print(f"{kind:>22} {o['count']:>5}->{c['count']:<5} "
+                  f"{o['bytes'] / 1e6:>8.2f}->{c['bytes'] / 1e6:<8.2f} {verdict}")
+        ct = sum(e["count"] for e in cur["by_kind"].values())
+        cb = sum(e["bytes"] for e in cur["by_kind"].values())
+        ot = sum(e["count"] for e in old["by_kind"].values())
+        ob = sum(e["bytes"] for e in old["by_kind"].values())
+        print(f"{'TOTAL':>22} {ot:>5}->{ct:<5} {ob / 1e6:>8.2f}->{cb / 1e6:<8.2f}")
+        if ct == 0 and ot > 0:
+            # an all-zero census against a collective-bearing baseline is
+            # the parser/dump-format-drift failure mode (it bit the r6
+            # 'before' capture), not a miracle optimization — refuse to
+            # certify it as within budget
+            print(f"compare: {prog} census parsed ZERO collectives against a "
+                  f"{ot}-collective baseline — HLO dump format drift? fix "
+                  "parse_collectives before trusting any budget result")
+            return 3
+    print("\ncompare:", "REGRESSED beyond tolerance" if rc else "within budget")
+    return rc
 
 
 if __name__ == "__main__":

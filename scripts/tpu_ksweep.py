@@ -166,6 +166,60 @@ def main() -> None:
             out["tick_cost"][str(k)] = {"error": f"{type(e).__name__}: {e}"[:300]}
         flush()
 
+    # -- 1b: the SHARDED tick over every visible chip (multi-chip ICI model,
+    # r6): only runs when the window exposes >1 device — the virtual-CPU
+    # variant of this number measures host thread rendezvous, not ICI, so
+    # a CPU fallback records nothing here.  certify_cost_model judges the
+    # median against the ICI-floor bracket derived from the committed
+    # profile_mesh collective budget (captures/mesh_profile_r6_after.json).
+    if len(jax.devices()) > 1 and out["platform"] != "cpu":
+        try:
+            from jax.sharding import Mesh
+
+            k = 256
+            params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10)
+            n_dev = len(jax.devices())
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            sstate = jax.tree.map(
+                jax.device_put,
+                lifecycle.init_state(params, seed=0),
+                lifecycle.state_shardings(mesh, k=k),
+            )
+            import functools as _ft
+
+            sblk = jax.jit(
+                _ft.partial(lifecycle._run_block, params), static_argnames="ticks"
+            )
+            t0 = time.perf_counter()
+            sstate = sblk(sstate, faults, ticks=block)
+            jax.block_until_ready(sstate.learned)
+            compile_s = time.perf_counter() - t0
+            per_rep = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sstate = sblk(sstate, faults, ticks=block)
+                jax.block_until_ready(sstate.learned)
+                per_rep.append(time.perf_counter() - t0)
+            out["sharded_tick"] = {
+                "n": n,
+                "k": k,
+                "n_devices": n_dev,
+                "mesh": f"{n_dev // rumor}x{rumor} (node x rumor)",
+                "block_ticks": block,
+                "compile_plus_first_block_s": round(compile_s, 3),
+                "block_s_reps": [round(r, 4) for r in per_rep],
+                "ms_per_tick_median": round(
+                    sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+                ),
+            }
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            out["sharded_tick"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+
     # -- 2+3: headline detection then convergence at the official config ----
     try:
         sim = lifecycle.LifecycleSim(n=n, k=k_head, seed=0)

@@ -450,67 +450,167 @@ def test_scale_spot_check_20k():
 
 
 def test_sparse_topk_paths_bit_identical(monkeypatch):
-    """The sparse candidate selection (compress + top_k, lax.cond overflow
-    fallback) must be BIT-identical to the dense ``lax.top_k`` it replaces
-    — including scatter side effects downstream of padding entries and
-    stable tie order at the m boundary (simultaneous declarations carry
-    equal keys, so which subjects win slots is order-sensitive).
+    """The hierarchical candidate selection (per-block compress + select +
+    cross-block merge, lax.cond overflow fallback) must be BIT-identical
+    to the dense ``lax.top_k`` it replaces — including scatter side
+    effects downstream of padding entries and stable tie order at the m
+    boundary (simultaneous declarations carry equal keys, so which
+    subjects win slots is order-sensitive).
 
     Caps are monkeypatched so a 512-node run exercises every branch:
-    dense (cap >= n), compressed (candidates < cap < n), and overflow
-    (cap < candidates -> cond falls back to the full sort).
+    dense (n <= min_n), hierarchical (per-block candidates <= cap), and
+    overflow (cap below any block's candidate count -> cond falls back
+    to the full sort).
     """
     from ringpop_tpu.sim import lifecycle
 
+    from ringpop_tpu.sim.packbits import block_count
+
     n, k = 512, 16
-    # 50 simultaneous victims vs alloc_per_tick=8: tie-heavy boundary
-    victims = list(range(3, 503, 10))
-    faults = make_faults(n, down=victims)
+    # two fault layouts: SPREAD (~3 victims per 32-subject block at the
+    # default 16 blocks — tie-heavy cross-block merges) and PACKED (30
+    # victims inside ONE block — more concurrent candidates than any
+    # cap >= m can hold, which is the only way to reach the runtime
+    # overflow cond: the static ``m > cap`` guard already eats cap < m)
+    spread = list(range(3, 503, 10))
+    packed = list(range(30)) + [100, 300]
     params = LifecycleParams(n=n, k=k, alloc_per_tick=8, suspect_ticks=4)
 
-    def run(cap, min_n=0):
+    # record which runtime branch each eager _top_m_sparse call could
+    # take, so the coverage claims below cannot rot into vacuity again
+    # (regression: a cap=1 'overflow' run was statically dense via the
+    # m > cap guard and compared dense against dense)
+    saw = {"hier": False, "overflow": False}
+    orig_top_m = lifecycle._top_m_sparse
+
+    def recording_top_m(cand, m):
+        cap = lifecycle._SPARSE_TOPK_CAP
+        if n > max(cap, lifecycle._SPARSE_TOPK_MIN_N) and m <= cap:
+            b = block_count(n, lifecycle._TOPK_BLOCKS)
+            counts = (np.asarray(cand).reshape(b, n // b) >= 0).sum(axis=1)
+            cap_eff = min(cap, n // b)
+            if (counts > cap_eff).any():
+                saw["overflow"] = True
+            elif counts.sum():
+                saw["hier"] = True
+        return orig_top_m(cand, m)
+
+    monkeypatch.setattr(lifecycle, "_top_m_sparse", recording_top_m)
+
+    def run(cap, victims, min_n=0):
         monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", cap)
         monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", min_n)
+        faults = make_faults(n, down=victims)
         state = init_state(params, seed=3)
         out = []
         for _ in range(30):
-            state = step(params, state, faults)
+            state = step(params, state, faults)  # eager: recorder sees values
             out.append(state)
         return out
 
-    dense = run(4096, min_n=1 << 30)  # n <= min_n: full top_k, statically
-    compressed = run(64)  # candidates (<=50ish) < cap < n: compressed path
-    overflow = run(8)  # cap < candidates: cond overflow -> full sort
+    dense_spread = run(4096, spread, min_n=1 << 30)  # full top_k, statically
+    hier = run(32, spread)  # every block's candidates (~3) <= cap
+    assert saw["hier"], "hierarchical branch never engaged — coverage rotted"
+    dense_packed = run(4096, packed, min_n=1 << 30)
+    saw["overflow"] = False
+    overflow = run(8, packed)  # block 0 exceeds cap -> cond -> full sort
+    assert saw["overflow"], "overflow cond never engaged — coverage rotted"
 
-    for variant, tag in ((compressed, "compressed"), (overflow, "overflow")):
-        for t, (sa, sb) in enumerate(zip(dense, variant)):
+    for oracle, variant, tag in (
+        (dense_spread, hier, "hierarchical"),
+        (dense_packed, overflow, "overflow"),
+    ):
+        for t, (sa, sb) in enumerate(zip(oracle, variant)):
             for f, va, vb in zip(sa._fields, sa, sb):
                 assert np.array_equal(np.asarray(va), np.asarray(vb)), (
                     f"{tag} diverges from dense at tick {t} field {f}"
                 )
 
 
+def test_hierarchical_topk_sharded_bit_identical(monkeypatch):
+    """r6 satellite: the hierarchical select must stay bit-identical to
+    the dense oracle UNDER THE 4×2 DEVICE MESH — the per-node-shard local
+    select, the cross-shard merge (tie-heavy: simultaneous suspicions
+    carry equal keys, so the merge's (block asc, index asc) tie order is
+    load-bearing), and the overflow fallback all execute against sharded
+    operands, where a partitioner-introduced reorder would be invisible
+    to the unsharded tests above."""
+    import functools
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ringpop_tpu.sim import lifecycle
+    from ringpop_tpu.sim.lifecycle import state_shardings
+
+    n, k = 512, 64  # k = 32 words × 2 rumor shards
+    # same two layouts as the unsharded test above: spread exercises the
+    # cross-block merge ties, packed (30 victims in block 0) pushes one
+    # block past any cap >= m so the runtime overflow cond actually runs
+    # (the eager test above ASSERTS these layouts reach those branches;
+    # here the runs are jitted, so the layouts carry the coverage)
+    spread = list(range(3, 503, 10))
+    packed = list(range(30)) + [100, 300]
+    params = LifecycleParams(n=n, k=k, alloc_per_tick=8, suspect_ticks=4)
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("node", "rumor"))
+
+    def run(cap, victims, min_n=0, sharded=True):
+        monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", cap)
+        monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", min_n)
+        faults = make_faults(n, down=victims)
+        state = init_state(params, seed=3)
+        if sharded:
+            state = jax.tree.map(
+                jax.device_put, state, state_shardings(mesh, k=params.k)
+            )
+        jstep = jax.jit(functools.partial(step, params))
+        out = []
+        for _ in range(24):
+            state = jstep(state, faults)
+            out.append(jax.tree.map(np.asarray, state))
+        return out
+
+    oracle_spread = run(4096, spread, min_n=1 << 30, sharded=False)
+    oracle_packed = run(4096, packed, min_n=1 << 30, sharded=False)
+    cases = (
+        ("sharded-dense", oracle_spread, run(4096, spread, min_n=1 << 30)),
+        ("sharded-hier", oracle_spread, run(32, spread)),  # local select+merge
+        ("sharded-overflow", oracle_packed, run(8, packed)),  # cond full sort
+    )
+    for tag, oracle, variant in cases:
+        for t, (sa, sb) in enumerate(zip(oracle, variant)):
+            for f, va, vb in zip(sa._fields, sa, sb):
+                assert np.array_equal(va, vb), (
+                    f"{tag} diverges from the dense oracle at tick {t} field {f}"
+                )
+
+
 def test_sparse_topk_branches_pinned(monkeypatch):
     """Unit-level pin of WHICH _top_m_sparse branch runs: the step-level
-    test above can't observe branch selection, so a drift in candidate
-    counts could silently turn its 'compressed' run into overflow-fallback
-    coverage.  Here the candidate count is constructed by hand on both
-    sides of the cap, including boundary ties, an empty candidate set,
-    and count == cap exactly."""
+    tests can't observe branch selection, so a drift in candidate counts
+    could silently turn the 'hierarchical' coverage into overflow-fallback
+    coverage.  Per-BLOCK candidate counts (the cap is per node block
+    since the r6 hierarchical rewrite) are constructed by hand on both
+    sides of the cap, including cross-block boundary ties, an empty
+    candidate set, and count == cap exactly."""
     import jax
 
     from ringpop_tpu.sim import lifecycle
 
     monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
-    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", 16)
-    n, m = 300, 4
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_CAP", 4)
+    n, m = 512, 4  # 16 blocks of 32 subjects; per-block cap 4
     rng = np.random.default_rng(7)
 
-    def check(n_cand, tag):
+    def check(per_block, tag, vals=None):
+        """per_block: candidate count to place in each 32-subject block."""
         cand = np.full(n, -1, np.int32)
-        idx = np.sort(rng.choice(n, n_cand, replace=False))
-        # duplicate keys on purpose: tie order at the m boundary must match
-        cand[idx] = rng.integers(0, 4, n_cand).astype(np.int32)
+        for b, cnt in enumerate(per_block):
+            idx = b * 32 + np.sort(rng.choice(32, cnt, replace=False))
+            cand[idx] = (
+                rng.integers(0, 3, cnt) if vals is None else vals
+            )
         got_v, got_i = lifecycle._top_m_sparse(jnp.asarray(cand), m)
         exp_v, exp_i = jax.lax.top_k(jnp.asarray(cand), m)
         # padding entries (value -1) may legitimately differ in subject:
@@ -521,7 +621,12 @@ def test_sparse_topk_branches_pinned(monkeypatch):
         real = np.asarray(exp_v) >= 0
         assert np.array_equal(np.asarray(got_i)[real], np.asarray(exp_i)[real]), tag
 
-    check(0, "empty")        # no candidates at all
-    check(7, "compressed")   # 7 < cap=16: compressed branch
-    check(16, "boundary")    # == cap: still compressed
-    check(40, "overflow")    # > cap: cond falls back to the full sort
+    check([0] * 16, "empty")  # no candidates at all
+    check([2] * 16, "hierarchical")  # every block under cap: local+merge
+    check([4] * 16, "boundary")  # == cap in every block: still hierarchical
+    check([2] * 15 + [7], "overflow")  # ONE overfull block -> full sort
+    # cross-block merge tie-break: more equal-valued candidates than m,
+    # spread over many blocks — the winners must be the lowest global
+    # indices, which only holds if the merge preserves (block, index) order
+    check([1] * 16, "merge-ties", vals=7)
+    check([3] * 16, "merge-ties-multi", vals=2)

@@ -1,0 +1,174 @@
+"""Collective-budget regression guard for the sharded lifecycle engine.
+
+The r6 tentpole cut the sharded 1M-tick's cross-chip traffic ~2.3×
+(PERF.md "Multi-chip collective cost model", captures/mesh_profile_r6_*)
+by making candidate selection hierarchical, blocking the packed row
+reduces, and replicating the detection walk's learned plane once per
+check.  Nothing in the type system stops a future engine edit from
+silently re-globalizing one of those paths — the SPMD partitioner will
+happily all-gather an [N]-indexed operand again — so this test compiles
+the sharded programs at CI scale (8k × 64 over a 2×2 node × rumor mesh;
+--force-sparse-equivalent monkeypatch so the hierarchical select engages
+exactly as it does at 1M) and asserts the collective census stays at or
+under the post-tentpole budget.
+
+Budgets are the r6 measured values plus slack for partitioner noise
+(measured: step 134 collectives / 0.60 MB; walk body 1 collective):
+blowing one is not flaky infrastructure, it is an ICI-traffic
+regression — profile scripts/profile_mesh.py to find the new collective
+before raising any number here.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ringpop_tpu.sim import lifecycle
+from ringpop_tpu.sim.delta import DeltaFaults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# measured 134 / 0.603 MB at this config (see module docstring)
+STEP_MAX_COLLECTIVES = 150
+STEP_MAX_MB = 0.80
+# the detection walk's fori body must stay at <= 1 collective per
+# iteration — the acceptance bar of the r6 detect-walk replication
+# (down from ~6/iteration when the packed plane was gathered per slot)
+WALK_MAX_COLLECTIVES_PER_ITER = 1
+
+
+def _profile_mesh_module():
+    spec = importlib.util.spec_from_file_location(
+        "profile_mesh", os.path.join(_REPO, "scripts", "profile_mesh.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _census_of(compiled_text: str, tmp_path):
+    pm = _profile_mesh_module()
+    p = tmp_path / "budget_hlo.txt"
+    p.write_text(compiled_text)
+    return pm.parse_collectives(str(p))
+
+
+@pytest.fixture(scope="module")
+def sharded_setup():
+    devs = np.asarray(jax.devices("cpu")[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("node", "rumor"))
+    n, k = 8192, 64
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10)
+    up = np.ones(n, bool)
+    up[::64] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    state = jax.tree.map(
+        jax.device_put,
+        lifecycle.init_state(params, seed=0),
+        lifecycle.state_shardings(mesh, k=k),
+    )
+    return mesh, params, state, faults, up
+
+
+def test_step_collective_budget(sharded_setup, tmp_path, monkeypatch):
+    """The sharded one-tick program's collective count/bytes stay at or
+    under the post-r6 budget (hierarchical select engaged via the MIN_N
+    monkeypatch, exactly as the 1M program runs it)."""
+    mesh, params, state, faults, _ = sharded_setup
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    census = _census_of(
+        blk.lower(state, faults, ticks=1).compile().as_text(), tmp_path
+    )
+    count = sum(len(v) for v in census["computations"].values())
+    mb = sum(
+        r["bytes"] for v in census["computations"].values() for r in v
+    ) / 1e6
+    assert count > 0, "census parsed no collectives — parser/format drift?"
+    assert count <= STEP_MAX_COLLECTIVES, (
+        f"sharded step now issues {count} collectives "
+        f"(budget {STEP_MAX_COLLECTIVES}) — an engine edit re-globalized "
+        "a hot path; run scripts/profile_mesh.py to attribute it"
+    )
+    assert mb <= STEP_MAX_MB, (
+        f"sharded step now moves {mb:.3f} MB/chip/tick (budget "
+        f"{STEP_MAX_MB}) — run scripts/profile_mesh.py to attribute it"
+    )
+
+
+def test_detect_walk_body_collective_budget(sharded_setup, tmp_path):
+    """With the rumor-axis replication hint, the detection walk's
+    while-body carries <= 1 collective per iteration (the finalize
+    scalar reduce) — the K-sequential-collectives pathology stays dead.
+    ``detection_complete`` holds exactly one loop (the K-slot walk), so
+    every loop-depth >= 1 computation in its HLO is walk body."""
+    mesh, params, state, faults, up = sharded_setup
+    subjects = jnp.asarray(np.flatnonzero(~up)[:32], jnp.int32)
+    jdc = jax.jit(
+        lifecycle.detection_complete,
+        static_argnames=("min_status", "learned_sharding"),
+    )
+    census = _census_of(
+        jdc.lower(
+            state,
+            subjects,
+            faults,
+            min_status=lifecycle.FAULTY,
+            learned_sharding=NamedSharding(mesh, P("node", None)),
+        )
+        .compile()
+        .as_text(),
+        tmp_path,
+    )
+    body_comps = {
+        c: rows
+        for c, rows in census["computations"].items()
+        if census["loop_depth"].get(c, 0) >= 1
+    }
+    total_entry = sum(len(v) for v in census["computations"].values())
+    assert total_entry > 0, "census parsed no collectives — parser/format drift?"
+    for comp, rows in body_comps.items():
+        assert len(rows) <= WALK_MAX_COLLECTIVES_PER_ITER, (
+            f"walk-body computation {comp} carries {len(rows)} collectives "
+            f"per iteration ({[r['kind'] for r in rows]}) — the detect walk "
+            "is paying cross-shard traffic inside the K-slot loop again"
+        )
+
+
+def test_detect_census_sees_unhinted_walk_collectives(sharded_setup, tmp_path):
+    """Self-check that the budget numbers are not vacuous: the UNhinted
+    detect program (no learned_sharding) must show MORE walk-body
+    collectives than the hinted one — proving the parser can see
+    in-body collectives at all, and that the hint is what removes them."""
+    mesh, params, state, faults, up = sharded_setup
+    subjects = jnp.asarray(np.flatnonzero(~up)[:32], jnp.int32)
+    jdc = jax.jit(
+        lifecycle.detection_complete,
+        static_argnames=("min_status", "learned_sharding"),
+    )
+    census = _census_of(
+        jdc.lower(state, subjects, faults, min_status=lifecycle.FAULTY)
+        .compile()
+        .as_text(),
+        tmp_path,
+    )
+    body = sum(
+        len(rows)
+        for c, rows in census["computations"].items()
+        if census["loop_depth"].get(c, 0) >= 1
+    )
+    assert body > WALK_MAX_COLLECTIVES_PER_ITER, (
+        "unhinted walk shows no extra in-body collectives — either the "
+        "partitioner learned to hoist the gather itself (budget test can "
+        "be tightened) or the census stopped seeing loop bodies"
+    )
