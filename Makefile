@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean profile-mesh
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean profile-mesh telemetry-smoke
 
 all: native test
 
@@ -13,10 +13,18 @@ all: native test
 # XLA compiles hit the persistent .jax_cache — cold first run pays compile
 # once, warm runs are compile-free.  --durations prints the tier timings.)
 # profile-mesh runs first so CI exercises the sharded compile + collective
-# budget ratchet without the slow 1M program; tests/test_mesh_budget.py
-# re-asserts the while-body budgets from inside pytest.
-test: profile-mesh
+# budget ratchet without the slow 1M program; telemetry-smoke gates the
+# telemetry plane (journal produced + telemetry-on digest-equal to off);
+# tests/test_mesh_budget.py re-asserts the while-body budgets from inside
+# pytest.
+test: profile-mesh telemetry-smoke
 	$(PY) -m pytest tests/ -q --durations=15
+
+# tiny-config telemetry gate: lifecycle run with telemetry on must emit a
+# parseable JSONL journal AND end digest-equal to a telemetry-off run;
+# the delta journal hook must be bit-transparent too.
+telemetry-smoke:
+	$(PY) scripts/telemetry_smoke.py
 
 # compile the sharded programs at CI scale (8k, hierarchical select forced
 # on) and diff the collective census against the committed budget capture —

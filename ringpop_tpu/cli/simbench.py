@@ -47,6 +47,30 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+# --telemetry PATH: the run-journal sink (sim/telemetry.py).  Scenario
+# benches that drive a sim engine consult _telemetry_sink(); each opens
+# the shared JSONL in append mode and writes its own header record, so
+# one file carries the whole run.  None (default) leaves every measured
+# path exactly as it was — the telemetry leg compiles out.
+_TELEMETRY_PATH = None
+
+
+def _telemetry_sink(scenario: str, engine: str, params: dict):
+    """A TelemetrySink journaling to the --telemetry file, or None."""
+    if _TELEMETRY_PATH is None:
+        return None
+    from ringpop_tpu.sim.telemetry import TelemetryJournal, TelemetrySink
+
+    journal = TelemetryJournal(_TELEMETRY_PATH, append=True)
+    journal.header(engine, scenario, params)
+    return TelemetrySink(journal=journal)
+
+
+def _close_sink(sink) -> None:
+    if sink is not None and sink.journal is not None:
+        sink.journal.close()
+
+
 def _platform():
     # A wedged axon tunnel HANGS jax.devices() rather than raising, so ask
     # via the shared subprocess probe before touching jax in this process.
@@ -151,24 +175,28 @@ def bench_loss1k(seed: int, full: bool) -> dict:
     from ringpop_tpu.sim.lifecycle import LifecycleSim
 
     n = 1000
-    sim = LifecycleSim(n=n, k=128, seed=seed, suspect_ticks=25)
+    sink = _telemetry_sink("loss1k", "lifecycle", {"n": n, "k": 128, "seed": seed})
+    sim = LifecycleSim(n=n, k=128, seed=seed, suspect_ticks=25, telemetry=sink)
     rng = np.random.default_rng(seed)
     victims = sorted(rng.choice(n, size=10, replace=False).tolist())
     up = np.ones(n, bool)
     up[victims] = False
     faults = DeltaFaults(up=jnp.asarray(up), drop_rate=0.05)
 
-    sim.tick(faults)  # compile
-    jax.block_until_ready(sim.state.learned)
-    t0 = time.perf_counter()
-    ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
-    elapsed = time.perf_counter() - t0
-    # continue to full quiescence: rumors drained + every live view
-    # checksum agrees (the reference's waitForConvergence criterion) —
-    # only meaningful when detection actually completed
-    conv_ticks, conv_ok = (
-        sim.run_until_converged(faults, max_ticks=4000) if ok else (None, False)
-    )
+    try:
+        sim.tick(faults)  # compile
+        jax.block_until_ready(sim.state.learned)
+        t0 = time.perf_counter()
+        ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
+        elapsed = time.perf_counter() - t0
+        # continue to full quiescence: rumors drained + every live view
+        # checksum agrees (the reference's waitForConvergence criterion) —
+        # only meaningful when detection actually completed
+        conv_ticks, conv_ok = (
+            sim.run_until_converged(faults, max_ticks=4000) if ok else (None, False)
+        )
+    finally:
+        _close_sink(sink)  # a dying bench must still flush its journal tail
     return {
         "metric": "lifecycle_1k_5pct_loss_detection",
         "value": round(elapsed, 3),
@@ -692,28 +720,29 @@ def bench_partition1m(seed: int, full: bool) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from ringpop_tpu.sim.delta import (
-        DeltaFaults,
-        DeltaParams,
-        init_state,
-        run_until_converged,
-    )
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaSim
 
     n = 1_000_000 if full else 50_000
     k = 128 if full else 64
-    params = DeltaParams(n=n, k=k)
     group = np.zeros(n, np.int32)
     group[: int(0.3 * n)] = 1
     part = DeltaFaults(up=jnp.ones(n, bool), group=jnp.asarray(group))
     heal = DeltaFaults(up=jnp.ones(n, bool))
 
-    state = init_state(params, seed=seed)
-    t0 = time.perf_counter()
-    # partition phase: dissemination proceeds within each side only
-    state, t_part, _ = run_until_converged(params, state, part, max_ticks=256)
-    # heal phase: cross-side exchange completes global convergence
-    state, t_heal, ok = run_until_converged(params, state, heal, max_ticks=4096)
-    elapsed = time.perf_counter() - t0
+    # the sink (--telemetry) journals one coverage/digest record per
+    # 64-tick block; with no sink DeltaSim dispatches exactly the old
+    # single-call path
+    sink = _telemetry_sink("partition1m", "delta", {"n": n, "k": k, "seed": seed})
+    sim = DeltaSim(n=n, k=k, seed=seed, telemetry_sink=sink)
+    try:
+        t0 = time.perf_counter()
+        # partition phase: dissemination proceeds within each side only
+        t_part, _ = sim.run_until_converged(part, max_ticks=256)
+        # heal phase: cross-side exchange completes global convergence
+        t_heal, ok = sim.run_until_converged(heal, max_ticks=4096)
+        elapsed = time.perf_counter() - t0
+    finally:
+        _close_sink(sink)  # a dying bench must still flush its journal tail
     return {
         "metric": f"delta_{n//1000}k_30pct_partition_heal",
         "value": round(elapsed, 3),
@@ -776,36 +805,42 @@ def bench_partition_lifecycle(seed: int, full: bool) -> dict:
     plain = DeltaFaults(up=jnp.asarray(up))
     blip = DeltaFaults(up=jnp.asarray(up), group=jnp.asarray(group))
 
-    sim = lifecycle.LifecycleSim(n=n, k=k, seed=seed)
-    # phase 1: headline failure detection, no partition
-    t0 = time.perf_counter()
-    detect_ticks, detected = sim.run_until_detected(
-        victims, plain, max_ticks=4096, check_every=16, blocks_per_dispatch=8,
-        time_budget_s=2400.0,
+    sink = _telemetry_sink(
+        "partition_lc", "lifecycle", {"n": n, "k": k, "seed": seed}
     )
-    jax.block_until_ready(sim.state.learned)
-    detect_s = time.perf_counter() - t0
+    sim = lifecycle.LifecycleSim(n=n, k=k, seed=seed, telemetry=sink)
+    try:
+        # phase 1: headline failure detection, no partition
+        t0 = time.perf_counter()
+        detect_ticks, detected = sim.run_until_detected(
+            victims, plain, max_ticks=4096, check_every=16, blocks_per_dispatch=8,
+            time_budget_s=2400.0,
+        )
+        jax.block_until_ready(sim.state.learned)
+        detect_s = time.perf_counter() - t0
 
-    # phase 2: the 30% partition blips and heals late
-    t0 = time.perf_counter()
-    sim.run(blip_ticks, blip)
-    jax.block_until_ready(sim.state.learned)
-    blip_s = time.perf_counter() - t0
+        # phase 2: the 30% partition blips and heals late
+        t0 = time.perf_counter()
+        sim.run(blip_ticks, blip)
+        jax.block_until_ready(sim.state.learned)
+        blip_s = time.perf_counter() - t0
 
-    # the blip left the cluster detected-but-not-converged: false
-    # accusations are in flight and views diverge across nodes
-    cs = np.asarray(lifecycle.view_checksums(sim.state, plain))
-    views_agree_after_blip = bool(len(np.unique(cs[np.asarray(plain.up)])) == 1)
+        # the blip left the cluster detected-but-not-converged: false
+        # accusations are in flight and views diverge across nodes
+        cs = np.asarray(lifecycle.view_checksums(sim.state, plain))
+        views_agree_after_blip = bool(len(np.unique(cs[np.asarray(plain.up)])) == 1)
 
-    # phase 3 (healed): literal convergence — refutations must disseminate
-    # and quiesce; 4-tick checks so a short tail still resolves as > 0
-    t0 = time.perf_counter()
-    extra_ticks, converged = sim.run_until_converged(
-        plain, max_ticks=4096, check_every=4, blocks_per_dispatch=8,
-        time_budget_s=2400.0,
-    )
-    jax.block_until_ready(sim.state.learned)
-    converge_s = time.perf_counter() - t0
+        # phase 3 (healed): literal convergence — refutations must disseminate
+        # and quiesce; 4-tick checks so a short tail still resolves as > 0
+        t0 = time.perf_counter()
+        extra_ticks, converged = sim.run_until_converged(
+            plain, max_ticks=4096, check_every=4, blocks_per_dispatch=8,
+            time_budget_s=2400.0,
+        )
+        jax.block_until_ready(sim.state.learned)
+        converge_s = time.perf_counter() - t0
+    finally:
+        _close_sink(sink)  # a dying bench must still flush its journal tail
 
     return {
         "metric": f"lifecycle_{n // 1000}k_30pct_partition_blip_heal",
@@ -1104,7 +1139,22 @@ def main(argv=None) -> int:
         help="also write all scenario results to this JSON file "
         "(the committed SIMBENCH_r{N}.json artifacts)",
     )
+    p.add_argument(
+        "--telemetry",
+        metavar="OUT.jsonl",
+        default=None,
+        help="write the sim-plane run journal (JSONL; one header per "
+        "scenario + one record per fetched tick-block — see "
+        "OBSERVABILITY.md) for the engine-driving scenarios; the "
+        "telemetry leg rides the device scan, so the measured paths "
+        "stay bit-identical to a telemetry-off run",
+    )
     args = p.parse_args(argv)
+
+    if args.telemetry:
+        global _TELEMETRY_PATH
+        _TELEMETRY_PATH = args.telemetry
+        open(args.telemetry, "w").close()  # truncate; scenarios append
 
     if args.cpu:
         import os

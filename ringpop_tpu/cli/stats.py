@@ -1,12 +1,19 @@
 """Stats reporter implementations for the CLI
 (parity: reference ``scripts/testpop/statter.go:48-59`` file-statsd adapter +
-UDP statsd option ``scripts/testpop/testpop.go``)."""
+UDP statsd option ``scripts/testpop/testpop.go``).
+
+Both reporters own an OS resource (file handle / UDP socket) and support
+``close()`` plus the context-manager protocol — the CLI entry points close
+them on exit so long-lived testpop processes don't leak descriptors, and
+``FileStats.close`` flushes so the tail of a run survives process exit.
+``close`` is idempotent; post-close emits are dropped (stats must never
+take the node down)."""
 
 from __future__ import annotations
 
 import socket
 import time
-from typing import TextIO
+from typing import Optional, TextIO
 
 from ringpop_tpu.options import StatsReporter
 
@@ -15,9 +22,11 @@ class FileStats(StatsReporter):
     """Timestamped stat lines to a file (parity: statter.go FileStatter)."""
 
     def __init__(self, path: str):
-        self._f: TextIO = open(path, "a", buffering=1)
+        self._f: Optional[TextIO] = open(path, "a", buffering=1)
 
     def _write(self, kind: str, key: str, value) -> None:
+        if self._f is None or self._f.closed:
+            return
         self._f.write(f"{time.time():.6f} {kind} {key} {value}\n")
 
     def incr(self, key: str, value: int = 1) -> None:
@@ -30,7 +39,16 @@ class FileStats(StatsReporter):
         self._write("timing", key, seconds)
 
     def close(self) -> None:
+        if self._f is None or self._f.closed:
+            return
+        self._f.flush()
         self._f.close()
+
+    def __enter__(self) -> "FileStats":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class UDPStatsd(StatsReporter):
@@ -39,9 +57,13 @@ class UDPStatsd(StatsReporter):
     def __init__(self, hostport: str):
         host, port = hostport.rsplit(":", 1)
         self._addr = (host, int(port))
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock: Optional[socket.socket] = socket.socket(
+            socket.AF_INET, socket.SOCK_DGRAM
+        )
 
     def _send(self, payload: str) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.sendto(payload.encode(), self._addr)
         except OSError:
@@ -57,4 +79,12 @@ class UDPStatsd(StatsReporter):
         self._send(f"{key}:{seconds * 1000:.3f}|ms")
 
     def close(self) -> None:
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __enter__(self) -> "UDPStatsd":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -86,9 +86,14 @@ async def amain(args) -> int:
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    rp.destroy()
-    await channel.close()
+    try:
+        await stop.wait()
+    finally:
+        rp.destroy()
+        await channel.close()
+        if stats is not None:
+            # flush + release the reporter's file handle / UDP socket
+            stats.close()
     return 0
 
 

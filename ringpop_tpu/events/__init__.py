@@ -99,6 +99,19 @@ class LookupNBatchEvent:
 
 
 @dataclass
+class SimTickBlockEvent:
+    """One fetched sim-plane telemetry block (``sim/telemetry.py``): the
+    per-tick protocol counters accumulated on device over a tick-block,
+    reduced and brought to the host in one fetch.  The sim analog of the
+    host plane's per-RPC swim events — emitted per block, not per tick,
+    because the sim plane's whole point is that ticks never touch the
+    host.  ``record`` is the flat scalar dict documented in
+    OBSERVABILITY.md ("journal record schema")."""
+
+    record: dict = field(default_factory=dict)
+
+
+@dataclass
 class Ready:
     pass
 

@@ -25,6 +25,13 @@ protocol period at once:
   arrays) for detection-latency distributions and parameter studies;
   replica b is bit-identical to ``LifecycleSim(seed=seeds[b])``.
 
+* :mod:`ringpop_tpu.sim.telemetry` — device-resident telemetry plane:
+  per-tick protocol counters carried through the jitted scan
+  (elementwise accumulators; zero per-tick collectives under SPMD),
+  fetched per tick-block into the host stats/event plumbing and a JSONL
+  run journal.  Off by default and bit-transparent when on — see
+  OBSERVABILITY.md.
+
 Fault injection is first-class: partition group arrays, per-edge drop
 probability, process-liveness masks — plain arrays applied to the message
 exchange step (BASELINE.json's 5% loss / 30% partition configs).

@@ -348,17 +348,48 @@ def run_until_converged(
 
 
 class DeltaSim:
-    def __init__(self, n: int, k: int, seed: int = 0, **kw):
+    """Host-side convenience wrapper.  ``telemetry_sink`` (any callable
+    taking a record dict, e.g. a ``telemetry.TelemetrySink``) turns on the
+    run journal: ``run_until_converged`` then dispatches in
+    ``journal_every``-tick blocks and emits one record per block (tick,
+    live-coverage fraction, state digest — ``telemetry.delta_record``).
+    The dissemination engine carries no in-step counters, so the hook
+    costs one extra readback per block and nothing per tick; with no sink
+    the dispatch path is exactly the old single-call one."""
+
+    def __init__(self, n: int, k: int, seed: int = 0, telemetry_sink=None, **kw):
         self.params = DeltaParams(n=n, k=k, **kw)
         self.state = init_state(self.params, seed=seed)
         self._step = jax.jit(functools.partial(step, self.params))
+        self.telemetry_sink = telemetry_sink
+        if telemetry_sink is not None:
+            from ringpop_tpu.sim import telemetry as _tm
+
+            self._record = jax.jit(_tm.delta_record)
 
     def tick(self, faults: DeltaFaults = DeltaFaults()) -> DeltaState:
         self.state = self._step(self.state, faults)
         return self.state
 
-    def run_until_converged(self, faults: DeltaFaults = DeltaFaults(), max_ticks: int = 10_000):
-        self.state, ticks, ok = run_until_converged(
-            self.params, self.state, faults, max_ticks=max_ticks
-        )
+    def run_until_converged(
+        self,
+        faults: DeltaFaults = DeltaFaults(),
+        max_ticks: int = 10_000,
+        journal_every: int = 64,
+    ):
+        if self.telemetry_sink is None:
+            self.state, ticks, ok = run_until_converged(
+                self.params, self.state, faults, max_ticks=max_ticks
+            )
+            return ticks, ok
+        ticks, ok = 0, False
+        while ticks < max_ticks and not ok:
+            block = min(journal_every, max_ticks - ticks)
+            self.state, t, ok = run_until_converged(
+                self.params, self.state, faults, max_ticks=block
+            )
+            ticks += t
+            self.telemetry_sink(self._record(self.state, faults))
+            if t == 0 and not ok:  # budget too small for one check block
+                break
         return ticks, ok

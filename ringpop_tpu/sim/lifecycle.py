@@ -76,6 +76,7 @@ from ringpop_tpu.sim.packbits import (
     bit_column,
     block_count,
     check_rumor_shardable,
+    mix32,
     n_words,
     or_reduce_rows,
     pack_bool,
@@ -358,7 +359,8 @@ def step(
     params: LifecycleParams,
     state: LifecycleState,
     faults: DeltaFaults = DeltaFaults(),
-) -> LifecycleState:
+    telemetry=None,
+):
     """One protocol period for all N nodes.  Fixed shapes throughout; jit-
     and shard-friendly (the only cross-node ops are segment reductions by
     ping target / rumor subject and row gathers).
@@ -373,452 +375,473 @@ def step(
     scatters, and the per-slot first-live-learner argmax runs only on
     ticks where a suspicion/faulty timer actually fired (lax.cond).  All
     of it is value-identical to the unpacked formulation — certified
-    bit-for-bit by tests/test_lifecycle_golden.py."""
-    n, k = params.n, params.k
-    m = min(params.alloc_per_tick, params.k, params.n)
-    maxp = jnp.int8(clamped_max_p(params))
-    key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
-    # incarnation epoch = tick counter (strictly increasing, like the
-    # reference's wall-ms but 200× denser in int32: 2^28 ticks ≈ 621 days of
-    # simulated time before the packed key would overflow)
-    now = state.tick + 1
-    i_all = jnp.arange(n, dtype=jnp.int32)
+    bit-for-bit by tests/test_lifecycle_golden.py.
 
-    up = faults.up if faults.up is not None else jnp.ones(n, bool)
+    ``telemetry`` (a ``telemetry.TelemetryState`` or None): when given,
+    the tick additionally accumulates the protocol counters — pure
+    elementwise reads of intermediates the tick computes anyway (no PRNG
+    draws, no feedback into the state, zero collectives under SPMD; see
+    ``sim/telemetry.py``) — and the return becomes ``(state, telemetry)``.
+    When None (the default), the traced program is exactly the
+    telemetry-free one.  The ``jax.named_scope`` sections name the
+    protocol phase in profiler traces and HLO metadata, which is what
+    lets ``scripts/profile_mesh.py`` attribute each censused collective
+    to a phase; scopes are metadata-only and change no values."""
+    with jax.named_scope("tick-prologue"):
+        n, k = params.n, params.k
+        m = min(params.alloc_per_tick, params.k, params.n)
+        maxp = jnp.int8(clamped_max_p(params))
+        key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
+        # incarnation epoch = tick counter (strictly increasing, like the
+        # reference's wall-ms but 200× denser in int32: 2^28 ticks ≈ 621 days of
+        # simulated time before the packed key would overflow)
+        now = state.tick + 1
+        i_all = jnp.arange(n, dtype=jnp.int32)
 
-    active = state.r_subject >= 0
-    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
-    # segment id n == dump bucket for free slots
-    subj = jnp.where(active, state.r_subject, jnp.int32(n))
-    subj_rumor_max = jnp.maximum(
-        jax.ops.segment_max(rkey, subj, num_segments=n + 1)[:n], jnp.int32(-1)
-    )
-    base_key = jnp.where(
-        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
-    )
-    eff_max = jnp.maximum(subj_rumor_max, base_key)
+        up = faults.up if faults.up is not None else jnp.ones(n, bool)
 
-    active_w = pack_bool(active)  # [W], tail bits zero
-
-    # -- ping target selection + belief gate --------------------------------
-    shift_mode = params.exchange == "shift"
-    if shift_mode:
-        shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
-        targets = (i_all + shift) % n
-        # belief[i] about its target: in shift mode each subject has
-        # exactly one prober i = (s - shift) mod n, so the dense masked
-        # reduce collapses to K bit-gathers + one scatter-max (identical
-        # values; the dense form is O(N·K))
-        prober = jnp.mod(state.r_subject - shift, n)
-        pbit = bit_column(_gather_rows(state.learned, jnp.clip(prober, 0, n - 1)), jnp.arange(k))
-        bel_vals = jnp.where(active & pbit, rkey, jnp.int32(-1))
-        bel_rumor = jnp.full((n,), -1, jnp.int32).at[
-            jnp.where(active, prober, jnp.int32(n))
-        ].max(bel_vals, mode="drop")
-    else:
-        targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
-        targets = jnp.where(targets >= i_all, targets + 1, targets)
-        learned0_b = unpack_bits(state.learned, k)
-        bel_rumor = _bel_rumor_dense(learned0_b, state.r_subject, rkey, active, targets)
-    bel = jnp.maximum(bel_rumor, base_key[targets])
-    bel_status = _status_of(jnp.maximum(bel, 0))
-    believes_pingable = (bel >= 0) & is_pingable(bel_status)
-    wants = up & believes_pingable
-
-    conn = _pair_connected(faults, i_all, targets)
-    if faults.drop_rate > 0:
-        conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
-    delivered = conn & wants
-
-    # -- piggyback exchange: request leg + response leg ---------------------
-    # (packed word ops in shift mode; the uniform path keeps the bool
-    # formulation — segment_max has no bitwise-OR combiner — and packs at
-    # the end.  Both produce identical bits.)
-    if shift_mode:
-        ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
-        dmask = row_mask(delivered)
-        riding_w = state.learned & ride_ok_w & active_w[None, :]
-        sent_w = riding_w & dmask
-        # rolls as explicit row gathers with precomputed index vectors:
-        # jnp.roll with a traced shift lowers to a slice-select chain that
-        # XLA re-derives PER CONSUMING ELEMENT when fused downstream
-        # (measured as the dominant cost of the tick); a gather through a
-        # materialized [N] index vector is one address lookup per element
-        # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
-        idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
-        idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
-        inbound_w = sent_w[idx_fwd]
-        got_pinged = delivered[idx_fwd]
-        learned1_w = state.learned | inbound_w
-        answerable_w = learned1_w & ride_ok_w & active_w[None, :]
-        resp_w = answerable_w[idx_back] & dmask
-        learned2_w = learned1_w | resp_w
-    else:
-        ride_ok_b = state.pcount < maxp
-        riding_b = learned0_b & active[None, :] & ride_ok_b
-        sent_b = riding_b & delivered[:, None]
-        inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
-        got_pinged = (
-            jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
+        active = state.r_subject >= 0
+        rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+        # segment id n == dump bucket for free slots
+        subj = jnp.where(active, state.r_subject, jnp.int32(n))
+        subj_rumor_max = jnp.maximum(
+            jax.ops.segment_max(rkey, subj, num_segments=n + 1)[:n], jnp.int32(-1)
         )
-        learned1_b = learned0_b | inbound_b
-        answerable_b = learned1_b & active[None, :] & ride_ok_b
-        resp_b = answerable_b[targets] & delivered[:, None]
-        learned2_b = learned1_b | resp_b
-        learned2_w = pack_bool(learned2_b)
+        base_key = jnp.where(
+            state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+        )
+        eff_max = jnp.maximum(subj_rumor_max, base_key)
 
-    # -- partition healer (heal_via_discover_provider.go, heal_partition.go):
-    # a discovery provider knows every address, so the heal channel ignores
-    # belief gating.  One probabilistic attempt per tick: a random connected
-    # pair swaps its full rumor set (the join + membership-merge of
-    # AttemptHeal); detractions thereby reach their subjects, whose
-    # refutations re-establish cross-partition liveness.
-    if params.heal_prob > 0:
-        kh1, kh2, kh3 = jax.random.split(k_heal, 3)
-        h = jax.random.randint(kh1, (), 0, n, dtype=jnp.int32)
-        p = jax.random.randint(kh2, (), 0, n, dtype=jnp.int32)
-        attempt = (
-            (jax.random.uniform(kh3, ()) < params.heal_prob)
-            & (h != p)
-            & up[h]
-            & up[p]
-            & _pair_connected(faults, h[None], p[None])[0]
-        )
-        # row reads via the two-level block pick (_gather_rows): a direct
-        # plane[h] at a traced index is a gather the SPMD partitioner can
-        # only serve by all-gathering the whole packed plane
-        heal_rows2 = jnp.stack([h, p])  # int32[2]
-        rows_hp = _gather_rows(learned2_w, heal_rows2)  # [2, W]
-        merged_row = (rows_hp[0] | rows_hp[1]) & active_w  # [W]
-        # apply the pair swap as a 2-row SCATTER, not dynamic_update_slices
-        # or a plane-wide select: a DUS whose operand is a fused producer
-        # makes XLA:CPU emit a full-plane copy fusion whose body RE-DERIVES
-        # the whole upstream chain per element (the round-4 HLO dump showed
-        # two 256 MB pcount copies with 153/120-op bodies — the dominant
-        # cost of the tick), and a where() against a thin row mask just
-        # fuses the same chain back into the big pass (measured 3.0 s/tick).
-        # A scatter is not elementwise, so XLA wraps it instead of fusing:
-        # the producer materializes once with a thin body and the 2-row
-        # update is O(2·K), in-place when the input buffer is dead.
-        learned2h_w = learned2_w.at[heal_rows2].set(
-            jnp.where(attempt, merged_row[None, :], rows_hp)
-        )
-        merged_bits = unpack_bits(merged_row, k)  # [K]
-    else:
-        learned2h_w = learned2_w
+        active_w = pack_bool(active)  # [W], tail bits zero
 
-    # -- pcount pass A: bump + newly-learned + heal resets ------------------
-    # (the unpacks fuse into this int8 pass; with gather-based rolls their
-    # producer chains are one lookup per element, so the fusion stays thin)
-    if shift_mode:
-        # bump = sent + (riding & got_pinged) = riding * (delivered + got):
-        # one packed-plane bit factor + per-row scalars (same restructure
-        # as delta.step — the sent plane's gather chain never has to be
-        # re-derived inside the int8 pass)
-        bump = unpack_bits(riding_w, k).astype(jnp.int8) * (
-            delivered.astype(jnp.int8) + got_pinged.astype(jnp.int8)
-        )[:, None]
-        newly_bit = unpack_bits(learned2_w & ~state.learned, k)
-    else:
-        bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
-            jnp.int8
-        )
-        newly_bit = learned2_b & ~learned0_b
-    pcount_a = jnp.minimum(state.pcount + bump, maxp)
-    pcount_a = jnp.where(newly_bit, jnp.int8(0), pcount_a)
-    if params.heal_prob > 0:
-        # heal resets (a join transfer restarts dissemination of everything
-        # it carried) as the same 2-row scatter shape as the learned-plane
-        # swap above — pass A materializes once with a thin body and the
-        # row writes are O(2·K); commutes with newly_bit's reset — both
-        # write zero
-        pcount_a = pcount_a.at[heal_rows2].set(
-            jnp.where(
-                attempt & merged_bits[None, :],
-                jnp.int8(0),
-                _gather_rows(pcount_a, heal_rows2),
+    with jax.named_scope("ping-target"):
+        # -- ping target selection + belief gate --------------------------------
+        shift_mode = params.exchange == "shift"
+        if shift_mode:
+            shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+            targets = (i_all + shift) % n
+            # belief[i] about its target: in shift mode each subject has
+            # exactly one prober i = (s - shift) mod n, so the dense masked
+            # reduce collapses to K bit-gathers + one scatter-max (identical
+            # values; the dense form is O(N·K))
+            prober = jnp.mod(state.r_subject - shift, n)
+            pbit = bit_column(_gather_rows(state.learned, jnp.clip(prober, 0, n - 1)), jnp.arange(k))
+            bel_vals = jnp.where(active & pbit, rkey, jnp.int32(-1))
+            bel_rumor = jnp.full((n,), -1, jnp.int32).at[
+                jnp.where(active, prober, jnp.int32(n))
+            ].max(bel_vals, mode="drop")
+        else:
+            targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+            targets = jnp.where(targets >= i_all, targets + 1, targets)
+            learned0_b = unpack_bits(state.learned, k)
+            bel_rumor = _bel_rumor_dense(learned0_b, state.r_subject, rkey, active, targets)
+        bel = jnp.maximum(bel_rumor, base_key[targets])
+        bel_status = _status_of(jnp.maximum(bel, 0))
+        believes_pingable = (bel >= 0) & is_pingable(bel_status)
+        wants = up & believes_pingable
+
+    with jax.named_scope("rumor-exchange"):
+        conn = _pair_connected(faults, i_all, targets)
+        if faults.drop_rate > 0:
+            conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
+        delivered = conn & wants
+
+        # -- piggyback exchange: request leg + response leg ---------------------
+        # (packed word ops in shift mode; the uniform path keeps the bool
+        # formulation — segment_max has no bitwise-OR combiner — and packs at
+        # the end.  Both produce identical bits.)
+        if shift_mode:
+            ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
+            dmask = row_mask(delivered)
+            riding_w = state.learned & ride_ok_w & active_w[None, :]
+            sent_w = riding_w & dmask
+            # rolls as explicit row gathers with precomputed index vectors:
+            # jnp.roll with a traced shift lowers to a slice-select chain that
+            # XLA re-derives PER CONSUMING ELEMENT when fused downstream
+            # (measured as the dominant cost of the tick); a gather through a
+            # materialized [N] index vector is one address lookup per element
+            # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
+            idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
+            idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
+            inbound_w = sent_w[idx_fwd]
+            got_pinged = delivered[idx_fwd]
+            learned1_w = state.learned | inbound_w
+            answerable_w = learned1_w & ride_ok_w & active_w[None, :]
+            resp_w = answerable_w[idx_back] & dmask
+            learned2_w = learned1_w | resp_w
+        else:
+            ride_ok_b = state.pcount < maxp
+            riding_b = learned0_b & active[None, :] & ride_ok_b
+            sent_b = riding_b & delivered[:, None]
+            inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
+            got_pinged = (
+                jax.ops.segment_max(delivered.astype(jnp.int8), targets, num_segments=n) > 0
             )
+            learned1_b = learned0_b | inbound_b
+            answerable_b = learned1_b & active[None, :] & ride_ok_b
+            resp_b = answerable_b[targets] & delivered[:, None]
+            learned2_b = learned1_b | resp_b
+            learned2_w = pack_bool(learned2_b)
+
+    with jax.named_scope("heal"):
+        # -- partition healer (heal_via_discover_provider.go, heal_partition.go):
+        # a discovery provider knows every address, so the heal channel ignores
+        # belief gating.  One probabilistic attempt per tick: a random connected
+        # pair swaps its full rumor set (the join + membership-merge of
+        # AttemptHeal); detractions thereby reach their subjects, whose
+        # refutations re-establish cross-partition liveness.
+        if params.heal_prob > 0:
+            kh1, kh2, kh3 = jax.random.split(k_heal, 3)
+            h = jax.random.randint(kh1, (), 0, n, dtype=jnp.int32)
+            p = jax.random.randint(kh2, (), 0, n, dtype=jnp.int32)
+            attempt = (
+                (jax.random.uniform(kh3, ()) < params.heal_prob)
+                & (h != p)
+                & up[h]
+                & up[p]
+                & _pair_connected(faults, h[None], p[None])[0]
+            )
+            # row reads via the two-level block pick (_gather_rows): a direct
+            # plane[h] at a traced index is a gather the SPMD partitioner can
+            # only serve by all-gathering the whole packed plane
+            heal_rows2 = jnp.stack([h, p])  # int32[2]
+            rows_hp = _gather_rows(learned2_w, heal_rows2)  # [2, W]
+            merged_row = (rows_hp[0] | rows_hp[1]) & active_w  # [W]
+            # apply the pair swap as a 2-row SCATTER, not dynamic_update_slices
+            # or a plane-wide select: a DUS whose operand is a fused producer
+            # makes XLA:CPU emit a full-plane copy fusion whose body RE-DERIVES
+            # the whole upstream chain per element (the round-4 HLO dump showed
+            # two 256 MB pcount copies with 153/120-op bodies — the dominant
+            # cost of the tick), and a where() against a thin row mask just
+            # fuses the same chain back into the big pass (measured 3.0 s/tick).
+            # A scatter is not elementwise, so XLA wraps it instead of fusing:
+            # the producer materializes once with a thin body and the 2-row
+            # update is O(2·K), in-place when the input buffer is dead.
+            learned2h_w = learned2_w.at[heal_rows2].set(
+                jnp.where(attempt, merged_row[None, :], rows_hp)
+            )
+            merged_bits = unpack_bits(merged_row, k)  # [K]
+        else:
+            learned2h_w = learned2_w
+
+    with jax.named_scope("piggyback-counters"):
+        # -- pcount pass A: bump + newly-learned + heal resets ------------------
+        # (the unpacks fuse into this int8 pass; with gather-based rolls their
+        # producer chains are one lookup per element, so the fusion stays thin)
+        if shift_mode:
+            # bump = sent + (riding & got_pinged) = riding * (delivered + got):
+            # one packed-plane bit factor + per-row scalars (same restructure
+            # as delta.step — the sent plane's gather chain never has to be
+            # re-derived inside the int8 pass)
+            bump = unpack_bits(riding_w, k).astype(jnp.int8) * (
+                delivered.astype(jnp.int8) + got_pinged.astype(jnp.int8)
+            )[:, None]
+            newly_bit = unpack_bits(learned2_w & ~state.learned, k)
+        else:
+            bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
+                jnp.int8
+            )
+            newly_bit = learned2_b & ~learned0_b
+        pcount_a = jnp.minimum(state.pcount + bump, maxp)
+        pcount_a = jnp.where(newly_bit, jnp.int8(0), pcount_a)
+        if params.heal_prob > 0:
+            # heal resets (a join transfer restarts dissemination of everything
+            # it carried) as the same 2-row scatter shape as the learned-plane
+            # swap above — pass A materializes once with a thin body and the
+            # row writes are O(2·K); commutes with newly_bit's reset — both
+            # write zero
+            pcount_a = pcount_a.at[heal_rows2].set(
+                jnp.where(
+                    attempt & merged_bits[None, :],
+                    jnp.int8(0),
+                    _gather_rows(pcount_a, heal_rows2),
+                )
+            )
+
+        # full-sync analog: re-seed rumors that expired short of full coverage
+        up_mask = row_mask(up)
+        mid_ride_w = pack_bool(pcount_a < maxp)  # reused for the carried gate below
+        riding_now_w = learned2h_w & mid_ride_w & active_w[None, :] & up_mask
+        fully_learned = unpack_bits(and_reduce_rows(learned2h_w | row_mask(~up)), k) & active
+        has_live_learner = unpack_bits(or_reduce_rows(learned2h_w & up_mask), k)
+        stuck = active & ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully_learned
+
+        state = state._replace(learned=learned2h_w, pcount=pcount_a)
+
+    with jax.named_scope("timers-fold"):
+        # -- timers fire: slot rumors (state_transitions.go:90-117) -------------
+        due = active & (state.tick >= state.r_deadline)
+        dominant = rkey >= eff_max[jnp.clip(subj, 0, n - 1)]
+        fire = due & dominant
+        fire_subj = jnp.clip(subj, 0, n - 1)
+        # a transition can only fire where some live node can seed the successor
+        # rumor (has_live_learner, from the packed OR-reduce above); otherwise
+        # the deadline persists and the slot is reclaimed below
+        fire_s = fire & (state.r_status == SUSPECT) & has_live_learner
+        fire_f = fire & (state.r_status == FAULTY) & has_live_learner
+        # eviction additionally waits for the tombstone to be fully disseminated
+        # (per-view eviction in the reference only completes once every node has
+        # learned it); an undisseminated tombstone's deadline simply refires
+        fire_t = fire & (state.r_status == TOMBSTONE) & fully_learned
+        slot_next = jnp.where(fire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))
+        slot_cand = jnp.where(
+            fire_s | fire_f, _key_of(state.r_inc, slot_next), jnp.int32(-1)
         )
+        fire_key = jnp.maximum(
+            jax.ops.segment_max(slot_cand, subj, num_segments=n + 1)[:n], jnp.int32(-1)
+        )
+        # seed for a fired transition: first live node that learned the rumor.
+        # The per-slot argmax over N is the single most expensive reduce in the
+        # tick (strided over the packed plane), and its result only matters on
+        # ticks where a suspect/faulty timer actually fired — so it runs under
+        # a cond (value-identical: when nothing fired, seed_node is -1 and the
+        # zeros never flow anywhere)
+        def _first_live_learner(_):
+            lb = unpack_bits(state.learned, k) & up[:, None]
+            return jnp.argmax(lb, axis=0).astype(jnp.int32)
 
-    # full-sync analog: re-seed rumors that expired short of full coverage
-    up_mask = row_mask(up)
-    mid_ride_w = pack_bool(pcount_a < maxp)  # reused for the carried gate below
-    riding_now_w = learned2h_w & mid_ride_w & active_w[None, :] & up_mask
-    fully_learned = unpack_bits(and_reduce_rows(learned2h_w | row_mask(~up)), k) & active
-    has_live_learner = unpack_bits(or_reduce_rows(learned2h_w & up_mask), k)
-    stuck = active & ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully_learned
+        slot_seed = jax.lax.cond(
+            (fire_s | fire_f).any(),
+            _first_live_learner,
+            lambda _: jnp.zeros((k,), jnp.int32),
+            None,
+        )
+        seed_node = jnp.maximum(
+            jax.ops.segment_max(
+                jnp.where(fire_s | fire_f, slot_seed, jnp.int32(-1)), subj, num_segments=n + 1
+            )[:n],
+            jnp.int32(-1),
+        )
+        # deadlines are NOT cleared here: a fired transition's deadline survives
+        # until its successor rumor actually allocates (deferred clear below), so
+        # K-slot saturation only delays the transition instead of dropping it
+        r_deadline = state.r_deadline
 
-    state = state._replace(learned=learned2h_w, pcount=pcount_a)
+        # dominated base timers cancel; due+dominant base timers fire
+        bdue = (state.base_pending >= 0) & (state.tick >= state.base_deadline) & state.base_present
+        bdom = base_key >= subj_rumor_max
+        bfire = bdue & bdom
+        base_pending = jnp.where(bdue & ~bdom, jnp.int8(-1), state.base_pending)
+        bfire_s = bfire & (state.base_pending == SUSPECT)
+        bfire_f = bfire & (state.base_pending == FAULTY)
+        bfire_t = bfire & (state.base_pending == TOMBSTONE)
+        # (skip the argmax when no fault model: XLA constant-folds it slowly)
+        first_live = jnp.argmax(up).astype(jnp.int32) if faults.up is not None else jnp.int32(0)
+        bfire_key = jnp.where(
+            bfire_s | bfire_f,
+            _key_of(state.base_inc, jnp.where(bfire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))),
+            jnp.int32(-1),
+        )
+        # seed at whichever candidate won the key merge: slot-fired rumors keep
+        # their first live learner; base-fired transitions (no learner set) seed
+        # at the first live node.  Ties keep the slot's learner.
+        seed_node = jnp.where(bfire_key > fire_key, first_live, seed_node)
+        fire_key = jnp.maximum(fire_key, bfire_key)
 
-    # -- timers fire: slot rumors (state_transitions.go:90-117) -------------
-    due = active & (state.tick >= state.r_deadline)
-    dominant = rkey >= eff_max[jnp.clip(subj, 0, n - 1)]
-    fire = due & dominant
-    fire_subj = jnp.clip(subj, 0, n - 1)
-    # a transition can only fire where some live node can seed the successor
-    # rumor (has_live_learner, from the packed OR-reduce above); otherwise
-    # the deadline persists and the slot is reclaimed below
-    fire_s = fire & (state.r_status == SUSPECT) & has_live_learner
-    fire_f = fire & (state.r_status == FAULTY) & has_live_learner
-    # eviction additionally waits for the tombstone to be fully disseminated
-    # (per-view eviction in the reference only completes once every node has
-    # learned it); an undisseminated tombstone's deadline simply refires
-    fire_t = fire & (state.r_status == TOMBSTONE) & fully_learned
-    slot_next = jnp.where(fire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))
-    slot_cand = jnp.where(
-        fire_s | fire_f, _key_of(state.r_inc, slot_next), jnp.int32(-1)
-    )
-    fire_key = jnp.maximum(
-        jax.ops.segment_max(slot_cand, subj, num_segments=n + 1)[:n], jnp.int32(-1)
-    )
-    # seed for a fired transition: first live node that learned the rumor.
-    # The per-slot argmax over N is the single most expensive reduce in the
-    # tick (strided over the packed plane), and its result only matters on
-    # ticks where a suspect/faulty timer actually fired — so it runs under
-    # a cond (value-identical: when nothing fired, seed_node is -1 and the
-    # zeros never flow anywhere)
-    def _first_live_learner(_):
-        lb = unpack_bits(state.learned, k) & up[:, None]
-        return jnp.argmax(lb, axis=0).astype(jnp.int32)
+        # -- evictions (tombstone timer expired; memberlist.Evict analog) -------
+        evicted = jnp.zeros((n,), bool).at[jnp.clip(subj, 0, n - 1)].max(fire_t) | bfire_t
+        base_present = state.base_present & ~evicted
+        freed_by_evict = active & evicted[jnp.clip(subj, 0, n - 1)]
 
-    slot_seed = jax.lax.cond(
-        (fire_s | fire_f).any(),
-        _first_live_learner,
-        lambda _: jnp.zeros((k,), jnp.int32),
-        None,
-    )
-    seed_node = jnp.maximum(
-        jax.ops.segment_max(
-            jnp.where(fire_s | fire_f, slot_seed, jnp.int32(-1)), subj, num_segments=n + 1
-        )[:n],
-        jnp.int32(-1),
-    )
-    # deadlines are NOT cleared here: a fired transition's deadline survives
-    # until its successor rumor actually allocates (deferred clear below), so
-    # K-slot saturation only delays the transition instead of dropping it
-    r_deadline = state.r_deadline
-
-    # dominated base timers cancel; due+dominant base timers fire
-    bdue = (state.base_pending >= 0) & (state.tick >= state.base_deadline) & state.base_present
-    bdom = base_key >= subj_rumor_max
-    bfire = bdue & bdom
-    base_pending = jnp.where(bdue & ~bdom, jnp.int8(-1), state.base_pending)
-    bfire_s = bfire & (state.base_pending == SUSPECT)
-    bfire_f = bfire & (state.base_pending == FAULTY)
-    bfire_t = bfire & (state.base_pending == TOMBSTONE)
-    # (skip the argmax when no fault model: XLA constant-folds it slowly)
-    first_live = jnp.argmax(up).astype(jnp.int32) if faults.up is not None else jnp.int32(0)
-    bfire_key = jnp.where(
-        bfire_s | bfire_f,
-        _key_of(state.base_inc, jnp.where(bfire_s, jnp.int8(FAULTY), jnp.int8(TOMBSTONE))),
-        jnp.int32(-1),
-    )
-    # seed at whichever candidate won the key merge: slot-fired rumors keep
-    # their first live learner; base-fired transitions (no learner set) seed
-    # at the first live node.  Ties keep the slot's learner.
-    seed_node = jnp.where(bfire_key > fire_key, first_live, seed_node)
-    fire_key = jnp.maximum(fire_key, bfire_key)
-
-    # -- evictions (tombstone timer expired; memberlist.Evict analog) -------
-    evicted = jnp.zeros((n,), bool).at[jnp.clip(subj, 0, n - 1)].max(fire_t) | bfire_t
-    base_present = state.base_present & ~evicted
-    freed_by_evict = active & evicted[jnp.clip(subj, 0, n - 1)]
-
-    # -- fold fully-learned dominant rumors into the base -------------------
-    foldable = fully_learned & (rkey >= eff_max[jnp.clip(subj, 0, n - 1)]) & ~freed_by_evict
-    folded_key = jnp.maximum(
-        jax.ops.segment_max(jnp.where(foldable, rkey, jnp.int32(-1)), subj, num_segments=n + 1)[:n],
-        jnp.int32(-1),
-    )
-    fold_mask = folded_key >= 0
-    base_status = jnp.where(fold_mask, _status_of(jnp.maximum(folded_key, 0)), state.base_status)
-    base_inc = jnp.where(fold_mask, _inc_of(jnp.maximum(folded_key, 0)), state.base_inc)
-    # folding any rumor (re-)establishes the subject in the base — this is
-    # how an admitted/rejoining member becomes part of the converged view
-    base_present = base_present | fold_mask
-    # transfer the folded rumor's pending deadline to the base timer
-    fold_dl = jax.ops.segment_min(
-        jnp.where(
-            foldable & (rkey == folded_key[jnp.clip(subj, 0, n - 1)]),
-            r_deadline,
-            NO_DEADLINE,
-        ),
-        subj,
-        num_segments=n + 1,
-    )[:n]
-    base_pending = jnp.where(
-        fold_mask,
-        jnp.where(fold_dl < NO_DEADLINE, _status_of(jnp.maximum(folded_key, 0)), jnp.int8(-1)),
-        base_pending,
-    )
-    base_deadline = jnp.where(fold_mask, fold_dl, state.base_deadline)
-    # free every slot of a folded subject (all are dominated by the base
-    # now), plus dead rumors whose only learners have crashed — freeing them
-    # drops eff_max so a live prober can re-declare from scratch
-    freed = (
-        freed_by_evict
-        | (active & fold_mask[jnp.clip(subj, 0, n - 1)])
-        | (active & ~has_live_learner)
-    )
-    r_subject = jnp.where(freed, jnp.int32(-1), state.r_subject)
-    learned3_w = state.learned & ~pack_bool(freed)[None, :]
-    active = r_subject >= 0
-    base_key = jnp.where(base_present, _key_of(base_inc, base_status), jnp.int32(-1))
-    subj = jnp.where(active, r_subject, jnp.int32(n))
-    subj_rumor_max = jnp.maximum(
-        jax.ops.segment_max(
-            jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1)),
+        # -- fold fully-learned dominant rumors into the base -------------------
+        foldable = fully_learned & (rkey >= eff_max[jnp.clip(subj, 0, n - 1)]) & ~freed_by_evict
+        folded_key = jnp.maximum(
+            jax.ops.segment_max(jnp.where(foldable, rkey, jnp.int32(-1)), subj, num_segments=n + 1)[:n],
+            jnp.int32(-1),
+        )
+        fold_mask = folded_key >= 0
+        base_status = jnp.where(fold_mask, _status_of(jnp.maximum(folded_key, 0)), state.base_status)
+        base_inc = jnp.where(fold_mask, _inc_of(jnp.maximum(folded_key, 0)), state.base_inc)
+        # folding any rumor (re-)establishes the subject in the base — this is
+        # how an admitted/rejoining member becomes part of the converged view
+        base_present = base_present | fold_mask
+        # transfer the folded rumor's pending deadline to the base timer
+        fold_dl = jax.ops.segment_min(
+            jnp.where(
+                foldable & (rkey == folded_key[jnp.clip(subj, 0, n - 1)]),
+                r_deadline,
+                NO_DEADLINE,
+            ),
             subj,
             num_segments=n + 1,
-        )[:n],
-        jnp.int32(-1),
-    )
-    eff_max = jnp.maximum(subj_rumor_max, base_key)
-
-    # -- refutation candidates (memberlist.go:337-354) ----------------------
-    # only (node == slot subject) pairs can self-detect a detraction, so
-    # the dense [N, K] mask collapses to K bit-gathers + one scatter-OR
-    # (identical values to the original any-reduce)
-    subj_c = jnp.clip(subj, 0, n - 1)
-    own_bit = bit_column(learned3_w[subj_c], jnp.arange(k))
-    slot_self_detract = (
-        active
-        & own_bit
-        & _is_detraction(state.r_status)
-        & (state.r_inc >= state.self_inc[subj_c])
-    )
-    self_detract = (
-        jnp.zeros((n,), bool)
-        .at[jnp.where(active, subj, jnp.int32(n))]
-        .max(slot_self_detract, mode="drop")
-    )
-    base_detract = (
-        _is_detraction(base_status) & (base_inc >= state.self_inc) & base_present
-    )
-    refute = up & (self_detract | base_detract)
-    refute_key = jnp.where(refute, _key_of(now, jnp.int8(ALIVE)), jnp.int32(-1))
-
-    # -- failed probe → indirect probes → Suspect (node.go:494-510) ---------
-    probing = wants & ~conn
-    k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
-    peer_choices = jax.random.randint(
-        k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
-    )
-    i_bcast = jnp.broadcast_to(i_all[:, None], peer_choices.shape)
-    peer_ok = (
-        _pair_connected(faults, i_bcast, peer_choices)
-        & (peer_choices != i_bcast)
-        & (peer_choices != targets[:, None])
-    )
-    peer_reaches = (
-        peer_ok
-        & _pair_connected(faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape))
-        & up[targets][:, None]
-    )
-    # each indirect leg is its own RPC and suffers packet loss too
-    if faults.drop_rate > 0:
-        peer_ok &= jax.random.uniform(k_pd1, peer_choices.shape) >= faults.drop_rate
-        peer_reaches &= peer_ok & (
-            jax.random.uniform(k_pd2, peer_choices.shape) >= faults.drop_rate
+        )[:n]
+        base_pending = jnp.where(
+            fold_mask,
+            jnp.where(fold_dl < NO_DEADLINE, _status_of(jnp.maximum(folded_key, 0)), jnp.int8(-1)),
+            base_pending,
         )
-    reached = peer_reaches.any(axis=1)
-    inconclusive = (~peer_ok).all(axis=1)
-    declare = probing & ~reached & ~inconclusive
-    susp_cand = jnp.where(
-        declare, _key_of(_inc_of(jnp.maximum(bel, 0)), jnp.int8(SUSPECT)), jnp.int32(-1)
-    )
-    susp_key = jnp.maximum(
-        jax.ops.segment_max(
-            susp_cand, jnp.where(declare, targets, jnp.int32(n)), num_segments=n + 1
-        )[:n],
-        jnp.int32(-1),
-    )
-    susp_key = jnp.where(susp_key > eff_max, susp_key, jnp.int32(-1))
+        base_deadline = jnp.where(fold_mask, fold_dl, state.base_deadline)
+        # free every slot of a folded subject (all are dominated by the base
+        # now), plus dead rumors whose only learners have crashed — freeing them
+        # drops eff_max so a live prober can re-declare from scratch
+        freed = (
+            freed_by_evict
+            | (active & fold_mask[jnp.clip(subj, 0, n - 1)])
+            | (active & ~has_live_learner)
+        )
+        r_subject = jnp.where(freed, jnp.int32(-1), state.r_subject)
+        learned3_w = state.learned & ~pack_bool(freed)[None, :]
+        active = r_subject >= 0
+        base_key = jnp.where(base_present, _key_of(base_inc, base_status), jnp.int32(-1))
+        subj = jnp.where(active, r_subject, jnp.int32(n))
+        subj_rumor_max = jnp.maximum(
+            jax.ops.segment_max(
+                jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1)),
+                subj,
+                num_segments=n + 1,
+            )[:n],
+            jnp.int32(-1),
+        )
+        eff_max = jnp.maximum(subj_rumor_max, base_key)
 
-    # -- merge per-subject candidates & allocate into free slots ------------
-    cand = jnp.maximum(jnp.maximum(refute_key, susp_key), fire_key)
-    cand_vals, cand_subj = _top_m_sparse(cand, m)
-    free_vals, free_slots = jax.lax.top_k((~active).astype(jnp.int32), m)
-    place = (cand_vals >= 0) & (free_vals == 1)
+    with jax.named_scope("candidate-select"):
+        # -- refutation candidates (memberlist.go:337-354) ----------------------
+        # only (node == slot subject) pairs can self-detect a detraction, so
+        # the dense [N, K] mask collapses to K bit-gathers + one scatter-OR
+        # (identical values to the original any-reduce)
+        subj_c = jnp.clip(subj, 0, n - 1)
+        own_bit = bit_column(learned3_w[subj_c], jnp.arange(k))
+        slot_self_detract = (
+            active
+            & own_bit
+            & _is_detraction(state.r_status)
+            & (state.r_inc >= state.self_inc[subj_c])
+        )
+        self_detract = (
+            jnp.zeros((n,), bool)
+            .at[jnp.where(active, subj, jnp.int32(n))]
+            .max(slot_self_detract, mode="drop")
+        )
+        base_detract = (
+            _is_detraction(base_status) & (base_inc >= state.self_inc) & base_present
+        )
+        refute = up & (self_detract | base_detract)
+        refute_key = jnp.where(refute, _key_of(now, jnp.int8(ALIVE)), jnp.int32(-1))
 
-    new_status = _status_of(jnp.maximum(cand_vals, 0))
-    new_inc = _inc_of(jnp.maximum(cand_vals, 0))
-    new_dl = jnp.where(
-        new_status == SUSPECT,
-        state.tick + params.suspect_ticks,
-        jnp.where(
-            new_status == FAULTY,
-            state.tick + params.faulty_ticks,
-            jnp.where(new_status == TOMBSTONE, state.tick + params.tombstone_ticks, NO_DEADLINE),
-        ),
-    )
-    r_subject = r_subject.at[free_slots].set(jnp.where(place, cand_subj, r_subject[free_slots]))
-    r_inc = state.r_inc.at[free_slots].set(jnp.where(place, new_inc, state.r_inc[free_slots]))
-    r_status = state.r_status.at[free_slots].set(
-        jnp.where(place, new_status, state.r_status[free_slots])
-    )
-    r_deadline = r_deadline.at[free_slots].set(jnp.where(place, new_dl, r_deadline[free_slots]))
+        # -- failed probe → indirect probes → Suspect (node.go:494-510) ---------
+        probing = wants & ~conn
+        k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
+        peer_choices = jax.random.randint(
+            k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
+        )
+        i_bcast = jnp.broadcast_to(i_all[:, None], peer_choices.shape)
+        peer_ok = (
+            _pair_connected(faults, i_bcast, peer_choices)
+            & (peer_choices != i_bcast)
+            & (peer_choices != targets[:, None])
+        )
+        peer_reaches = (
+            peer_ok
+            & _pair_connected(faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape))
+            & up[targets][:, None]
+        )
+        # each indirect leg is its own RPC and suffers packet loss too
+        if faults.drop_rate > 0:
+            peer_ok &= jax.random.uniform(k_pd1, peer_choices.shape) >= faults.drop_rate
+            peer_reaches &= peer_ok & (
+                jax.random.uniform(k_pd2, peer_choices.shape) >= faults.drop_rate
+            )
+        reached = peer_reaches.any(axis=1)
+        inconclusive = (~peer_ok).all(axis=1)
+        declare = probing & ~reached & ~inconclusive
+        susp_cand = jnp.where(
+            declare, _key_of(_inc_of(jnp.maximum(bel, 0)), jnp.int8(SUSPECT)), jnp.int32(-1)
+        )
+        susp_key = jnp.maximum(
+            jax.ops.segment_max(
+                susp_cand, jnp.where(declare, targets, jnp.int32(n)), num_segments=n + 1
+            )[:n],
+            jnp.int32(-1),
+        )
+        susp_key = jnp.where(susp_key > eff_max, susp_key, jnp.int32(-1))
 
-    # fresh slots start unlearned, then get seeded
-    placed_col = jnp.zeros((k,), bool).at[free_slots].set(place)
-    learned4_w = learned3_w & ~pack_bool(placed_col)[None, :]
+        # -- merge per-subject candidates & allocate into free slots ------------
+        cand = jnp.maximum(jnp.maximum(refute_key, susp_key), fire_key)
+        cand_vals, cand_subj = _top_m_sparse(cand, m)
+        free_vals, free_slots = jax.lax.top_k((~active).astype(jnp.int32), m)
+    with jax.named_scope("alloc-seed"):
+        place = (cand_vals >= 0) & (free_vals == 1)
 
-    # seed row per placed candidate: refute → the subject itself; timer
-    # transition → first live learner of the precursor rumor.  Fresh suspect
-    # rumors are seeded by their declarers below, not here.
-    seed_rows = jnp.where(new_status == ALIVE, cand_subj, seed_node[cand_subj])
-    seed_ok = place & (new_status != SUSPECT) & (seed_rows >= 0)
-    learned5_w = set_bit(
-        learned4_w, jnp.clip(seed_rows, 0, n - 1), free_slots, seed_ok
-    )
-    # suspect rumors: every declarer that targeted the subject seeds it
-    subj_to_slot = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
-        jnp.where(place & (new_status == SUSPECT), free_slots, jnp.int32(-1))
-    )
-    decl_slot = subj_to_slot[targets]
-    decl_ok = declare & (decl_slot >= 0)
-    # every-row seeding (rows == iota): the elementwise one-hot form — a
-    # scatter here made the partitioner all-gather [N]-sized index/update
-    # tensors (see packbits.set_bit_per_row)
-    learned6_w = set_bit_per_row(learned5_w, jnp.clip(decl_slot, 0, k - 1), decl_ok)
+        new_status = _status_of(jnp.maximum(cand_vals, 0))
+        new_inc = _inc_of(jnp.maximum(cand_vals, 0))
+        new_dl = jnp.where(
+            new_status == SUSPECT,
+            state.tick + params.suspect_ticks,
+            jnp.where(
+                new_status == FAULTY,
+                state.tick + params.faulty_ticks,
+                jnp.where(new_status == TOMBSTONE, state.tick + params.tombstone_ticks, NO_DEADLINE),
+            ),
+        )
+        r_subject = r_subject.at[free_slots].set(jnp.where(place, cand_subj, r_subject[free_slots]))
+        r_inc = state.r_inc.at[free_slots].set(jnp.where(place, new_inc, state.r_inc[free_slots]))
+        r_status = state.r_status.at[free_slots].set(
+            jnp.where(place, new_status, state.r_status[free_slots])
+        )
+        r_deadline = r_deadline.at[free_slots].set(jnp.where(place, new_dl, r_deadline[free_slots]))
 
-    # -- pcount pass B: the deferred stuck/freed/placed clears (one fused
-    # read/write; all resets-to-zero commute with pass A's) ----------------
-    pcount_final = jnp.where(
-        (freed | placed_col)[None, :]
-        | (stuck[None, :] & unpack_bits(learned2h_w, k)),
-        jnp.int8(0),
-        pcount_a,
-    )
-    # maintain the carried gate invariant ride_ok == pack(pcount < maxp):
-    # a reset-to-zero opens the gate iff maxp > 0 (degenerate max_p=0
-    # configs never ride)
-    reset_w = (
-        pack_bool(freed | placed_col)[None, :]
-        | (pack_bool(stuck)[None, :] & learned2h_w)
-    ) & jnp.where(maxp > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    ride_next = mid_ride_w | reset_w
+        # fresh slots start unlearned, then get seeded
+        placed_col = jnp.zeros((k,), bool).at[free_slots].set(place)
+        learned4_w = learned3_w & ~pack_bool(placed_col)[None, :]
 
-    # refutation bumps the refuter's own incarnation (iff its rumor placed)
-    placed_subject = jnp.zeros((n,), bool).at[cand_subj].max(place & (new_status == ALIVE))
-    self_inc = jnp.where(refute & placed_subject, now, state.self_inc)
+        # seed row per placed candidate: refute → the subject itself; timer
+        # transition → first live learner of the precursor rumor.  Fresh suspect
+        # rumors are seeded by their declarers below, not here.
+        seed_rows = jnp.where(new_status == ALIVE, cand_subj, seed_node[cand_subj])
+        seed_ok = place & (new_status != SUSPECT) & (seed_rows >= 0)
+        learned5_w = set_bit(
+            learned4_w, jnp.clip(seed_rows, 0, n - 1), free_slots, seed_ok
+        )
+        # suspect rumors: every declarer that targeted the subject seeds it
+        subj_to_slot = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
+            jnp.where(place & (new_status == SUSPECT), free_slots, jnp.int32(-1))
+        )
+        decl_slot = subj_to_slot[targets]
+        decl_ok = declare & (decl_slot >= 0)
+        # every-row seeding (rows == iota): the elementwise one-hot form — a
+        # scatter here made the partitioner all-gather [N]-sized index/update
+        # tensors (see packbits.set_bit_per_row)
+        learned6_w = set_bit_per_row(learned5_w, jnp.clip(decl_slot, 0, k - 1), decl_ok)
 
-    # deferred timer clears: a fired suspect/faulty timer only retires once a
-    # rumor at least as strong as its successor was actually allocated for
-    # its subject (otherwise it refires next tick and retries)
-    placed_key = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
-        jnp.where(place, cand_vals, jnp.int32(-1))
-    )
-    slot_fired_ok = (
-        (fire_s | fire_f) & (placed_key[fire_subj] >= slot_cand) & ~placed_col
-    )
-    r_deadline = jnp.where(slot_fired_ok, NO_DEADLINE, r_deadline)
-    base_fired_ok = (
-        (bfire_s | bfire_f) & (bfire_key >= 0) & (placed_key >= bfire_key)
-    ) | bfire_t
-    base_pending = jnp.where(base_fired_ok, jnp.int8(-1), base_pending)
+    with jax.named_scope("piggyback-counters"):
+        # -- pcount pass B: the deferred stuck/freed/placed clears (one fused
+        # read/write; all resets-to-zero commute with pass A's) ----------------
+        pcount_final = jnp.where(
+            (freed | placed_col)[None, :]
+            | (stuck[None, :] & unpack_bits(learned2h_w, k)),
+            jnp.int8(0),
+            pcount_a,
+        )
+        # maintain the carried gate invariant ride_ok == pack(pcount < maxp):
+        # a reset-to-zero opens the gate iff maxp > 0 (degenerate max_p=0
+        # configs never ride)
+        reset_w = (
+            pack_bool(freed | placed_col)[None, :]
+            | (pack_bool(stuck)[None, :] & learned2h_w)
+        ) & jnp.where(maxp > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        ride_next = mid_ride_w | reset_w
 
-    return LifecycleState(
+    with jax.named_scope("commit"):
+        # refutation bumps the refuter's own incarnation (iff its rumor placed)
+        placed_subject = jnp.zeros((n,), bool).at[cand_subj].max(place & (new_status == ALIVE))
+        self_inc = jnp.where(refute & placed_subject, now, state.self_inc)
+
+        # deferred timer clears: a fired suspect/faulty timer only retires once a
+        # rumor at least as strong as its successor was actually allocated for
+        # its subject (otherwise it refires next tick and retries)
+        placed_key = jnp.full((n,), -1, jnp.int32).at[cand_subj].set(
+            jnp.where(place, cand_vals, jnp.int32(-1))
+        )
+        slot_fired_ok = (
+            (fire_s | fire_f) & (placed_key[fire_subj] >= slot_cand) & ~placed_col
+        )
+        r_deadline = jnp.where(slot_fired_ok, NO_DEADLINE, r_deadline)
+        base_fired_ok = (
+            (bfire_s | bfire_f) & (bfire_key >= 0) & (placed_key >= bfire_key)
+        ) | bfire_t
+        base_pending = jnp.where(base_fired_ok, jnp.int8(-1), base_pending)
+
+    new_state = LifecycleState(
         r_subject=r_subject,
         r_inc=r_inc,
         r_status=r_status,
@@ -835,6 +858,47 @@ def step(
         tick=state.tick + 1,
         key=key,
     )
+    if telemetry is None:
+        return new_state
+
+    # -- telemetry: pure reductions over intermediates the tick already
+    # materialized — nothing above this point changes, so telemetry-on is
+    # bit-identical to telemetry-off by construction (certified by
+    # tests/test_telemetry.py and the make telemetry-smoke pairing)
+    with jax.named_scope("telemetry"):
+        from ringpop_tpu.sim import telemetry as _tm
+
+        if shift_mode:
+            t_sent_w, t_resp_w = sent_w, resp_w
+        else:
+            t_sent_w, t_resp_w = pack_bool(sent_b), pack_bool(resp_b)
+        telemetry = _tm.accumulate(
+            telemetry,
+            delivered=delivered,
+            probing=probing,
+            ping_req_legs=jnp.where(
+                probing, peer_ok.sum(axis=1, dtype=jnp.int32), jnp.int32(0)
+            ),
+            refuted=refute & placed_subject,
+            sent_w=t_sent_w,
+            resp_w=t_resp_w,
+            # ride gates that closed this tick (piggyback budget exhausted);
+            # state.ride_ok is still the tick-entry gate — the _replace
+            # above only swapped learned/pcount
+            closed_w=state.ride_ok & ~mid_ride_w,
+            # count timers at RETIREMENT, not firing: a fired timer that
+            # couldn't place its successor (K-slot/alloc saturation, or a
+            # tombstone short of full dissemination) refires every tick
+            # until it lands, and counting raw fires would journal one
+            # logical transition dozens of times — the host plane counts
+            # each transition once
+            fired=slot_fired_ok | fire_t,
+            base_fired=base_fired_ok,
+            place=place,
+            new_status=new_status,
+            heal_attempt=attempt if params.heal_prob > 0 else None,
+        )
+    return new_state, telemetry
 
 
 def state_shardings(mesh, k: Optional[int] = None) -> LifecycleState:
@@ -1054,33 +1118,34 @@ def detection_complete(
     iteration (see :func:`_walk_subject_slots`).  Purely a layout hint;
     values are bit-identical with or without it.
     """
-    n, _ = state.learned.shape
-    subjects = jnp.asarray(subjects, jnp.int32)
+    with jax.named_scope("detect-walk"):
+        n, _ = state.learned.shape
+        subjects = jnp.asarray(subjects, jnp.int32)
 
-    base_bad = state.base_present & (state.base_status < min_status)  # [N]
-    base_key = jnp.where(
-        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
-    )  # [N], indexed by subject id
+        base_bad = state.base_present & (state.base_status < min_status)  # [N]
+        base_key = jnp.where(
+            state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+        )  # [N], indexed by subject id
 
-    up = faults.up if faults.up is not None else jnp.ones(n, bool)
-    is_subject = jnp.zeros(n, bool).at[subjects].set(True)
-    obs = up & ~is_subject
-    has_obs = obs.any()
+        up = faults.up if faults.up is not None else jnp.ones(n, bool)
+        is_subject = jnp.zeros(n, bool).at[subjects].set(True)
+        obs = up & ~is_subject
+        has_obs = obs.any()
 
-    def finalize(anybad, s, m, fin):
-        bad_any = (obs & (m >= 0) & (_status_of(jnp.maximum(m, 0)) < min_status)).any()
-        return anybad.at[jnp.where(fin, s, n)].set(
-            jnp.where(fin, bad_any, False), mode="drop"
+        def finalize(anybad, s, m, fin):
+            bad_any = (obs & (m >= 0) & (_status_of(jnp.maximum(m, 0)) < min_status)).any()
+            return anybad.at[jnp.where(fin, s, n)].set(
+                jnp.where(fin, bad_any, False), mode="drop"
+            )
+
+        anybad = _walk_subject_slots(
+            state, base_key, jnp.zeros(n, bool), finalize,
+            learned_sharding=learned_sharding,
         )
-
-    anybad = _walk_subject_slots(
-        state, base_key, jnp.zeros(n, bool), finalize,
-        learned_sharding=learned_sharding,
-    )
-    not_detected = jnp.where(
-        _slot_covered(state), anybad, base_bad
-    )[subjects]
-    return has_obs & ~not_detected.any()
+        not_detected = jnp.where(
+            _slot_covered(state), anybad, base_bad
+        )[subjects]
+        return has_obs & ~not_detected.any()
 
 
 def _slot_covered(state: LifecycleState) -> jax.Array:
@@ -1116,59 +1181,51 @@ def _walk_subject_slots(state: LifecycleState, base_key, carry0, finalize,
     and pins the [K] walk metadata + ``base_key`` replicated, so every
     iteration's gathers are local and only ``finalize``'s scalar reduce
     crosses shards.  Pure layout hint — bit-identical values either way."""
-    n = state.learned.shape[0]
-    k = state.r_subject.shape[0]
-    learned = state.learned
-    active = state.r_subject >= 0
-    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
-    subj_or_sentinel = jnp.where(active, state.r_subject, jnp.int32(n))
-    order = jnp.lexsort((-rkey, subj_or_sentinel))
-    sorted_subj = subj_or_sentinel[order]
-    sorted_key = rkey[order]
-    is_last = sorted_subj != jnp.concatenate(
-        [sorted_subj[1:], jnp.full((1,), n + 1, jnp.int32)]
-    )
-    if learned_sharding is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        learned = jax.lax.with_sharding_constraint(learned, learned_sharding)
-        rep = NamedSharding(learned_sharding.mesh, PartitionSpec())
-        order, sorted_subj, sorted_key, is_last, base_key = (
-            jax.lax.with_sharding_constraint(x, rep)
-            for x in (order, sorted_subj, sorted_key, is_last, base_key)
+    with jax.named_scope("detect-walk"):
+        n = state.learned.shape[0]
+        k = state.r_subject.shape[0]
+        learned = state.learned
+        active = state.r_subject >= 0
+        rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+        subj_or_sentinel = jnp.where(active, state.r_subject, jnp.int32(n))
+        order = jnp.lexsort((-rkey, subj_or_sentinel))
+        sorted_subj = subj_or_sentinel[order]
+        sorted_key = rkey[order]
+        is_last = sorted_subj != jnp.concatenate(
+            [sorted_subj[1:], jnp.full((1,), n + 1, jnp.int32)]
         )
+        if learned_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-    def body(j, c):
-        best, carry = c
-        s = sorted_subj[j]
-        valid = s < n
-        # slot order[j]'s learned column, extracted from the packed plane
-        # (the pre-pack code materialized a [K, N] transpose here)
-        lcol = bit_column(learned, order[j])
-        best = jnp.where(lcol & valid, jnp.maximum(best, sorted_key[j]), best)
-        m = jnp.maximum(best, base_key[jnp.minimum(s, n - 1)])
-        fin = is_last[j] & valid
-        carry = finalize(carry, jnp.minimum(s, n - 1), m, fin)
-        best = jnp.where(fin, jnp.int32(-1), best)
-        return best, carry
+            learned = jax.lax.with_sharding_constraint(learned, learned_sharding)
+            rep = NamedSharding(learned_sharding.mesh, PartitionSpec())
+            order, sorted_subj, sorted_key, is_last, base_key = (
+                jax.lax.with_sharding_constraint(x, rep)
+                for x in (order, sorted_subj, sorted_key, is_last, base_key)
+            )
 
-    best0 = jnp.full(n, -1, jnp.int32)
-    _, carry = jax.lax.fori_loop(0, k, body, (best0, carry0))
-    return carry
+        def body(j, c):
+            best, carry = c
+            s = sorted_subj[j]
+            valid = s < n
+            # slot order[j]'s learned column, extracted from the packed plane
+            # (the pre-pack code materialized a [K, N] transpose here)
+            lcol = bit_column(learned, order[j])
+            best = jnp.where(lcol & valid, jnp.maximum(best, sorted_key[j]), best)
+            m = jnp.maximum(best, base_key[jnp.minimum(s, n - 1)])
+            fin = is_last[j] & valid
+            carry = finalize(carry, jnp.minimum(s, n - 1), m, fin)
+            best = jnp.where(fin, jnp.int32(-1), best)
+            return best, carry
+
+        best0 = jnp.full(n, -1, jnp.int32)
+        _, carry = jax.lax.fori_loop(0, k, body, (best0, carry0))
+        return carry
 
 
-def _mix32(x: jax.Array) -> jax.Array:
-    """murmur3 fmix32: a full-avalanche integer mixer (public-domain
-    constants).  Used for the order-invariant view checksum below — NOT the
-    wire-compat farm32 (which needs the host's canonical sorted-string
-    encoding, ``memberlist.go:106-128``)."""
-    x = x.astype(jnp.uint32)
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x85EB_CA6B)
-    x = x ^ (x >> 13)
-    x = x * jnp.uint32(0xC2B2_AE35)
-    x = x ^ (x >> 16)
-    return x
+# murmur3 fmix32 (packbits.mix32) — the order-invariant view checksum's
+# per-member mixer; see that docstring for the wire-compat caveat
+_mix32 = mix32
 
 
 @jax.jit
@@ -1196,34 +1253,35 @@ def view_checksums(
     a node's own checksum is defined whether or not it is up (the
     reference's memberlist exists on a stopped node too).
     """
-    n = state.learned.shape[0]
-    del faults
+    with jax.named_scope("view-checksum"):
+        n = state.learned.shape[0]
+        del faults
 
-    active = state.r_subject >= 0
-    rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
-    base_key = jnp.where(
-        state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
-    )  # [N] indexed by subject id
+        active = state.r_subject >= 0
+        rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
+        base_key = jnp.where(
+            state.base_present, _key_of(state.base_inc, state.base_status), jnp.int32(-1)
+        )  # [N] indexed by subject id
 
-    def member_term(subject, key):
-        """Contribution of (subject, governing key) — zero when absent or
-        tombstoned (checksum exclusion per the reference)."""
-        include = (key >= 0) & (_status_of(jnp.maximum(key, 0)) != TOMBSTONE)
-        h = _mix32(_mix32(subject.astype(jnp.uint32)) ^ key.astype(jnp.uint32))
-        return jnp.where(include, h, jnp.uint32(0))
+        def member_term(subject, key):
+            """Contribution of (subject, governing key) — zero when absent or
+            tombstoned (checksum exclusion per the reference)."""
+            include = (key >= 0) & (_status_of(jnp.maximum(key, 0)) != TOMBSTONE)
+            h = _mix32(_mix32(subject.astype(jnp.uint32)) ^ key.astype(jnp.uint32))
+            return jnp.where(include, h, jnp.uint32(0))
 
-    def finalize(acc, s, m, fin):
-        return acc + jnp.where(fin, member_term(s, m), jnp.uint32(0))
+        def finalize(acc, s, m, fin):
+            return acc + jnp.where(fin, member_term(s, m), jnp.uint32(0))
 
-    acc = _walk_subject_slots(state, base_key, jnp.zeros(n, jnp.uint32), finalize)
+        acc = _walk_subject_slots(state, base_key, jnp.zeros(n, jnp.uint32), finalize)
 
-    # subjects with no in-flight rumor are identical in every view: one
-    # shared scalar term
-    i_all = jnp.arange(n, dtype=jnp.int32)
-    base_terms = jnp.where(
-        ~_slot_covered(state), member_term(i_all, base_key), jnp.uint32(0)
-    )
-    return acc + base_terms.sum(dtype=jnp.uint32)
+        # subjects with no in-flight rumor are identical in every view: one
+        # shared scalar term
+        i_all = jnp.arange(n, dtype=jnp.int32)
+        base_terms = jnp.where(
+            ~_slot_covered(state), member_term(i_all, base_key), jnp.uint32(0)
+        )
+        return acc + base_terms.sum(dtype=jnp.uint32)
 
 
 @jax.jit
@@ -1240,8 +1298,18 @@ def checksums_converged(
     return (jnp.where(up, cs, cs[first_live]) == cs[first_live]).all() & up.any()
 
 
-def _run_block(params: LifecycleParams, state, faults, ticks: int):
-    return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
+def _run_block(params: LifecycleParams, state, faults, ticks: int, telemetry=None):
+    """``ticks`` steps in one fused loop.  With a telemetry accumulator the
+    carry is the (state, telemetry) pair; with None the loop is exactly
+    the telemetry-free program (the None leg compiles out)."""
+    if telemetry is None:
+        return jax.lax.fori_loop(0, ticks, lambda _, s: step(params, s, faults), state)
+    return jax.lax.fori_loop(
+        0,
+        ticks,
+        lambda _, c: step(params, c[0], faults, telemetry=c[1]),
+        (state, telemetry),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("params", "block_ticks"))
@@ -1252,6 +1320,7 @@ def _run_until_converged_device(
     *,
     block_ticks: int,
     max_blocks: jax.Array,
+    telemetry=None,
 ):
     """Blocks + convergence test + early exit in one dispatch (the
     lifecycle analog of ``delta._run_until_converged_device``).
@@ -1259,14 +1328,24 @@ def _run_until_converged_device(
     remain in flight (no active rumor slots) AND all live checksums agree
     (``swim/test_utils.go:164-199`` — it ticks until the disseminators are
     empty and the checksums match).  Returns (state, blocks_run,
-    converged)."""
+    converged), with the accumulated telemetry appended when a telemetry
+    leg rides the carry (None compiles out — same program as before)."""
 
-    def quiescent(s):
+    def quiescent(c):
+        s = c[0]
         return ~(s.r_subject >= 0).any() & checksums_converged(s, faults)
 
-    return until_loop(
-        lambda s: _run_block(params, s, faults, block_ticks), state, max_blocks, quiescent
+    def run_block(c):
+        s, t = c
+        out = _run_block(params, s, faults, block_ticks, t)
+        return out if t is not None else (out, None)
+
+    (state, telemetry), blocks, done = until_loop(
+        run_block, (state, telemetry), max_blocks, quiescent
     )
+    if telemetry is None:
+        return state, blocks, done
+    return state, blocks, done, telemetry
 
 
 @functools.partial(
@@ -1283,6 +1362,7 @@ def _run_until_detected_device(
     block_ticks: int,
     max_blocks: jax.Array,
     learned_sharding=None,
+    telemetry=None,
 ):
     """Up to ``max_blocks`` blocks of ``block_ticks`` ticks with the
     detection test INSIDE the jitted loop — one dispatch, one readback.
@@ -1296,37 +1376,103 @@ def _run_until_detected_device(
     ``NamedSharding(mesh, P("node", None))``; values are identical with
     or without it."""
 
-    def detected(s):
+    def detected(c):
         return detection_complete(
-            s, subjects, faults, min_status, learned_sharding=learned_sharding
+            c[0], subjects, faults, min_status, learned_sharding=learned_sharding
         )
 
-    return until_loop(
-        lambda s: _run_block(params, s, faults, block_ticks), state, max_blocks, detected
+    def run_block(c):
+        s, t = c
+        out = _run_block(params, s, faults, block_ticks, t)
+        return out if t is not None else (out, None)
+
+    (state, telemetry), blocks, done = until_loop(
+        run_block, (state, telemetry), max_blocks, detected
     )
+    if telemetry is None:
+        return state, blocks, done
+    return state, blocks, done, telemetry
 
 
 class LifecycleSim:
     """Convenience wrapper: jitted step + detection queries.  The jitted
     multi-tick block is cached on the instance (keyed on the static tick
     count; faults flow through as a traced pytree), so repeated run calls
-    reuse one compilation."""
+    reuse one compilation.
 
-    def __init__(self, n: int, seed: int = 0, **kw):
+    ``telemetry``: False/None (default) leaves the hot path untouched —
+    the telemetry leg compiles out entirely.  Pass True (or a
+    ``telemetry.TelemetrySink``) to carry the device-resident counter
+    accumulators through every tick; each ``run``/``run_until_*``
+    dispatch then fetches one block record (``sim/telemetry.py``) and —
+    when a sink is attached — fans it out to its journal/stats/event-bus
+    targets with the block's state digest attached.  ``journal_views=True``
+    additionally runs the O(N·K) ``view_checksums`` walk per fetched
+    block and journals the wrapped sum + live-agreement bit (pricey at
+    1M; meant for the small-config smoke)."""
+
+    def __init__(self, n: int, seed: int = 0, telemetry=None, journal_views: bool = False, **kw):
+        from ringpop_tpu.sim import telemetry as _tm
+
         self.params = LifecycleParams(n=n, **kw)
         self.state = init_state(self.params, seed=seed)
         self._step = jax.jit(functools.partial(step, self.params))
         self._block = jax.jit(
             functools.partial(_run_block, self.params), static_argnames="ticks"
         )
+        self.telemetry = None
+        self.telemetry_sink = None
+        self.journal_views = journal_views
+        if telemetry:
+            self.telemetry = _tm.zeros(self.params)
+            self.telemetry_sink = telemetry if callable(telemetry) else None
+            self._fetch = jax.jit(_tm.fetch)
+            self._digest = jax.jit(_tm.tree_digest)
 
     def tick(self, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
-        self.state = self._step(self.state, faults)
+        if self.telemetry is None:
+            self.state = self._step(self.state, faults)
+        else:
+            self.state, self.telemetry = self._step(
+                self.state, faults, telemetry=self.telemetry
+            )
         return self.state
 
     def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
-        self.state = self._block(self.state, faults, ticks=ticks)
+        if self.telemetry is None:
+            self.state = self._block(self.state, faults, ticks=ticks)
+        else:
+            self.state, self.telemetry = self._block(
+                self.state, faults, ticks=ticks, telemetry=self.telemetry
+            )
+            self._flush(faults)
         return self.state
+
+    # -- telemetry plumbing -------------------------------------------------
+
+    def fetch_telemetry(self, faults: DeltaFaults = DeltaFaults()) -> Optional[dict]:
+        """Fetch-and-reset the accumulated block record as host scalars
+        (one device_get); None when telemetry is off."""
+        if self.telemetry is None:
+            return None
+        record, self.telemetry = self._fetch(self.telemetry, self.state, faults)
+        return {
+            k: v.item() if hasattr(v, "item") else v
+            for k, v in jax.device_get(record).items()
+        }
+
+    def _flush(self, faults: DeltaFaults) -> None:
+        """Fetch the block record and hand it to the sink (if any), with
+        the state digest — and, when ``journal_views`` is set, the view-
+        checksum summary — attached."""
+        if self.telemetry_sink is None:
+            return
+        record, self.telemetry = self._fetch(self.telemetry, self.state, faults)
+        extra = {"state_digest": self._digest(self.state)}
+        if self.journal_views:
+            extra["views_sum"] = view_checksums(self.state, faults).sum(dtype=jnp.uint32)
+            extra["views_agree"] = checksums_converged(self.state, faults)
+        self.telemetry_sink(record, **extra)
 
     def _run_until(
         self,
@@ -1388,13 +1534,24 @@ class LifecycleSim:
         converged).  Loop/budget semantics: :meth:`_run_until`."""
 
         def dispatch(max_blocks):
-            self.state, blocks, done = _run_until_converged_device(
-                self.params,
-                self.state,
-                faults,
-                block_ticks=check_every,
-                max_blocks=jnp.int32(max_blocks),
-            )
+            if self.telemetry is None:
+                self.state, blocks, done = _run_until_converged_device(
+                    self.params,
+                    self.state,
+                    faults,
+                    block_ticks=check_every,
+                    max_blocks=jnp.int32(max_blocks),
+                )
+            else:
+                self.state, blocks, done, self.telemetry = _run_until_converged_device(
+                    self.params,
+                    self.state,
+                    faults,
+                    block_ticks=check_every,
+                    max_blocks=jnp.int32(max_blocks),
+                    telemetry=self.telemetry,
+                )
+                self._flush(faults)
             return blocks, done
 
         return self._run_until(
@@ -1424,16 +1581,30 @@ class LifecycleSim:
         subjects = jnp.asarray(list(subjects), jnp.int32)
 
         def dispatch(max_blocks):
-            self.state, blocks, done = _run_until_detected_device(
-                self.params,
-                self.state,
-                faults,
-                subjects,
-                min_status=min_status,
-                block_ticks=check_every,
-                max_blocks=jnp.int32(max_blocks),
-                learned_sharding=learned_sharding,
-            )
+            if self.telemetry is None:
+                self.state, blocks, done = _run_until_detected_device(
+                    self.params,
+                    self.state,
+                    faults,
+                    subjects,
+                    min_status=min_status,
+                    block_ticks=check_every,
+                    max_blocks=jnp.int32(max_blocks),
+                    learned_sharding=learned_sharding,
+                )
+            else:
+                self.state, blocks, done, self.telemetry = _run_until_detected_device(
+                    self.params,
+                    self.state,
+                    faults,
+                    subjects,
+                    min_status=min_status,
+                    block_ticks=check_every,
+                    max_blocks=jnp.int32(max_blocks),
+                    learned_sharding=learned_sharding,
+                    telemetry=self.telemetry,
+                )
+                self._flush(faults)
             return blocks, done
 
         return self._run_until(

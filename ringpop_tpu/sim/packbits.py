@@ -39,6 +39,21 @@ def n_words(k: int) -> int:
     return (k + WORD - 1) // WORD
 
 
+def mix32(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32: a full-avalanche integer mixer (public-domain
+    constants) — the ONE copy shared by lifecycle's order-invariant view
+    checksum and telemetry's state digest.  NOT the wire-compat farm32
+    (which needs the host's canonical sorted-string encoding,
+    ``memberlist.go:106-128``)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EB_CA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2_AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
 def pack_bool(x: jax.Array) -> jax.Array:
     """bool[..., K] -> uint32[..., W] (LSB-first within each word)."""
     k = x.shape[-1]
@@ -131,14 +146,17 @@ def _tree_reduce_rows(p: jax.Array, op, identity: int) -> jax.Array:
     """Bitwise reduce over the node axis: blocked halving tree (see
     ``_REDUCE_BLOCKS``) — in-block combines are shard-local, only the
     [G, W] block results cross shards.  Identical bits to the flat tree
-    (bitwise ops reassociate exactly); identical word count on one core."""
-    n = p.shape[0]
-    g = block_count(n, _REDUCE_BLOCKS)
-    if g > 1 and n > g:
-        p = _halving_tree(
-            p.reshape((g, n // g) + p.shape[1:]), op, identity, axis=1
-        )
-    return _halving_tree(p, op, identity, axis=0)
+    (bitwise ops reassociate exactly); identical word count on one core.
+    The named scope tags the reduce in profiler traces / HLO metadata
+    (nested under whichever protocol phase called it)."""
+    with jax.named_scope("row-reduce"):
+        n = p.shape[0]
+        g = block_count(n, _REDUCE_BLOCKS)
+        if g > 1 and n > g:
+            p = _halving_tree(
+                p.reshape((g, n // g) + p.shape[1:]), op, identity, axis=1
+            )
+        return _halving_tree(p, op, identity, axis=0)
 
 
 def or_reduce_rows(p: jax.Array) -> jax.Array:
@@ -173,12 +191,13 @@ def set_bit(p: jax.Array, rows: jax.Array, slots: jax.Array, on: jax.Array) -> j
     distinct rows), because two adds of the same bit would carry into the
     next slot instead of ORing.
     """
-    n, w = p.shape
-    rows = jnp.asarray(rows, jnp.int32)
-    slots = jnp.asarray(slots, jnp.int32)
-    vals = jnp.where(on, jnp.uint32(1) << (slots & 31).astype(jnp.uint32), jnp.uint32(0))
-    upd = jnp.zeros((n, w), jnp.uint32).at[rows, slots >> 5].add(vals, mode="drop")
-    return p | upd
+    with jax.named_scope("set-bit"):
+        n, w = p.shape
+        rows = jnp.asarray(rows, jnp.int32)
+        slots = jnp.asarray(slots, jnp.int32)
+        vals = jnp.where(on, jnp.uint32(1) << (slots & 31).astype(jnp.uint32), jnp.uint32(0))
+        upd = jnp.zeros((n, w), jnp.uint32).at[rows, slots >> 5].add(vals, mode="drop")
+        return p | upd
 
 
 def set_bit_per_row(p: jax.Array, slots: jax.Array, on: jax.Array) -> jax.Array:
@@ -193,11 +212,12 @@ def set_bit_per_row(p: jax.Array, slots: jax.Array, on: jax.Array) -> jax.Array:
     Out-of-range slots: callers clamp (identical to the engine's previous
     ``set_bit(..., i_all, clip(slots), on)`` contract — the clamped write
     lands in a real word but is masked by ``on``)."""
-    w = p.shape[1]
-    slots = jnp.asarray(slots, jnp.int32)
-    hit = (slots[:, None] >> 5) == jnp.arange(w, dtype=jnp.int32)[None, :]
-    bit = (jnp.uint32(1) << (slots & 31).astype(jnp.uint32))[:, None]
-    return p | jnp.where(hit & on[:, None], bit, jnp.uint32(0))
+    with jax.named_scope("set-bit"):
+        w = p.shape[1]
+        slots = jnp.asarray(slots, jnp.int32)
+        hit = (slots[:, None] >> 5) == jnp.arange(w, dtype=jnp.int32)[None, :]
+        bit = (jnp.uint32(1) << (slots & 31).astype(jnp.uint32))[:, None]
+        return p | jnp.where(hit & on[:, None], bit, jnp.uint32(0))
 
 
 def check_rumor_shardable(k: int, rumor_shards: int) -> None:

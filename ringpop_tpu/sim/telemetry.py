@@ -1,0 +1,438 @@
+"""Device-resident telemetry plane for the sim engines.
+
+The host plane mirrors ringpop-go's stats surface (``swim/stats.py``,
+``events/``, the CLI reporters); the sim plane — where the 1M-node
+headline lives — was a black box: a ``_run_block`` scan emits nothing
+until it returns.  This module gives it the Ising-on-TPU treatment
+(PAPERS.md): carry cheap on-device reductions *through* the scan and
+fetch them in amortized blocks, so observability costs no host
+round-trips and, under a device mesh, no per-tick collectives.
+
+Design rules (these are what the acceptance tests pin):
+
+* **Bit-identity.** Telemetry only *reads* intermediates the protocol
+  tick already computes — it consumes no PRNG draws and feeds nothing
+  back into the state.  A telemetry-on run is bit-identical to a
+  telemetry-off run, certified by ``tests/test_telemetry.py`` and the
+  ``make telemetry-smoke`` digest pairing.
+* **None compiles out.** Every seam (``lifecycle.step``, ``_run_block``,
+  the ``run_until_*`` drivers) takes ``telemetry=None`` by default; the
+  ``None`` leg is a Python-level branch, so the traced program — and
+  therefore the HLO and its collective census — is the one HEAD had.
+* **Zero per-tick collectives.** Accumulators are shaped like their
+  sources ([N] per-node masks, [N, W] packed planes, [K] slot vectors,
+  [M] placement vectors) and updated with *elementwise* adds, which the
+  SPMD partitioner keeps shard-local.  The cross-shard reduction to
+  scalars happens once per fetched block, in :func:`fetch` — one
+  psum-class collective per counter per block (asserted by
+  ``tests/test_mesh_budget.py``).
+
+Counter overflow: int32 accumulators hold per-tick increments of at most
+N (or 32 per packed word); a fetch resets them, so the cadence bounds the
+window — at the 1M headline a block must stay under ~2k ticks, far above
+any ``check_every * blocks_per_dispatch`` in the tree.  :func:`fetch`
+sums the big planes in float32 (exact to 2^24, ~1e-7 relative beyond —
+counters, not invariants).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim.delta import DeltaFaults, converged_fraction
+from ringpop_tpu.sim.packbits import mix32, n_words
+from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TelemetryState(NamedTuple):
+    """Per-tick protocol counters, accumulated on device between fetches.
+
+    Every leaf is an *elementwise* accumulator shaped like the mask it
+    counts (see the module docstring for why) — ``fetch`` owns the
+    reduction to scalars.
+    """
+
+    # per-node masks — [N], node-sharded
+    pings: jax.Array  # int32[N]: completed direct probe exchanges
+    ping_reqs: jax.Array  # int32[N]: indirect probe legs issued
+    probes_failed: jax.Array  # int32[N]: direct probes that found no path
+    incarnation_bumps: jax.Array  # int32[N]: refutations that placed
+    # packed-plane event counts — [N, W], sharded like ``learned``
+    piggybacked: jax.Array  # uint32[N, W]: rumor bits ridden (both legs)
+    expired: jax.Array  # uint32[N, W]: piggyback gates closed (maxP hit)
+    # rumor-table vectors — [K], rumor-sharded
+    timer_fires: jax.Array  # int32[K]: in-flight-rumor state-timer transitions completed
+    base_timer_fires: jax.Array  # int32[N]: folded-to-base state-timer transitions completed
+    # placement vectors — [M], M = alloc budget (replicated post-merge)
+    decl_alive: jax.Array  # int32[M]: refutation rumors placed
+    decl_suspect: jax.Array  # int32[M]: suspect declarations placed
+    decl_faulty: jax.Array  # int32[M]: faulty declarations placed
+    decl_tombstone: jax.Array  # int32[M]: tombstone (leave) declarations
+    # scalars
+    heal_attempts: jax.Array  # int32[]: partition-healer pair swaps tried
+    ticks: jax.Array  # int32[]: ticks accumulated since the last fetch
+
+
+def placement_budget(params) -> int:
+    """M, the per-tick rumor-allocation budget — the shared shape rule of
+    the placement vectors (mirrors the ``m`` computed in
+    ``lifecycle.step``)."""
+    return min(params.alloc_per_tick, params.k, params.n)
+
+
+def zeros(params) -> TelemetryState:
+    """A zeroed accumulator for a ``LifecycleParams`` config."""
+    n, k = params.n, params.k
+    w = n_words(k)
+    m = placement_budget(params)
+    i32 = jnp.int32
+    return TelemetryState(
+        pings=jnp.zeros((n,), i32),
+        ping_reqs=jnp.zeros((n,), i32),
+        probes_failed=jnp.zeros((n,), i32),
+        incarnation_bumps=jnp.zeros((n,), i32),
+        piggybacked=jnp.zeros((n, w), jnp.uint32),
+        expired=jnp.zeros((n, w), jnp.uint32),
+        timer_fires=jnp.zeros((k,), i32),
+        base_timer_fires=jnp.zeros((n,), i32),
+        decl_alive=jnp.zeros((m,), i32),
+        decl_suspect=jnp.zeros((m,), i32),
+        decl_faulty=jnp.zeros((m,), i32),
+        decl_tombstone=jnp.zeros((m,), i32),
+        heal_attempts=jnp.zeros((), i32),
+        ticks=jnp.zeros((), i32),
+    )
+
+
+def accumulate(
+    tel: TelemetryState,
+    *,
+    delivered: jax.Array,  # bool[N]
+    probing: jax.Array,  # bool[N]
+    ping_req_legs: jax.Array,  # int32[N]
+    refuted: jax.Array,  # bool[N]
+    sent_w: jax.Array,  # uint32[N, W]
+    resp_w: jax.Array,  # uint32[N, W]
+    closed_w: jax.Array,  # uint32[N, W]
+    fired: jax.Array,  # bool[K]
+    base_fired: jax.Array,  # bool[N]
+    place: jax.Array,  # bool[M]
+    new_status: jax.Array,  # int8[M]
+    heal_attempt: Optional[jax.Array],  # bool[] or None (healer disabled)
+) -> TelemetryState:
+    """One tick's worth of counter updates — every op elementwise, so the
+    partitioner adds no collectives to the step (see module docstring).
+    Called by ``lifecycle.step`` with intermediates the tick already has;
+    the popcounts read planes that are materialized regardless."""
+    i32 = jnp.int32
+    pop = jax.lax.population_count
+    return TelemetryState(
+        pings=tel.pings + delivered.astype(i32),
+        ping_reqs=tel.ping_reqs + ping_req_legs,
+        probes_failed=tel.probes_failed + probing.astype(i32),
+        incarnation_bumps=tel.incarnation_bumps + refuted.astype(i32),
+        piggybacked=tel.piggybacked + pop(sent_w) + pop(resp_w),
+        expired=tel.expired + pop(closed_w),
+        timer_fires=tel.timer_fires + fired.astype(i32),
+        base_timer_fires=tel.base_timer_fires + base_fired.astype(i32),
+        decl_alive=tel.decl_alive + (place & (new_status == ALIVE)).astype(i32),
+        decl_suspect=tel.decl_suspect + (place & (new_status == SUSPECT)).astype(i32),
+        decl_faulty=tel.decl_faulty + (place & (new_status == FAULTY)).astype(i32),
+        decl_tombstone=tel.decl_tombstone
+        + (place & (new_status == TOMBSTONE)).astype(i32),
+        heal_attempts=tel.heal_attempts
+        + (heal_attempt.astype(i32) if heal_attempt is not None else 0),
+        ticks=tel.ticks + 1,
+    )
+
+
+# -- fetch: the once-per-block reduction + census ----------------------------
+
+
+def _census(state, faults: DeltaFaults):
+    """Point-in-time membership census from the converged base view, plus
+    the detection fraction over the fault model's down nodes (the DGRO-
+    style convergence series: how much of the crash set the *converged*
+    view has absorbed).  All [N]-column reductions."""
+    present = state.base_present
+    status = state.base_status
+
+    def count(s):
+        return (present & (status == s)).sum(dtype=jnp.int32)
+
+    n = present.shape[0]
+    out = {
+        "num_members": present.sum(dtype=jnp.int32),
+        "census_alive": count(ALIVE),
+        "census_suspect": count(SUSPECT),
+        "census_faulty": count(FAULTY),
+        "census_tombstone": count(TOMBSTONE),
+        "rumors_active": (state.r_subject >= 0).sum(dtype=jnp.int32),
+    }
+    if faults.up is not None:
+        down = ~faults.up
+        detected = down & (~present | (status >= FAULTY))
+        out["detect_frac"] = detected.sum(dtype=jnp.float32) / jnp.maximum(
+            down.sum(dtype=jnp.float32), 1.0
+        )
+    else:
+        out["detect_frac"] = jnp.float32(1.0)
+    return out
+
+
+def fetch(
+    tel: TelemetryState, state, faults: DeltaFaults = DeltaFaults()
+) -> tuple[dict, TelemetryState]:
+    """Reduce the block's accumulators to a scalar record and reset them.
+
+    Returns ``(record, zeroed_tel)`` — the record is a flat dict of
+    device scalars (one ``jax.device_get`` fetches the whole block).
+    This is where the cross-shard psums happen: one reduction per counter
+    per fetched block, none per tick.  Jit-safe; ``LifecycleSim`` wraps
+    it in a cached jit."""
+    f32 = jnp.float32
+    record = {
+        "ticks": tel.ticks,
+        "ping_send": tel.pings.sum(dtype=jnp.int32),
+        "ping_req_send": tel.ping_reqs.sum(dtype=jnp.int32),
+        "ping_timeout": tel.probes_failed.sum(dtype=jnp.int32),
+        "refuted": tel.incarnation_bumps.sum(dtype=jnp.int32),
+        # float32 sums: counts, not invariants (see module docstring)
+        "rumors_piggybacked": tel.piggybacked.sum(dtype=f32),
+        "rumors_expired": tel.expired.sum(dtype=f32),
+        "timer_fired": tel.timer_fires.sum(dtype=jnp.int32)
+        + tel.base_timer_fires.sum(dtype=jnp.int32),
+        "decl_alive": tel.decl_alive.sum(dtype=jnp.int32),
+        "decl_suspect": tel.decl_suspect.sum(dtype=jnp.int32),
+        "decl_faulty": tel.decl_faulty.sum(dtype=jnp.int32),
+        "decl_tombstone": tel.decl_tombstone.sum(dtype=jnp.int32),
+        "heal_attempts": tel.heal_attempts,
+        "tick": state.tick,
+    }
+    record.update(_census(state, faults))
+    fresh = jax.tree.map(jnp.zeros_like, tel)
+    return record, fresh
+
+
+# -- order-sensitive state digest (journal pairing) --------------------------
+
+
+# murmur3 fmix32 — the shared packbits.mix32 mixer (same one the view
+# checksum uses; here it digests raw state words, not membership views)
+_mix32 = mix32
+
+
+def tree_digest(tree) -> jax.Array:
+    """uint32 scalar, on-device: a position-sensitive digest of every leaf
+    of an integer/bool pytree (both sim engines' states qualify).  Two
+    states digest equal iff every leaf is bit-equal (up to hash
+    collision) — the cheap pairing check the run journal carries so a
+    telemetry-on run can be certified against its telemetry-off twin
+    without shipping full planes to the host."""
+    acc = jnp.uint32(0)
+    for li, leaf in enumerate(jax.tree.leaves(tree)):
+        v = jnp.asarray(leaf)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.uint32)
+        flat = v.reshape(-1).astype(jnp.uint32)
+        idx = jnp.arange(flat.shape[0], dtype=jnp.uint32)
+        leaf_sum = _mix32(flat ^ _mix32(idx)).sum(dtype=jnp.uint32)
+        acc = acc + _mix32(leaf_sum ^ jnp.uint32((li * 0x9E37_79B9) & 0xFFFF_FFFF))
+    return acc
+
+
+def delta_record(state, faults: DeltaFaults = DeltaFaults()) -> dict:
+    """The delta engine's per-block journal record (device scalars): the
+    dissemination engine carries no in-step counters — coverage fraction
+    and the state digest are its convergence series."""
+    return {
+        "tick": state.tick,
+        "coverage": converged_fraction(state, faults),
+        "digest": tree_digest(state),
+    }
+
+
+# -- toolchain / mesh-budget fingerprints ------------------------------------
+
+
+def toolchain_fingerprint() -> dict:
+    """The versions that decide whether two trajectory captures are
+    comparable (the golden-drift diagnosis in ``tests/golden_tools.py``
+    compares exactly this dict)."""
+    import jaxlib
+
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "numpy": np.__version__,
+        "python": ".".join(map(str, sys.version_info[:3])),
+    }
+
+
+def mesh_budget_fingerprint(repo: str = _REPO) -> dict:
+    """Identity of the collective-budget baseline this run is ratcheted
+    against (``captures/mesh_profile_small_budget.json``): file name +
+    content sha256 prefix, so a journal names which budget world it was
+    produced in.  Missing capture → ``{"budget_capture": None}``."""
+    path = os.path.join(repo, "captures", "mesh_profile_small_budget.json")
+    if not os.path.exists(path):
+        return {"budget_capture": None}
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    return {"budget_capture": os.path.basename(path), "sha256": digest}
+
+
+def _to_host(record: dict) -> dict:
+    """Fetch every value of a record to host JSON scalars — the ONE
+    device-to-journal coercion (one ``device_get`` for the whole dict;
+    floats rounded to 6 places so the journal, the stats bridge, and
+    ``TelemetrySink.records`` all carry the same numbers).  Idempotent on
+    already-host dicts."""
+    host = {}
+    for k, v in jax.device_get(record).items():
+        if isinstance(v, (np.generic, np.ndarray)):
+            v = v.item() if np.ndim(v) == 0 else np.asarray(v).tolist()
+        if isinstance(v, float):
+            v = round(v, 6)
+        host[k] = v
+    return host
+
+
+# -- JSONL run journal -------------------------------------------------------
+
+
+class TelemetryJournal:
+    """One JSONL stream per run: a ``header`` record (engine, params,
+    toolchain + mesh-budget fingerprints), then one ``block`` record per
+    fetched tick-block.  Values are plain JSON scalars — device arrays
+    are fetched (one ``device_get`` per record) and numpy scalars
+    coerced.  Context-manager; safe to hand to multiple scenarios in
+    append mode (each writes its own header)."""
+
+    def __init__(self, path: str, *, append: bool = False):
+        self.path = path
+        self._f = open(path, "a" if append else "w", buffering=1)
+
+    def header(self, engine: str, scenario: str = "", params: Optional[dict] = None) -> None:
+        self._write(
+            {
+                "kind": "header",
+                "engine": engine,
+                "scenario": scenario,
+                "params": params or {},
+                "toolchain": toolchain_fingerprint(),
+                "mesh_budget": mesh_budget_fingerprint(),
+            }
+        )
+
+    def block(self, record: dict, **extra) -> None:
+        self._write({"kind": "block", **_to_host({**record, **extra})})
+
+    def _write(self, obj: dict) -> None:
+        self._f.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    def close(self) -> None:
+        self._f.flush()
+        self._f.close()
+
+    def __enter__(self) -> "TelemetryJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_journal(path: str) -> list[dict]:
+    """Parse a JSONL journal back into records (the smoke test's loader)."""
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- event bus + StatsReporter bridge ----------------------------------------
+
+SIM_STAT_PREFIX = "ringpop.sim"
+
+# record field -> (statsd method, key suffix).  Suffixes reuse the host
+# plane's vocabulary (``ringpop.py`` event->stats table) so dashboards
+# built for one plane read the other: see OBSERVABILITY.md for the full
+# table with the ringpop-go parity anchors.
+STAT_KEYS = {
+    "ping_send": ("incr", "ping.send"),
+    "ping_req_send": ("incr", "ping-req.send"),
+    "ping_timeout": ("incr", "ping.timeout"),
+    "refuted": ("incr", "refuted-update"),
+    "rumors_piggybacked": ("incr", "changes.disseminate"),
+    "rumors_expired": ("incr", "changes.expired"),
+    "timer_fired": ("incr", "state-timer.fired"),
+    "decl_alive": ("incr", "membership-update.alive"),
+    "decl_suspect": ("incr", "membership-update.suspect"),
+    "decl_faulty": ("incr", "membership-update.faulty"),
+    "decl_tombstone": ("incr", "membership-update.tombstone"),
+    "heal_attempts": ("incr", "heal.attempt"),
+    "num_members": ("gauge", "num-members"),
+    "census_alive": ("gauge", "membership.alive"),
+    "census_suspect": ("gauge", "membership.suspect"),
+    "census_faulty": ("gauge", "membership.faulty"),
+    "census_tombstone": ("gauge", "membership.tombstone"),
+    "rumors_active": ("gauge", "rumors.active"),
+    "detect_frac": ("gauge", "detection.fraction"),
+}
+
+
+def emit_stats(reporter, record: dict, prefix: str = SIM_STAT_PREFIX) -> None:
+    """Feed a fetched block record into a host-plane ``StatsReporter``
+    under the sim namespace — the same sinks (file/UDP statsd/in-memory)
+    the facade uses, so one collection pipeline serves both planes."""
+    record = _to_host(record)
+    for field, (kind, suffix) in STAT_KEYS.items():
+        if field not in record:
+            continue
+        if kind == "incr":
+            reporter.incr(f"{prefix}.{suffix}", int(record[field]))
+        else:
+            reporter.gauge(f"{prefix}.{suffix}", float(record[field]))
+
+
+class TelemetrySink:
+    """Fan a fetched block record out to any of: a JSONL journal, a
+    ``StatsReporter``, a typed event bus, and/or a plain callable —
+    the one object ``LifecycleSim``/``simbench`` attach."""
+
+    def __init__(
+        self,
+        journal: Optional[TelemetryJournal] = None,
+        stats=None,
+        emitter=None,
+        fn: Optional[Callable[[dict], None]] = None,
+        stat_prefix: str = SIM_STAT_PREFIX,
+    ):
+        self.journal = journal
+        self.stats = stats
+        self.emitter = emitter
+        self.fn = fn
+        self.stat_prefix = stat_prefix
+        self.records: list = []  # host-side history (cheap; per block)
+
+    def __call__(self, record: dict, **extra: Any) -> None:
+        host = _to_host({**record, **extra})
+        self.records.append(host)
+        if self.journal is not None:
+            self.journal.block(host)
+        if self.stats is not None:
+            emit_stats(self.stats, host, self.stat_prefix)
+        if self.emitter is not None:
+            from ringpop_tpu.events import SimTickBlockEvent
+
+            self.emitter.emit(SimTickBlockEvent(record=host))
+        if self.fn is not None:
+            self.fn(host)

@@ -51,6 +51,91 @@ COLLECTIVES = (
     "reduce-scatter",
 )
 
+# protocol-phase named scopes (jax.named_scope in sim/lifecycle.py and
+# sim/packbits.py) — XLA carries them through to each instruction's
+# metadata op_name, which is how a censused collective gets attributed to
+# the protocol phase that emitted it.  Outermost-first: a collective under
+# "rumor-exchange/row-reduce" belongs to the exchange phase.
+PHASES = (
+    "tick-prologue",
+    "ping-target",
+    "rumor-exchange",
+    "heal",
+    "piggyback-counters",
+    "timers-fold",
+    "candidate-select",
+    "alloc-seed",
+    "commit",
+    "telemetry",
+    "detect-walk",
+    "view-checksum",
+    "row-reduce",
+    "set-bit",
+)
+
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_SRC_RE = re.compile(r'source_file="([^"]+)" source_line=(\d+)')
+_PHASE_SPAN_CACHE: dict = {}
+
+
+def _source_spans(path: str):
+    """(named-scope spans, function starts) of one source file — the
+    fallback attributor for collectives whose op_name lost its scope (the
+    SPMD partitioner re-homes resharding ops onto loop boundaries, whose
+    metadata names only the enclosing while)."""
+    if path not in _PHASE_SPAN_CACHE:
+        spans, funcs = [], []
+        try:
+            src = open(path).read().split("\n")
+        except OSError:
+            src = []
+        for i, ln in enumerate(src):
+            m = re.match(r'(\s*)with jax\.named_scope\("([^"]+)"\):', ln)
+            if m:
+                indent = len(m.group(1))
+                j = i + 1
+                while j < len(src) and (
+                    not src[j].strip()
+                    or len(src[j]) - len(src[j].lstrip()) > indent
+                ):
+                    j += 1
+                spans.append((i + 1, j, m.group(2)))
+            d = re.match(r"def (\w+)\(", ln)
+            if d:
+                funcs.append((i + 1, d.group(1)))
+        _PHASE_SPAN_CACHE[path] = (spans, funcs)
+    return _PHASE_SPAN_CACHE[path]
+
+
+def _phase_of(line: str) -> str:
+    """Protocol phase of one HLO instruction line: the named-scope path
+    XLA keeps in metadata op_name when present (fusions inherit a
+    representative instruction's metadata), else the scope lexically
+    enclosing the op's source line, else ``loop:<function>`` for ops the
+    partitioner re-homed onto a loop boundary (e.g. the detect walk's
+    learned-plane replication hoisted to the tick loop)."""
+    m = _OPNAME_RE.search(line)
+    if m:
+        for part in m.group(1).split("/"):
+            if part in PHASES:
+                return part
+    s = _SRC_RE.search(line)
+    if s:
+        spans, funcs = _source_spans(s.group(1))
+        ln = int(s.group(2))
+        for a, b, name in spans:
+            if a <= ln <= b:
+                return name
+        owner = None
+        for a, name in funcs:
+            if a <= ln:
+                owner = name
+            else:
+                break
+        if owner:
+            return f"loop:{owner}"
+    return "(unattributed)"
+
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
     "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
@@ -103,6 +188,7 @@ def parse_collectives(hlo_path: str) -> dict:
                         "op": m.group(1),
                         "kind": m.group(3),
                         "bytes": _shape_bytes(m.group(2)),
+                        "phase": _phase_of(line),
                     }
                 )
             b = re.search(r"body=%?([\w.\-]+)", line)
@@ -144,6 +230,19 @@ def _summarize(census: dict) -> dict:
             e["count"] += 1
             e["bytes"] += r["bytes"]
     return by_kind
+
+
+def _summarize_phases(census: dict) -> dict:
+    """{phase: {kind: {count, bytes}}} — the protocol-phase attribution of
+    the collective census (the table PERF.md's budget discussion reads)."""
+    by_phase: dict = {}
+    for rows in census["computations"].values():
+        for r in rows:
+            kinds = by_phase.setdefault(r.get("phase", "(unattributed)"), {})
+            e = kinds.setdefault(r["kind"], {"count": 0, "bytes": 0})
+            e["count"] += 1
+            e["bytes"] += r["bytes"]
+    return by_phase
 
 
 def main() -> None:
@@ -227,6 +326,7 @@ def _run(args, dump: str) -> int:
         "n": n, "k": k, "compile_s": round(step_compile_s, 1),
         "module": os.path.basename(mod) if mod else None,
         "by_kind": _summarize(census),
+        "by_phase": _summarize_phases(census),
         "by_computation": {
             c: {
                 "count": len(rows),
@@ -273,6 +373,7 @@ def _run(args, dump: str) -> int:
         "n": nd, "k": 256, "compile_s": round(detect_compile_s, 1),
         "module": os.path.basename(mod) if mod else None,
         "by_kind": _summarize(census),
+        "by_phase": _summarize_phases(census),
         "by_computation": {
             c: {
                 "count": len(rows),
@@ -290,6 +391,16 @@ def _run(args, dump: str) -> int:
         print(f"{'kind':>22} {'count':>6} {'MB total':>10}")
         for kind, e in sorted(sec["by_kind"].items()):
             print(f"{kind:>22} {e['count']:>6} {e['bytes'] / 1e6:>10.2f}")
+        print("  by protocol phase (named-scope attribution):")
+        for phase, kinds in sorted(sec["by_phase"].items()):
+            for kind, e in sorted(kinds.items()):
+                print(f"    {phase:>20} {kind:>22} {e['count']:>4} "
+                      f"{e['bytes'] / 1e6:>8.2f} MB")
+        unattr = sec["by_phase"].get("(unattributed)")
+        if unattr:
+            print("    WARNING: %d collectives carry no phase scope — extend "
+                  "the named_scope coverage in sim/lifecycle.py"
+                  % sum(e["count"] for e in unattr.values()))
         print("  per computation (collective-bearing only; depth = enclosing "
               "while-loop nesting):")
         for c, e in sorted(sec["by_computation"].items(),

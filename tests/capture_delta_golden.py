@@ -75,12 +75,17 @@ def run_config(pkw, sources, fault_sched, ticks, seed):
 
 
 def main() -> None:
+    from tests import golden_tools
+
     out = {}
     for name, pkw, sources, fault_sched, ticks, seed in CONFIGS:
         print(f"capturing {name} ...", flush=True)
         traj = run_config(pkw, sources, fault_sched, ticks, seed)
         for f, arr in traj.items():
             out[f"{name}/{f}"] = arr
+    # record the capture toolchain so a future mismatch can be classified
+    # as drift vs regression (tests/golden_tools.py)
+    golden_tools.embed(out)
     os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
     np.savez_compressed(GOLDEN_PATH, **out)
     print(f"wrote {GOLDEN_PATH} ({os.path.getsize(GOLDEN_PATH) / 1e6:.2f} MB)")

@@ -10,6 +10,7 @@ import pytest
 
 from ringpop_tpu.sim import delta
 
+from tests import golden_tools
 from tests.capture_delta_golden import CONFIGS, GOLDEN_PATH, run_config
 from tests.test_lifecycle_golden import _as_bool_plane
 
@@ -42,9 +43,11 @@ def test_trajectory_bit_identical(golden, name, pkw, sources, fault_sched, ticks
             want, got = _as_bool_plane(want, k), _as_bool_plane(got, k)
         assert got.shape == want.shape, (field, got.shape, want.shape)
         mism = np.flatnonzero((got != want).reshape(ticks, -1).any(axis=1))
-        assert mism.size == 0, (
-            f"{name}: field {field} diverges first at tick {mism[0] if mism.size else '?'}"
-        )
+        if mism.size:
+            # classify toolchain drift vs real regression instead of a raw
+            # array-mismatch assert (ROADMAP: 'Golden trajectories vs
+            # toolchain drift')
+            golden_tools.fail_golden(golden, name, field, int(mism[0]))
     # the carried ride_ok plane is derived state: its invariant pins it to
     # the golden-checked pcount at every tick
     max_p = delta.clamped_max_p(params)

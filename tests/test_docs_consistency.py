@@ -33,7 +33,7 @@ import re
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DOCS = ["PERF.md", "README.md", "PARITY.md", "VERDICT_RESPONSE.md"]
+DOCS = ["PERF.md", "README.md", "PARITY.md", "VERDICT_RESPONSE.md", "OBSERVABILITY.md"]
 
 CHECK_RE = re.compile(r"<!--check:\s*(\S+)\s+(.+?)\s*(==|~=)\s*(.+?)\s*-->")
 

@@ -145,6 +145,96 @@ def test_detect_walk_body_collective_budget(sharded_setup, tmp_path):
         )
 
 
+def test_telemetry_adds_zero_per_tick_collectives(sharded_setup, tmp_path, monkeypatch):
+    """The telemetry-plane acceptance bar (ISSUE 2): carrying the counter
+    accumulators through the sharded step adds ZERO collectives per tick —
+    every accumulator update is elementwise, so the partitioner keeps it
+    shard-local.  Asserted as census equality between the telemetry-off
+    and telemetry-on compilations of the same one-tick block."""
+    from ringpop_tpu.sim import telemetry
+
+    mesh, params, state, faults, _ = sharded_setup
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    off = _census_of(blk.lower(state, faults, ticks=1).compile().as_text(), tmp_path)
+    tel = telemetry.zeros(params)
+    on = _census_of(
+        blk.lower(state, faults, ticks=1, telemetry=tel).compile().as_text(),
+        tmp_path,
+    )
+    n_off = sum(len(v) for v in off["computations"].values())
+    n_on = sum(len(v) for v in on["computations"].values())
+    assert n_off > 0, "census parsed no collectives — parser/format drift?"
+    assert n_on == n_off, (
+        f"telemetry-on step compiles to {n_on} collectives vs {n_off} "
+        "telemetry-off — an accumulator update stopped being elementwise"
+    )
+    b_off = sum(r["bytes"] for v in off["computations"].values() for r in v)
+    b_on = sum(r["bytes"] for v in on["computations"].values() for r in v)
+    assert b_on == b_off, (n_on, b_on, b_off)
+
+
+def test_telemetry_fetch_is_psum_only_per_block(sharded_setup, tmp_path):
+    """The once-per-block fetch reduction compiles to psum-class
+    collectives only (all-reduce / reduce-scatter) — no gathers or
+    permutes: the counters leave the mesh as scalars, one reduction per
+    counter per fetched block."""
+    from ringpop_tpu.sim import telemetry
+
+    mesh, params, state, faults, _ = sharded_setup
+    tel = telemetry.zeros(params)
+    jfetch = jax.jit(telemetry.fetch)
+    census = _census_of(
+        jfetch.lower(tel, state, faults).compile().as_text(), tmp_path
+    )
+    kinds = {r["kind"] for v in census["computations"].values() for r in v}
+    assert kinds <= {"all-reduce", "reduce-scatter"}, (
+        f"telemetry fetch moved non-psum collectives across the mesh: {kinds}"
+    )
+
+
+def test_sharded_telemetry_run_matches_unsharded(sharded_setup):
+    """Execute (not just compile) the telemetry-carrying block over the
+    mesh: state AND fetched counters must be bit-equal to the unsharded
+    run — the counters are reductions of deterministic integer masks.
+
+    Exception, asserted loosely: ``ping_req_send`` counts peer_ok lanes of
+    the [N, P] peer-sampling draw, and with ``jax_threefry_partitionable``
+    off the SPMD partitioner generates DIFFERENT lanes for a sharded
+    output than the unsharded program does (verified directly: ~100% of
+    lanes differ).  The protocol state is immune — ``peer_reaches`` is
+    masked by ``up[targets]`` for every probing node whose target is
+    actually down, and all-peers-invalid is ~1e-6 per probe — which is
+    why the r6 sharded bit-equality certifications hold; the counter
+    faithfully reports what the sharded program actually sampled.  The
+    ROADMAP's "replicated peer-choice PRNG" item is the real fix."""
+    from ringpop_tpu.sim import telemetry
+
+    mesh, params, sstate, faults, up = sharded_setup
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    ref_s, ref_t = blk(
+        lifecycle.init_state(params, seed=0), faults, ticks=4,
+        telemetry=telemetry.zeros(params),
+    )
+    sh_s, sh_t = blk(sstate, faults, ticks=4, telemetry=telemetry.zeros(params))
+    for a, b in zip(jax.tree.leaves(ref_s), jax.tree.leaves(sh_s)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+    ref_rec, _ = telemetry.fetch(ref_t, ref_s, faults)
+    sh_rec, _ = telemetry.fetch(sh_t, sh_s, faults)
+    ref_rec, sh_rec = jax.device_get((ref_rec, sh_rec))
+    for key in ref_rec:
+        if key == "ping_req_send":  # sharded peer-draw lanes (docstring)
+            assert abs(int(ref_rec[key]) - int(sh_rec[key])) <= int(
+                0.1 * max(int(ref_rec[key]), 1)
+            )
+            continue
+        assert np.asarray(ref_rec[key]) == np.asarray(sh_rec[key]), key
+
+
 def test_detect_census_sees_unhinted_walk_collectives(sharded_setup, tmp_path):
     """Self-check that the budget numbers are not vacuous: the UNhinted
     detect program (no learned_sharding) must show MORE walk-body

@@ -36,6 +36,43 @@ def test_histogram_empty_is_zero():
     h = Histogram()
     assert h.percentile(0.5) == 0.0
     assert h.mean() == 0.0 and h.min() == 0.0 and h.max() == 0.0
+    assert h.percentiles([0.0, 0.5, 0.99]) == [0.0, 0.0, 0.0]
+    assert h.count == 0
+
+
+def test_histogram_single_sample_every_percentile():
+    """With one sample every percentile — including the p*(len+1) < 1 and
+    >= len index edges — must return that sample, never interpolate off
+    the end."""
+    h = Histogram(sample_size=10, seed=3)
+    h.update(42.0)
+    for p in (0.0, 0.01, 0.5, 0.95, 0.99, 1.0):
+        assert h.percentile(p) == 42.0, p
+    assert h.min() == h.max() == h.mean() == 42.0
+    assert h.count == 1
+
+
+def test_histogram_reservoir_overflow_deterministic_under_seed():
+    """Past sample_size the reservoir replacement is driven by the seeded
+    RNG only: same seed + same update stream => identical retained sample
+    (what makes committed bench artifacts reproducible); a different seed
+    diverges on the same stream."""
+    stream = [float(v) for v in range(500)]
+
+    def run(seed):
+        h = Histogram(sample_size=16, seed=seed)
+        for v in stream:
+            h.update(v)
+        return list(h._sample), h.count
+
+    s1, c1 = run(7)
+    s2, c2 = run(7)
+    s3, _ = run(8)
+    assert s1 == s2 and c1 == c2 == 500
+    assert len(s1) == 16
+    assert s3 != s1  # 16-of-500 uniform samples colliding is ~impossible
+    # the retained values all came from the stream
+    assert set(s1) <= set(stream)
 
 
 def test_meter_ewma_rate_with_mock_clock():
@@ -49,6 +86,32 @@ def test_meter_ewma_rate_with_mock_clock():
         clock.advance(5.0)
     assert m.count == 600
     assert 5.0 < m.rate1() <= 10.5
+
+
+def test_meter_ewma_decays_when_idle():
+    """After traffic stops, the 1-minute EWMA must decay monotonically
+    toward zero under the fake clock — and an untouched meter stays at
+    exactly zero however far the clock advances."""
+    clock = MockClock()
+    m = Meter(clock=clock)
+    for _ in range(12):
+        for _ in range(50):
+            m.mark()
+        clock.advance(5.0)
+    peak = m.rate1()
+    assert peak > 5.0
+    rates = []
+    for _ in range(24):  # two idle minutes, sampled every 5s tick
+        clock.advance(5.0)
+        rates.append(m.rate1())
+    assert all(a >= b for a, b in zip(rates, rates[1:])), "decay not monotone"
+    assert rates[0] < peak
+    assert rates[-1] < 0.2 * peak  # ~2 idle minutes kill most of a 1m EWMA
+    assert m.count == 600  # decay never forgets the lifetime count
+
+    idle = Meter(clock=clock)
+    clock.advance(300.0)
+    assert idle.rate1() == 0.0 and idle.count == 0
 
 
 def test_accel_probe_contract():

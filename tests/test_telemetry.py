@@ -1,0 +1,334 @@
+"""Telemetry-plane acceptance tests (sim/telemetry.py).
+
+The two load-bearing properties:
+
+1. **Bit-identity** — a telemetry-on run produces exactly the
+   telemetry-off state, tick for tick, across exchange modes, fault
+   models, heal on/off, and the run_until drivers (telemetry reads
+   intermediates; it must never feed back or consume PRNG draws).
+2. **Fidelity** — the fetched counters mean what they claim: paired
+   against brute-force recomputation from the per-tick states, and
+   against conservation laws (an all-up lossless cluster pings N times a
+   tick and declares nothing).
+
+Plus the plumbing: fetch-resets, journal records/headers, the stats/event
+bridges, the state digest, the DeltaSim journal hook, and the
+golden-drift diagnosis helper.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.events import EventEmitter, SimTickBlockEvent, on
+from ringpop_tpu.options import InMemoryStats
+from ringpop_tpu.sim import lifecycle, telemetry
+from ringpop_tpu.sim.delta import DeltaFaults, DeltaSim
+
+from tests import golden_tools
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        bool((np.asarray(x) == np.asarray(y)).all())
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _faults(n, n_victims=3, drop=0.0, seed=0):
+    rng = np.random.default_rng(seed)
+    victims = np.sort(rng.choice(n, size=n_victims, replace=False))
+    up = np.ones(n, bool)
+    up[victims] = False
+    return victims, DeltaFaults(up=jnp.asarray(up), drop_rate=drop)
+
+
+@pytest.mark.parametrize(
+    "pkw,drop",
+    [
+        (dict(k=32, suspect_ticks=6), 0.0),
+        (dict(k=32, suspect_ticks=6, exchange="uniform"), 0.05),
+        (dict(k=32, suspect_ticks=6, heal_prob=0.0), 0.05),
+    ],
+    ids=["shift", "uniform_drop", "no_heal"],
+)
+def test_step_bit_identical_with_telemetry(pkw, drop):
+    n, ticks = 96, 40
+    params = lifecycle.LifecycleParams(n=n, **pkw)
+    _, faults = _faults(n, drop=drop)
+    s_off = lifecycle.init_state(params, seed=5)
+    s_on = lifecycle.init_state(params, seed=5)
+    tel = telemetry.zeros(params)
+    stepper = jax.jit(functools.partial(lifecycle.step, params))
+    for _ in range(ticks):
+        s_off = stepper(s_off, faults)
+        s_on, tel = stepper(s_on, faults, telemetry=tel)
+    assert _leaves_equal(s_off, s_on)
+    assert int(tel.ticks) == ticks
+
+
+def test_counters_match_bruteforce_recomputation():
+    """Fetched counters equal sums recomputed from the per-tick state
+    evolution: ping_send from the fault-free shift topology, declarations
+    from the rumor table's placement history."""
+    n, ticks = 64, 50
+    params = lifecycle.LifecycleParams(n=n, k=32, suspect_ticks=8)
+    victims, faults = _faults(n, n_victims=2)
+    sim = lifecycle.LifecycleSim(n=n, k=32, seed=1, suspect_ticks=8, telemetry=True)
+    live = int(np.asarray(faults.up).sum())
+    for _ in range(ticks):
+        sim.tick(faults)
+    rec = sim.fetch_telemetry(faults)
+    assert rec["ticks"] == ticks
+    # shift topology, no drops: every live node whose belief allows the
+    # probe pings once a tick; dead targets/probers account for the gap
+    assert rec["ping_send"] <= live * ticks
+    assert rec["ping_send"] >= (live - 2 * len(victims)) * ticks
+    # victims were declared: suspect placements >= victims, faulty followed
+    assert rec["decl_suspect"] >= len(victims)
+    assert rec["decl_faulty"] >= len(victims)
+    assert rec["timer_fired"] >= len(victims)
+    assert rec["ping_timeout"] > 0 and rec["ping_req_send"] > 0
+    assert rec["rumors_piggybacked"] > 0
+    assert rec["detect_frac"] == pytest.approx(1.0)
+    assert rec["census_faulty"] == len(victims)
+    assert rec["num_members"] == n
+    # fetch reset the accumulators
+    rec2 = sim.fetch_telemetry(faults)
+    assert rec2["ticks"] == 0 and rec2["ping_send"] == 0
+    # census is point-in-time, not accumulated — it survives the reset
+    assert rec2["census_faulty"] == len(victims)
+
+
+def test_quiet_cluster_conserves():
+    """All nodes up, no loss, no victims: exactly N pings per tick, no
+    failed probes, no declarations, no timers, detect_frac saturated."""
+    n, ticks = 48, 30
+    sim = lifecycle.LifecycleSim(n=n, k=16, seed=2, telemetry=True)
+    for _ in range(ticks):
+        sim.tick()
+    rec = sim.fetch_telemetry()
+    assert rec["ping_send"] == n * ticks
+    for key in ("ping_timeout", "ping_req_send", "decl_suspect", "decl_faulty",
+                "decl_tombstone", "decl_alive", "refuted", "timer_fired"):
+        assert rec[key] == 0, key
+    assert rec["census_alive"] == n
+    assert rec["detect_frac"] == pytest.approx(1.0)
+
+
+def test_run_until_detected_bit_identical_and_flushes():
+    n = 128
+    victims, faults = _faults(n, n_victims=4, seed=3)
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(n=n, k=64, seed=3, suspect_ticks=10, telemetry=sink)
+    ticks, ok = sim.run_until_detected(victims, faults, max_ticks=1024)
+    ref = lifecycle.LifecycleSim(n=n, k=64, seed=3, suspect_ticks=10)
+    rticks, rok = ref.run_until_detected(victims, faults, max_ticks=1024)
+    assert (ticks, ok) == (rticks, rok) and ok
+    assert _leaves_equal(sim.state, ref.state)
+    # one flushed record per dispatch, counters covering every tick run
+    assert sink.records
+    assert sum(r["ticks"] for r in sink.records) == ticks
+    assert all("state_digest" in r for r in sink.records)
+    # quiescence driver flushes too, and states stay paired
+    sim.run_until_converged(faults, max_ticks=1024)
+    ref.run_until_converged(faults, max_ticks=1024)
+    assert _leaves_equal(sim.state, ref.state)
+
+
+def test_block_accumulation_equals_per_tick_stepping():
+    """_run_block's carried accumulator equals per-tick accumulation —
+    the fori carry loses nothing."""
+    n, ticks = 64, 24
+    params = lifecycle.LifecycleParams(n=n, k=32, suspect_ticks=6)
+    _, faults = _faults(n, seed=4)
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    s_blk, t_blk = blk(
+        lifecycle.init_state(params, seed=7), faults, ticks=ticks,
+        telemetry=telemetry.zeros(params),
+    )
+    stepper = jax.jit(functools.partial(lifecycle.step, params))
+    s_tick = lifecycle.init_state(params, seed=7)
+    t_tick = telemetry.zeros(params)
+    for _ in range(ticks):
+        s_tick, t_tick = stepper(s_tick, faults, telemetry=t_tick)
+    assert _leaves_equal(s_blk, s_tick)
+    assert _leaves_equal(t_blk, t_tick)
+
+
+def test_sink_fans_out_journal_stats_events(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    stats = InMemoryStats()
+    emitter = EventEmitter()
+    got_events = []
+    on(emitter, SimTickBlockEvent, got_events.append)
+    n = 96
+    victims, faults = _faults(n, seed=5)
+    with telemetry.TelemetryJournal(path) as journal:
+        journal.header("lifecycle", "unit", {"n": n})
+        sink = telemetry.TelemetrySink(journal=journal, stats=stats, emitter=emitter)
+        sim = lifecycle.LifecycleSim(
+            n=n, k=32, seed=5, suspect_ticks=8, telemetry=sink, journal_views=True
+        )
+        sim.run(32, faults)
+    records = telemetry.read_journal(path)
+    assert records[0]["kind"] == "header"
+    assert records[0]["toolchain"]["jax"] == jax.__version__
+    assert "mesh_budget" in records[0]
+    blocks = [r for r in records if r["kind"] == "block"]
+    assert blocks and blocks[0]["ticks"] == 32
+    # journal_views: the view-checksum summary rode along
+    assert "views_sum" in blocks[0] and "views_agree" in blocks[0]
+    # every journal value is a plain JSON scalar
+    assert all(
+        isinstance(v, (int, float, str, bool, dict, type(None)))
+        for r in records for v in r.values()
+    )
+    # stats bridge: host-plane namespace under ringpop.sim
+    assert stats.counters.get("ringpop.sim.ping.send", 0) > 0
+    assert "ringpop.sim.num-members" in stats.gauges
+    # event bridge
+    assert len(got_events) == len(blocks)
+    assert got_events[0].record["ticks"] == 32
+
+
+def test_tree_digest_detects_single_bit_flip():
+    params = lifecycle.LifecycleParams(n=32, k=32)
+    s = lifecycle.init_state(params, seed=0)
+    d1 = telemetry.tree_digest(s)
+    d2 = telemetry.tree_digest(lifecycle.init_state(params, seed=0))
+    assert int(d1) == int(d2)
+    flipped = s._replace(learned=s.learned.at[3, 0].set(s.learned[3, 0] ^ 1))
+    assert int(telemetry.tree_digest(flipped)) != int(d1)
+    # and it is order/position sensitive (swapping two rows changes it)
+    swapped = s._replace(self_inc=s.self_inc.at[0].set(1))
+    assert int(telemetry.tree_digest(swapped)) != int(d1)
+
+
+def test_delta_sim_journal_hook_bit_identical():
+    rows = []
+    d = DeltaSim(n=256, k=32, seed=9, telemetry_sink=lambda r: rows.append(jax.device_get(r)))
+    ticks, ok = d.run_until_converged(max_ticks=512, journal_every=16)
+    ref = DeltaSim(n=256, k=32, seed=9)
+    rticks, rok = ref.run_until_converged(max_ticks=512)
+    assert ok and rok and ticks == rticks
+    assert _leaves_equal(d.state, ref.state)
+    assert rows and float(rows[-1]["coverage"]) == pytest.approx(1.0)
+    assert [int(r["tick"]) for r in rows] == sorted(int(r["tick"]) for r in rows)
+    assert int(rows[-1]["digest"]) == int(telemetry.tree_digest(ref.state))
+
+
+def test_montecarlo_unaffected_by_telemetry_seam():
+    """The vmapped Monte-Carlo engine goes through the telemetry=None
+    default — replica 0 must still be bit-identical to a solo sim."""
+    from ringpop_tpu.sim.montecarlo import MonteCarlo
+
+    n = 64
+    mc = MonteCarlo(lifecycle.LifecycleParams(n=n, k=16), seeds=[11, 12])
+    mc.run(8)
+    solo = lifecycle.LifecycleSim(n=n, k=16, seed=11)
+    solo.run(8)
+    rep0 = jax.tree.map(lambda x: np.asarray(x)[0], mc.states)
+    assert _leaves_equal(rep0, solo.state)
+
+
+# -- golden drift diagnosis (tests/golden_tools.py) --------------------------
+
+
+class _FakeNpz(dict):
+    @property
+    def files(self):
+        return list(self.keys())
+
+
+def test_golden_fingerprint_roundtrip_and_diagnosis():
+    out = {}
+    golden_tools.embed(out)
+    npz = _FakeNpz(out)
+    assert golden_tools.recorded(npz) == golden_tools.fingerprint()
+
+    # same-toolchain mismatch → real regression
+    with pytest.raises(pytest.fail.Exception) as e:
+        golden_tools.fail_golden(npz, "cfg", "learned", 3)
+    assert "REAL REGRESSION" in str(e.value)
+
+    # different-toolchain mismatch → drift
+    stale = dict(golden_tools.fingerprint(), jax="0.0.1")
+    npz_drift = _FakeNpz({golden_tools.TOOLCHAIN_KEY: np.array(json.dumps(stale))})
+    with pytest.raises(pytest.fail.Exception) as e:
+        golden_tools.fail_golden(npz_drift, "cfg", "learned", 3)
+    assert "TOOLCHAIN DRIFT" in str(e.value)
+
+    # unrecorded (the committed pre-fingerprint goldens) → drift suspected
+    with pytest.raises(pytest.fail.Exception) as e:
+        golden_tools.fail_golden(_FakeNpz({}), "cfg", "learned", 0)
+    assert "UNRECORDED" in str(e.value)
+
+
+# -- CLI reporters: close()/context-manager (satellite) ----------------------
+
+
+def test_file_stats_context_manager_flushes_and_closes(tmp_path):
+    from ringpop_tpu.cli.stats import FileStats
+
+    path = str(tmp_path / "stats.out")
+    with FileStats(path) as fs:
+        fs.incr("a.counter", 2)
+        fs.gauge("a.gauge", 1.5)
+        fs.timing("a.timing", 0.25)
+        handle = fs._f
+    assert handle.closed
+    lines = open(path).read().strip().split("\n")
+    assert len(lines) == 3 and "count a.counter 2" in lines[0]
+    fs.close()  # idempotent
+    fs.incr("late", 1)  # post-close emits are dropped, not raised
+    assert len(open(path).read().strip().split("\n")) == 3
+
+
+def test_udp_statsd_context_manager_closes_socket(tmp_path):
+    import socket
+
+    from ringpop_tpu.cli.stats import UDPStatsd
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+    with UDPStatsd(f"127.0.0.1:{port}") as udp:
+        udp.incr("x", 3)
+        sock = udp._sock
+    assert udp._sock is None and sock.fileno() == -1
+    udp.close()  # idempotent
+    udp.gauge("late", 1.0)  # dropped silently after close
+    assert recv.recv(64) == b"x:3|c"
+    recv.close()
+
+
+def test_simbench_telemetry_flag_writes_parseable_journal(tmp_path):
+    """The CLI seam end to end: `simbench --telemetry` produces a journal
+    with a header per scenario and parseable block records."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "bench.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "ringpop_tpu.cli.simbench", "--cpu",
+         "--only", "loss1k", "--telemetry", path],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    result = json.loads(r.stdout.strip().splitlines()[-1])
+    assert result["detected"] is True
+    records = telemetry.read_journal(path)
+    headers = [x for x in records if x["kind"] == "header"]
+    blocks = [x for x in records if x["kind"] == "block"]
+    assert len(headers) == 1 and headers[0]["scenario"] == "loss1k"
+    assert blocks and sum(b["ticks"] for b in blocks) >= result["ticks"]
