@@ -27,15 +27,18 @@ telemetry-smoke:
 	$(PY) scripts/telemetry_smoke.py
 
 # compile the sharded programs at CI scale (8k, hierarchical select forced
-# on) and diff the collective census against the committed budget capture —
-# non-zero exit if any collective class regressed beyond tolerance.
+# on, the sharded-caller defaults rng=counter + shard-local exchange) and
+# diff the collective census against the committed budget capture — non-zero
+# exit if any collective class regressed beyond tolerance.  --phase-budget
+# additionally ratchets the exchange/peer-choice phase rows (r8), so a
+# regression there can't hide inside an unchanged global total.
 # Re-baseline (after an INTENDED budget change, with PERF.md updated):
 #   $(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
 #     --force-sparse --out captures/mesh_profile_small_budget.json
 profile-mesh:
 	$(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
 	  --force-sparse --compare captures/mesh_profile_small_budget.json \
-	  --out /tmp/mesh_profile_small.json
+	  --phase-budget --out /tmp/mesh_profile_small.json
 
 # skip the scale spot-checks
 test-fast:

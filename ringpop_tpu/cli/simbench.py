@@ -176,7 +176,7 @@ def bench_loss1k(seed: int, full: bool) -> dict:
 
     n = 1000
     sink = _telemetry_sink("loss1k", "lifecycle", {"n": n, "k": 128, "seed": seed})
-    sim = LifecycleSim(n=n, k=128, seed=seed, suspect_ticks=25, telemetry=sink)
+    sim = LifecycleSim(n=n, k=128, seed=seed, suspect_ticks=25, rng="counter", telemetry=sink)
     rng = np.random.default_rng(seed)
     victims = sorted(rng.choice(n, size=10, replace=False).tolist())
     up = np.ones(n, bool)
@@ -256,7 +256,7 @@ def bench_delta16m(seed: int, full: bool) -> dict:
     from ringpop_tpu.sim.delta import DeltaParams, init_state, run_until_converged
 
     n = 16_000_000 if full else 2_000_000
-    params = DeltaParams(n=n, k=64)
+    params = DeltaParams(n=n, k=64, rng="counter")
     # jitted init: eager pack_bool would materialize a multi-GB [N, W, 32]
     # intermediate at this scale; under jit only the packed output exists
     jinit = jax.jit(functools.partial(init_state, params), static_argnames="seed")
@@ -314,7 +314,7 @@ rng = np.random.default_rng(seed)
 victims = np.sort(rng.choice(n, size=100, replace=False))
 up = np.ones(n, bool); up[victims] = False
 faults = DeltaFaults(up=jnp.asarray(up))
-params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10)
+params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10, rng="counter")
 
 state = lifecycle.init_state(params, seed=seed)
 import functools
@@ -326,11 +326,18 @@ unsharded_s = time.perf_counter() - t0
 
 devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
 mesh = Mesh(devs, ("node", "rumor"))
+# the sharded twin runs the r8 sharded-caller defaults: same counter RNG
+# (partition-invariant, so the bit-equality below is exact) plus the
+# shard-local exchange legs (bit-identical data motion) — bound via the
+# one shared helper so its guards can't drift between sharded callers
+from ringpop_tpu.parallel.mesh import with_exchange_mesh
+sm_params = with_exchange_mesh(params, mesh)
+sm_blk = jax.jit(functools.partial(lifecycle._run_block, sm_params), static_argnames="ticks")
 shardings = lifecycle.state_shardings(mesh, k=params.k)
 sstate = jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed),
                       shardings)
 t0 = time.perf_counter()
-sout = blk(sstate, faults, ticks=ticks)
+sout = sm_blk(sstate, faults, ticks=ticks)
 jax.block_until_ready(sout.learned)
 sharded_s = time.perf_counter() - t0
 
@@ -367,14 +374,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 sh_detect_kw = dict(detect_kw, learned_sharding=NamedSharding(mesh, P("node", None)))
 t0 = time.perf_counter()
 dsh, sh_blocks, sh_done = lifecycle._run_until_detected_device(
-    params,
+    sm_params,
     jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed), shardings),
     faults, subjects, **sh_detect_kw)
 jax.block_until_ready(dsh.learned)
 detect_sharded_s = time.perf_counter() - t0
 t0 = time.perf_counter()
 dsh2, _, _ = lifecycle._run_until_detected_device(
-    params,
+    sm_params,
     jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed), shardings),
     faults, subjects, **sh_detect_kw)
 jax.block_until_ready(dsh2.learned)
@@ -404,7 +411,8 @@ print(json.dumps(dict(tick_equal=equal, n_devices=len(jax.devices("cpu")),
 # proves the mesh path compiles + executes at the shape the framework is
 # built for (memory-permitting; failure is reported, not fatal)
 try:
-    p1m = lifecycle.LifecycleParams(n=1_000_000, k=256, suspect_ticks=10)
+    p1m = lifecycle.LifecycleParams(n=1_000_000, k=256, suspect_ticks=10,
+                                    rng="counter", exchange_mesh=mesh)
     up1 = np.ones(p1m.n, bool); up1[::1000] = False
     f1m = DeltaFaults(up=jnp.asarray(up1))
     s1m = jax.tree.map(jax.device_put, lifecycle.init_state(p1m, seed=seed),
@@ -702,7 +710,7 @@ def bench_sweep100k(seed: int, full: bool) -> dict:
     faults = DeltaFaults(up=jnp.asarray(up))
     t0 = time.perf_counter()
     for suspect_ticks in (5, 25, 50):
-        sim = LifecycleSim(n=n, k=256, seed=seed, suspect_ticks=suspect_ticks)
+        sim = LifecycleSim(n=n, k=256, seed=seed, suspect_ticks=suspect_ticks, rng="counter")
         ticks, ok = sim.run_until_detected(victims, faults, max_ticks=4000)
         sweep[str(suspect_ticks)] = {"ticks": ticks, "detected": ok}
     elapsed = time.perf_counter() - t0
@@ -733,7 +741,7 @@ def bench_partition1m(seed: int, full: bool) -> dict:
     # 64-tick block; with no sink DeltaSim dispatches exactly the old
     # single-call path
     sink = _telemetry_sink("partition1m", "delta", {"n": n, "k": k, "seed": seed})
-    sim = DeltaSim(n=n, k=k, seed=seed, telemetry_sink=sink)
+    sim = DeltaSim(n=n, k=k, seed=seed, rng="counter", telemetry_sink=sink)
     try:
         t0 = time.perf_counter()
         # partition phase: dissemination proceeds within each side only
@@ -808,7 +816,7 @@ def bench_partition_lifecycle(seed: int, full: bool) -> dict:
     sink = _telemetry_sink(
         "partition_lc", "lifecycle", {"n": n, "k": k, "seed": seed}
     )
-    sim = lifecycle.LifecycleSim(n=n, k=k, seed=seed, telemetry=sink)
+    sim = lifecycle.LifecycleSim(n=n, k=k, seed=seed, rng="counter", telemetry=sink)
     try:
         # phase 1: headline failure detection, no partition
         t0 = time.perf_counter()

@@ -2,6 +2,12 @@ from ringpop_tpu.parallel.mesh import (
     make_mesh,
     shard_delta_state,
     sharded_delta_step,
+    with_exchange_mesh,
 )
 
-__all__ = ["make_mesh", "shard_delta_state", "sharded_delta_step"]
+__all__ = [
+    "make_mesh",
+    "shard_delta_state",
+    "sharded_delta_step",
+    "with_exchange_mesh",
+]

@@ -24,6 +24,7 @@ v5e-8 unchanged.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -81,14 +82,29 @@ def shard_delta_state(state: DeltaState, mesh: Mesh) -> DeltaState:
     return jax.tree.map(jax.device_put, state, sh)
 
 
+def with_exchange_mesh(params, mesh: Mesh):
+    """Return ``params`` with ``exchange_mesh`` bound to ``mesh`` (works for
+    DeltaParams and LifecycleParams alike) — the shift exchange then lowers
+    its roll legs as shard-local crossing-block ppermutes
+    (``parallel/shift.shard_roll``) instead of GSPMD's plane all-gathers.
+    Bit-identical values; a no-op when the caller already bound a mesh, or
+    when the mesh has no >1-way node axis to exchange over."""
+    if params.exchange_mesh is not None or mesh.shape.get("node", 1) <= 1:
+        return params
+    return dataclasses.replace(params, exchange_mesh=mesh)
+
+
 def sharded_delta_step(params: DeltaParams, mesh: Mesh):
-    """Jitted step with explicit in/out shardings over the mesh."""
+    """Jitted step with explicit in/out shardings over the mesh (and the
+    shift exchange's roll legs lowered shard-local — ``with_exchange_mesh``;
+    the partitioned program stays bit-equal to the unsharded one)."""
     from ringpop_tpu.sim.packbits import check_rumor_shardable
 
     # packed planes shard words, unpacked planes shard slots — k must be a
     # multiple of 32 * rumor_shards (shared rule; raises with the real
     # constraint instead of an opaque GSPMD divisibility error inside jit)
     check_rumor_shardable(params.k, mesh.shape.get("rumor", 1))
+    params = with_exchange_mesh(params, mesh)
     sh = delta_shardings(mesh)
     return jax.jit(
         functools.partial(step, params),

@@ -1,0 +1,117 @@
+"""Shard-local exchange legs for the ``exchange="shift"`` topology.
+
+The shift exchange's two legs are cyclic rolls of the packed rumor plane
+by a per-tick traced amount ``s``: ``out[i] = x[(i - s) mod n]``.  Under
+GSPMD the partitioner cannot route a traced shift — data must physically
+move between chips by an amount it cannot see at compile time — so it
+falls back to ALL-GATHERING the operand and slicing locally: one
+plane-sized gather per leg, the dominant class of the r6 collective
+budget (PERF.md "Multi-chip collective cost model").
+
+This module is the manual lowering the r6 analysis deferred.  Split each
+shard's ``nb``-row block into ``H`` equal sub-blocks and write
+``s = hq·(nb/H) + rh``.  Then every destination shard's output window
+covers exactly ``H+1`` consecutive sub-blocks of the input ring — only
+ONE sub-block per shard straddles the roll's crossing boundary — so:
+
+* ``hq`` is traced and a ``lax.ppermute`` perm must be static, so a
+  ``lax.switch`` over the ``H·S`` possible values of ``hq`` picks the
+  static perm set; exactly ONE branch executes per tick;
+* inside the branch, ``H+1`` ppermutes deliver the window's sub-blocks
+  (sends whose ring offset is 0 are local and skipped) and one local
+  ``dynamic_slice`` at ``nb/H - rh`` stitches the output.
+
+Per-chip bytes per leg drop from a full plane (the partitioner's
+all-gather) to ``(H+1)/H`` local blocks — at the default ``H=2``, 1.5
+local blocks, ~5× less than an 8-way all-gather — and the cost is flat
+in ``s``.  ``H+1`` sends per leg is the floor for this decomposition:
+the window spans ``H+1`` sub-blocks on up to two source shards, and a
+ppermute has one destination per source.  Raising ``H`` shaves padding
+(→ 1 local block as ``H → ∞``) but multiplies switch branches and
+per-send latency; ``H=2`` already clears the r8 byte budget.
+
+Bit-identity: the region is pure data movement (permute + concat +
+slice), so the result equals ``jnp.roll(x, s, axis=0)`` — and therefore
+the engines' materialized-index-gather formulation — exactly;
+``tests/test_mesh_budget.py`` pins it against the gather path over every
+shift class and the paired sharded trajectory runs certify it end to
+end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def shard_roll(leaves: tuple, shift, mesh: Mesh, axis: str, specs: tuple) -> tuple:
+    """``jnp.roll(x, shift, axis=0)`` for every array in ``leaves``, as the
+    crossing-block ppermute exchange described in the module docstring.
+
+    ``leaves``: arrays whose axis 0 is the full node axis (one shared n,
+    ``n % S == 0`` — the state-sharding divisibility rule).  ``shift``: a
+    traced int32 scalar in ``[0, n)``.  ``specs``: one ``PartitionSpec``
+    per leaf describing its sharding over ``mesh`` (axis 0 must be
+    ``axis``); they become the region's in/out specs, so the call neither
+    reshards its inputs nor leaves resharding work behind.
+
+    Requires ``mesh.shape[axis] > 1`` (with one node shard there is
+    nothing to exchange — callers keep the local gather path).
+    """
+    s_shards = mesh.shape[axis]
+    if s_shards <= 1:
+        raise ValueError("shard_roll needs >1 node shard; use the gather path")
+    n = leaves[0].shape[0]
+    if n % s_shards:
+        raise ValueError(f"n={n} not divisible by {s_shards} node shards")
+    nb = n // s_shards
+    h = 2 if nb % 2 == 0 else 1  # sub-blocks per shard (module docstring)
+    sub = nb // h
+
+    def body(shift, *locs):
+        hq = shift // sub
+        rh = shift - hq * sub
+
+        def branch(hqi: int):
+            # window part p (of H+1) for destination d is global sub-block
+            # H·d - m with m = hqi + 1 - p: it lives on the shard m/H
+            # (ceil) ring-steps back, at local sub-index (-m) mod H
+            plan = []
+            for p in range(h + 1):
+                m = hqi + 1 - p
+                ring = -(-m // h) % s_shards  # ceil(m/H) mod S
+                plan.append((ring, (-m) % h))
+
+            def run(rh, *xs):
+                outs = []
+                for x in xs:
+                    subs = x.reshape((h, sub) + x.shape[1:])
+                    parts = []
+                    for ring, si in plan:
+                        piece = subs[si]
+                        if ring:  # ring offset 0 = already local, no send
+                            perm = [(j, (j + ring) % s_shards) for j in range(s_shards)]
+                            piece = jax.lax.ppermute(piece, axis, perm)
+                        parts.append(piece)
+                    cat = jnp.concatenate(parts, axis=0)
+                    outs.append(jax.lax.dynamic_slice_in_dim(cat, sub - rh, nb, axis=0))
+                return tuple(outs)
+
+            return run
+
+        return jax.lax.switch(hq, [branch(i) for i in range(h * s_shards)], rh, *locs)
+
+    with jax.named_scope("shard-roll"):
+        kw = {"mesh": mesh, "in_specs": (P(),) + tuple(specs), "out_specs": tuple(specs)}
+        try:
+            fn = _shard_map(body, check_vma=False, **kw)
+        except TypeError:  # pragma: no cover - older jax spells it check_rep
+            fn = _shard_map(body, check_rep=False, **kw)
+        return fn(jnp.asarray(shift, jnp.int32), *leaves)

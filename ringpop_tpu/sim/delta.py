@@ -103,6 +103,16 @@ class DeltaParams:
     #               via scatter-max), closest to the reference's shuffled
     #               round-robin when probe independence matters.
     exchange: str = "shift"
+    # PRNG family: "threefry" = the jax.random draws the frozen goldens pin;
+    # "counter" = the partition-invariant stateless generator (sim/prng.py),
+    # shard-local with zero collectives and identical lanes on any mesh —
+    # the sharded-caller/simbench default.  See LifecycleParams.rng.
+    rng: str = "threefry"
+    # optional Mesh with a >1-way "node" axis: lower the shift exchange's
+    # roll legs as shard-local crossing-block ppermutes
+    # (parallel/shift.shard_roll) instead of GSPMD's plane all-gathers.
+    # Bit-identical; ``sharded_delta_step`` injects the run's mesh.
+    exchange_mesh: Optional["jax.sharding.Mesh"] = None
 
     def resolved_max_p(self) -> int:
         return resolve_max_p(self.n, self.p_factor, self.max_p)
@@ -164,15 +174,40 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     by tests/test_delta_golden.py."""
     n, k = params.n, params.k
     max_p = jnp.int8(clamped_max_p(params))
-    key, k_target, k_drop = jax.random.split(state.key, 3)
+    if params.rng not in ("threefry", "counter"):
+        raise ValueError(f"unknown rng family {params.rng!r}")
+    use_counter = params.rng == "counter"
+    if use_counter:
+        # stateless counter stream (sim/prng.py): the key leaf carries the
+        # seed material unchanged and the tick counter advances the stream
+        from ringpop_tpu.sim import prng as _prng
+
+        key = state.key
+        cseed = _prng.fold_key(state.key)
+        ctick = state.tick
+    else:
+        key, k_target, k_drop = jax.random.split(state.key, 3)
     i_all = jnp.arange(n, dtype=jnp.int32)
 
     shift_mode = params.exchange == "shift"
+    emesh = params.exchange_mesh
+    use_sm = (
+        shift_mode
+        and emesh is not None
+        and emesh.shape.get("node", 1) > 1
+        and n % emesh.shape["node"] == 0
+    )
     if shift_mode:
-        s = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+        if use_counter:
+            s = _prng.draw_randint(cseed, ctick, _prng.D_SHIFT, 0, 1, n)
+        else:
+            s = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
         targets = (i_all + s) % n
     else:
-        targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+        if use_counter:
+            targets = _prng.draw_randint(cseed, ctick, _prng.D_TARGET, i_all, 0, n - 1)
+        else:
+            targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
         targets = jnp.where(targets >= i_all, targets + 1, targets)
 
     up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
@@ -181,7 +216,12 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
         g = faults.group
         conn &= (g < 0) | (g[targets] < 0) | (g == g[targets])
     if faults.drop_rate > 0:
-        conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
+        drop_u = (
+            _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
+            if use_counter
+            else jax.random.uniform(k_drop, (n,))
+        )
+        conn &= drop_u >= faults.drop_rate
 
     if shift_mode:
         ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
@@ -190,14 +230,31 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
         # request leg: sender i's rumors land at targets[i].  The cyclic
         # permutation makes delivery a row gather (receipt uniqueness is
         # structural: node j is pinged only by j-s).
-        idx_fwd = jnp.mod(i_all - s, n)
         sent_w = riding_w & cmask
-        inbound_w = sent_w[idx_fwd]
-        got_pinged = conn[idx_fwd]
+        if use_sm:
+            # sharded callers: both roll legs as explicit shard-local
+            # crossing-block ppermutes (parallel/shift.shard_roll) instead
+            # of GSPMD's plane-sized all-gathers; bit-identical data motion
+            from jax.sharding import PartitionSpec as _P
+
+            from ringpop_tpu.parallel.shift import shard_roll
+
+            wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
+            inbound_w, got_pinged = shard_roll(
+                (sent_w, conn), s, emesh, "node", (wspec, _P("node"))
+            )
+        else:
+            idx_fwd = jnp.mod(i_all - s, n)
+            inbound_w = sent_w[idx_fwd]
+            got_pinged = conn[idx_fwd]
         learned1_w = state.learned | inbound_w
         # response leg: the target's riding rumors come back to the pinger
         answerable_w = learned1_w & ride_ok_w
-        resp_w = answerable_w[jnp.mod(i_all + s, n)] & cmask
+        if use_sm:
+            (resp_src,) = shard_roll((answerable_w,), n - s, emesh, "node", (wspec,))
+        else:
+            resp_src = answerable_w[jnp.mod(i_all + s, n)]
+        resp_w = resp_src & cmask
         learned2_w = learned1_w | resp_w
         # bump = sent + (riding & got_pinged) = riding * (conn + got):
         # the bit factor is ONE materialized-plane product (learned &

@@ -154,6 +154,21 @@ class LifecycleParams:
     # cluster-wide (swim/node.go:59-67, heal_via_discover_provider.go:63-88),
     # i.e. ~0.02 per 200ms tick.
     heal_prob: float = 0.02
+    # PRNG family: "threefry" = the jax.random draws the frozen goldens pin
+    # (replicated/lane-divergent under GSPMD); "counter" = the
+    # partition-invariant stateless generator (sim/prng.py) — every lane a
+    # pure function of (seed, tick, lane, draw site), shard-local with zero
+    # collectives and identical lanes on any mesh.  Sharded callers and
+    # simbench default to "counter"; the two families draw different
+    # (equally valid) trajectories.
+    rng: str = "threefry"
+    # optional jax.sharding.Mesh with a >1-way "node" axis: lower the shift
+    # exchange's two roll legs as explicit shard-local crossing-block
+    # ppermutes (parallel/shift.shard_roll) instead of the plane-sized
+    # all-gather GSPMD emits for a traced-shift gather.  Bit-identical to
+    # the gather path by construction; None (default) keeps the
+    # single-device lowering.
+    exchange_mesh: Optional["jax.sharding.Mesh"] = None
 
     def resolved_max_p(self) -> int:
         return resolve_max_p(self.n, self.p_factor, self.max_p)
@@ -391,7 +406,21 @@ def step(
         n, k = params.n, params.k
         m = min(params.alloc_per_tick, params.k, params.n)
         maxp = jnp.int8(clamped_max_p(params))
-        key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
+        if params.rng not in ("threefry", "counter"):
+            raise ValueError(f"unknown rng family {params.rng!r}")
+        use_counter = params.rng == "counter"
+        if use_counter:
+            # stateless counter stream (sim/prng.py): the key leaf is never
+            # split — it carries the seed material and the tick counter
+            # advances the stream, so every draw below is a pure
+            # (shard-local, partition-invariant) function of its lane
+            from ringpop_tpu.sim import prng as _prng
+
+            key = state.key
+            cseed = _prng.fold_key(state.key)
+            ctick = state.tick
+        else:
+            key, k_target, k_drop, k_peers, k_heal = jax.random.split(state.key, 5)
         # incarnation epoch = tick counter (strictly increasing, like the
         # reference's wall-ms but 200× denser in int32: 2^28 ticks ≈ 621 days of
         # simulated time before the packed key would overflow)
@@ -417,8 +446,18 @@ def step(
     with jax.named_scope("ping-target"):
         # -- ping target selection + belief gate --------------------------------
         shift_mode = params.exchange == "shift"
+        emesh = params.exchange_mesh
+        use_sm = (
+            shift_mode
+            and emesh is not None
+            and emesh.shape.get("node", 1) > 1
+            and n % emesh.shape["node"] == 0
+        )
         if shift_mode:
-            shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+            if use_counter:
+                shift = _prng.draw_randint(cseed, ctick, _prng.D_SHIFT, 0, 1, n)
+            else:
+                shift = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
             targets = (i_all + shift) % n
             # belief[i] about its target: in shift mode each subject has
             # exactly one prober i = (s - shift) mod n, so the dense masked
@@ -431,7 +470,10 @@ def step(
                 jnp.where(active, prober, jnp.int32(n))
             ].max(bel_vals, mode="drop")
         else:
-            targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+            if use_counter:
+                targets = _prng.draw_randint(cseed, ctick, _prng.D_TARGET, i_all, 0, n - 1)
+            else:
+                targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
             targets = jnp.where(targets >= i_all, targets + 1, targets)
             learned0_b = unpack_bits(state.learned, k)
             bel_rumor = _bel_rumor_dense(learned0_b, state.r_subject, rkey, active, targets)
@@ -443,7 +485,12 @@ def step(
     with jax.named_scope("rumor-exchange"):
         conn = _pair_connected(faults, i_all, targets)
         if faults.drop_rate > 0:
-            conn &= jax.random.uniform(k_drop, (n,)) >= faults.drop_rate
+            drop_u = (
+                _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
+                if use_counter
+                else jax.random.uniform(k_drop, (n,))
+            )
+            conn &= drop_u >= faults.drop_rate
         delivered = conn & wants
 
         # -- piggyback exchange: request leg + response leg ---------------------
@@ -455,19 +502,40 @@ def step(
             dmask = row_mask(delivered)
             riding_w = state.learned & ride_ok_w & active_w[None, :]
             sent_w = riding_w & dmask
-            # rolls as explicit row gathers with precomputed index vectors:
-            # jnp.roll with a traced shift lowers to a slice-select chain that
-            # XLA re-derives PER CONSUMING ELEMENT when fused downstream
-            # (measured as the dominant cost of the tick); a gather through a
-            # materialized [N] index vector is one address lookup per element
-            # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
-            idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
-            idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
-            inbound_w = sent_w[idx_fwd]
-            got_pinged = delivered[idx_fwd]
+            if use_sm:
+                # sharded callers: the two roll legs as explicit shard-local
+                # crossing-block ppermutes (parallel/shift.shard_roll, H+1
+                # sub-block sends per leg) — per-leg cross-chip bytes drop
+                # from the plane-sized all-gather GSPMD emits for a
+                # traced-index gather to ~1.5 local blocks per chip.
+                # Bit-identical: the region is pure data movement.
+                from jax.sharding import PartitionSpec as _P
+
+                from ringpop_tpu.parallel.shift import shard_roll
+
+                wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
+                vspec = _P("node")
+                inbound_w, got_pinged = shard_roll(
+                    (sent_w, delivered), shift, emesh, "node", (wspec, vspec)
+                )
+            else:
+                # rolls as explicit row gathers with precomputed index vectors:
+                # jnp.roll with a traced shift lowers to a slice-select chain that
+                # XLA re-derives PER CONSUMING ELEMENT when fused downstream
+                # (measured as the dominant cost of the tick); a gather through a
+                # materialized [N] index vector is one address lookup per element
+                # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
+                idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
+                inbound_w = sent_w[idx_fwd]
+                got_pinged = delivered[idx_fwd]
             learned1_w = state.learned | inbound_w
             answerable_w = learned1_w & ride_ok_w & active_w[None, :]
-            resp_w = answerable_w[idx_back] & dmask
+            if use_sm:
+                (resp_src,) = shard_roll((answerable_w,), n - shift, emesh, "node", (wspec,))
+            else:
+                idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
+                resp_src = answerable_w[idx_back]
+            resp_w = resp_src & dmask
             learned2_w = learned1_w | resp_w
         else:
             ride_ok_b = state.pcount < maxp
@@ -491,11 +559,17 @@ def step(
         # AttemptHeal); detractions thereby reach their subjects, whose
         # refutations re-establish cross-partition liveness.
         if params.heal_prob > 0:
-            kh1, kh2, kh3 = jax.random.split(k_heal, 3)
-            h = jax.random.randint(kh1, (), 0, n, dtype=jnp.int32)
-            p = jax.random.randint(kh2, (), 0, n, dtype=jnp.int32)
+            if use_counter:
+                h = _prng.draw_randint(cseed, ctick, _prng.D_HEAL_A, 0, 0, n)
+                p = _prng.draw_randint(cseed, ctick, _prng.D_HEAL_B, 0, 0, n)
+                heal_u = _prng.draw_uniform(cseed, ctick, _prng.D_HEAL_U, 0)
+            else:
+                kh1, kh2, kh3 = jax.random.split(k_heal, 3)
+                h = jax.random.randint(kh1, (), 0, n, dtype=jnp.int32)
+                p = jax.random.randint(kh2, (), 0, n, dtype=jnp.int32)
+                heal_u = jax.random.uniform(kh3, ())
             attempt = (
-                (jax.random.uniform(kh3, ()) < params.heal_prob)
+                (heal_u < params.heal_prob)
                 & (h != p)
                 & up[h]
                 & up[p]
@@ -694,6 +768,42 @@ def step(
         )
         eff_max = jnp.maximum(subj_rumor_max, base_key)
 
+    with jax.named_scope("peer-choice"):
+        # -- the [N, P] indirect-probe draws, in their own phase scope so the
+        # collective census can see them in isolation: under rng="threefry"
+        # this is the non-partitionable draw that materializes replicated
+        # (~12 MB/chip/tick at 1M) AND generates different lanes sharded vs
+        # unsharded; under rng="counter" it is elementwise in (node, column)
+        # and the phase carries ZERO cross-chip collectives
+        # (tests/test_mesh_budget.py asserts exactly that)
+        if use_counter:
+            if params.ping_req_size >= _prng.D_COLUMN_SPAN:
+                raise ValueError(
+                    f"ping_req_size={params.ping_req_size} overflows the "
+                    f"counter RNG's per-site column span "
+                    f"({_prng.D_COLUMN_SPAN}): column draws would collide "
+                    "with the next draw site's stream (sim/prng.py)"
+                )
+            pcols = jnp.arange(params.ping_req_size, dtype=jnp.int32)[None, :]
+            peer_choices = _prng.draw_randint(
+                cseed, ctick, _prng.D_PEER + pcols, i_all[:, None], 0, n
+            )
+            if faults.drop_rate > 0:
+                pd_req_u = _prng.draw_uniform(
+                    cseed, ctick, _prng.D_PEER_DROP_REQ + pcols, i_all[:, None]
+                )
+                pd_ack_u = _prng.draw_uniform(
+                    cseed, ctick, _prng.D_PEER_DROP_ACK + pcols, i_all[:, None]
+                )
+        else:
+            k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
+            peer_choices = jax.random.randint(
+                k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
+            )
+            if faults.drop_rate > 0:
+                pd_req_u = jax.random.uniform(k_pd1, peer_choices.shape)
+                pd_ack_u = jax.random.uniform(k_pd2, peer_choices.shape)
+
     with jax.named_scope("candidate-select"):
         # -- refutation candidates (memberlist.go:337-354) ----------------------
         # only (node == slot subject) pairs can self-detect a detraction, so
@@ -720,10 +830,6 @@ def step(
 
         # -- failed probe → indirect probes → Suspect (node.go:494-510) ---------
         probing = wants & ~conn
-        k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
-        peer_choices = jax.random.randint(
-            k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
-        )
         i_bcast = jnp.broadcast_to(i_all[:, None], peer_choices.shape)
         peer_ok = (
             _pair_connected(faults, i_bcast, peer_choices)
@@ -737,10 +843,8 @@ def step(
         )
         # each indirect leg is its own RPC and suffers packet loss too
         if faults.drop_rate > 0:
-            peer_ok &= jax.random.uniform(k_pd1, peer_choices.shape) >= faults.drop_rate
-            peer_reaches &= peer_ok & (
-                jax.random.uniform(k_pd2, peer_choices.shape) >= faults.drop_rate
-            )
+            peer_ok &= pd_req_u >= faults.drop_rate
+            peer_reaches &= peer_ok & (pd_ack_u >= faults.drop_rate)
         reached = peer_reaches.any(axis=1)
         inconclusive = (~peer_ok).all(axis=1)
         declare = probing & ~reached & ~inconclusive

@@ -1,0 +1,121 @@
+"""Partition-invariant counter RNG for the sim engines (``rng="counter"``).
+
+The engines' default threefry draws are correct but GSPMD-hostile: threefry
+is not partitionable, so a sharded ``[N, P]`` draw either materializes
+REPLICATED (the r6 budget's ~12 MB/chip/tick peer-choice all-reduce) or —
+worse — the partitioner generates DIFFERENT lanes for the sharded output
+than the unsharded program produces (the r7 telemetry finding: the sharded
+peer-sampling draw diverges on ~100% of lanes; protocol state was immune at
+the committed configs only because ``up[targets]`` masks every lane that
+could matter — see ROADMAP "residual sharded-tick traffic").
+
+This module is the fix, built to the Ising-on-TPU discipline of making
+every per-lane random quantity a pure function of its coordinates: a value
+is ``h(seed, tick, draw-site, lane)`` where ``h`` is a chain of murmur3
+fmix32 finalizers (``packbits.mix32`` — the repo's one shared
+full-avalanche mixer).  Consequences, by construction:
+
+* **shard-local**: the lane argument is the only array input, and ``h`` is
+  elementwise in it — the partitioner keeps every draw on the shard that
+  owns the lane, with ZERO collectives under any mesh;
+* **partition-invariant**: lane ``i``'s value never depends on which shard
+  computes it, so sharded and unsharded programs draw IDENTICAL lanes
+  (``tests/test_prng.py`` pins 1/2/4/8-way meshes bit-equal, and the
+  engine-level sharded-vs-unsharded run matches including the telemetry
+  counters that exposed the threefry divergence);
+* **stateless**: the carried ``key`` leaf is never split — it holds the
+  run's seed material and the tick counter advances the stream — so the
+  per-tick key-derivation ops vanish from the step too.
+
+NOT a cryptographic generator, and NOT bit-compatible with the threefry
+draws: ``rng="counter"`` is a different (equally valid) trajectory family.
+The frozen goldens therefore stay on ``rng="threefry"``; sharded callers
+and ``simbench`` default to the counter stream.
+
+Statistical quality: each draw site gets its own stream constant
+(fmix32-folded), and lanes walk a Weyl sequence through two further fmix32
+rounds — the SplitMix construction, which is far beyond what an epidemic
+sim needs.  ``tests/test_prng.py`` chi-squares 1M draws as a smoke check.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.sim.packbits import mix32
+
+# the golden-ratio Weyl increment (2^32 / phi, odd) — SplitMix's stream
+# stride; full-period over uint32 because it is odd
+_GAMMA = 0x9E37_79B9
+
+# -- per-call-site draw ids ---------------------------------------------------
+# One id per PRNG consumption site per tick, shared by the delta and
+# lifecycle engines (a site unused by an engine simply never draws).
+# Multi-column sites (the P indirect-probe peers) add their column index to
+# a base spaced D_COLUMN_SPAN apart — so two sites collide (correlated
+# streams!) if a column index ever reaches the span.  The lifecycle engine
+# guards ``ping_req_size < D_COLUMN_SPAN`` at trace time; widen the span
+# here if a config ever legitimately needs more indirect-probe fan-out.
+D_COLUMN_SPAN = 0x100
+D_SHIFT = 1  # exchange="shift" cyclic offset (scalar)
+D_TARGET = 2  # exchange="uniform" per-node targets
+D_DROP = 3  # per-node packet-loss coin on the direct probe
+D_HEAL_A = 4  # healer endpoint a (scalar)
+D_HEAL_B = 5  # healer endpoint b (scalar)
+D_HEAL_U = 6  # healer attempt coin (scalar)
+D_PEER = 1 * D_COLUMN_SPAN  # + column j: indirect-probe peer choice [N, P]
+D_PEER_DROP_REQ = 2 * D_COLUMN_SPAN  # + column j: ping-req request-leg loss [N, P]
+D_PEER_DROP_ACK = 3 * D_COLUMN_SPAN  # + column j: ping-req ack-leg loss [N, P]
+
+
+def fold_key(key) -> jax.Array:
+    """uint32 scalar seed from an engine ``state.key`` leaf (the raw
+    uint32[2] threefry key ``init_state`` already carries) — the counter
+    stream reuses the existing state layout instead of adding a seed leaf.
+    Works for any uint32 vector; vmappable (the Monte-Carlo replica batch
+    maps distinct keys to distinct streams)."""
+    k = jnp.ravel(jnp.asarray(key)).astype(jnp.uint32)
+    seed = jnp.uint32(0)
+    for i in range(k.shape[0]):
+        seed = mix32(seed ^ k[i] ^ jnp.uint32((i + 1) * _GAMMA & 0xFFFF_FFFF))
+    return seed
+
+
+def draw_u32(seed, tick, draw, lane) -> jax.Array:
+    """uint32 ``h(seed, tick, draw, lane)`` — elementwise in every
+    argument (all broadcast; ``lane`` is normally the only array).  The
+    (seed, tick, draw) triple folds into a per-site stream constant —
+    scalar at every engine call site, so it traces to a handful of
+    replicated scalar ops — and the lane then takes two fmix32 rounds on
+    a Weyl walk seeded by that stream."""
+    stream = mix32(
+        jnp.asarray(seed).astype(jnp.uint32)
+        ^ mix32(
+            jnp.asarray(tick).astype(jnp.uint32)
+            ^ mix32(jnp.asarray(draw).astype(jnp.uint32) * jnp.uint32(_GAMMA))
+        )
+    )
+    x = jnp.asarray(lane).astype(jnp.uint32) * jnp.uint32(_GAMMA) + stream
+    return mix32(mix32(x) ^ stream)
+
+
+def draw_uniform(seed, tick, draw, lane) -> jax.Array:
+    """float32 in [0, 1) — the top 24 bits of the u32 draw (exactly
+    representable; same construction as jax.random.uniform's mantissa
+    fill)."""
+    return (draw_u32(seed, tick, draw, lane) >> 8).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24)
+    )
+
+
+def draw_randint(seed, tick, draw, lane, lo: int, hi: int) -> jax.Array:
+    """int32 in [lo, hi) via modulo reduction.  The modulo bias is
+    (hi-lo)/2^32 — ~2e-4 relative at the 1M-node headline, noise against
+    the protocol's own stochasticity and far below what the uniformity
+    smoke can resolve; accepted for staying in uint32 (TPU-native, no
+    64-bit ops)."""
+    span = hi - lo
+    if span <= 0:
+        raise ValueError(f"empty randint range [{lo}, {hi})")
+    return (jnp.int32(lo) + (draw_u32(seed, tick, draw, lane) % jnp.uint32(span)).astype(jnp.int32))

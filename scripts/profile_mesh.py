@@ -21,14 +21,33 @@ from one-shot entry computations.
 Usage:
     python scripts/profile_mesh.py [--step-n N] [--detect-n N] [--out FILE]
                                    [--compare BASE.json] [--force-sparse]
+                                   [--rng counter|threefry]
+                                   [--exchange shardmap|gspmd]
+                                   [--phase-budget]
 
 ``--compare BASE.json`` diffs this run against a prior capture (same n/k
 config) and prints a per-collective-class delta table — count and
 MB/chip/tick — exiting non-zero if any class regressed beyond the
 tolerance, so the collective budget is a ratchet, not a trivia table.
+``--phase-budget`` additionally ratchets the per-phase table for the
+protocol phases named in ``PHASE_BUDGET_PHASES`` (the exchange and
+peer-choice classes this round's work pinned), so a regression can't
+hide inside an unchanged global total.
 ``--force-sparse`` drops the sparse candidate path's engagement floor so
 a small --step-n profile exercises the same hierarchical-select code
 path as the 1M headline (CI-speed budget checks).
+``--rng``/``--exchange`` select the engine's PRNG family and roll-leg
+lowering (defaults: the sharded-caller defaults, ``counter`` +
+``shardmap``; the r8 'before' capture was taken with ``threefry`` +
+``gspmd`` — the r6/r7 program — under the SAME parser).
+
+Census semantics (r8): collectives inside sibling branches of one
+``conditional`` (``lax.switch``/``lax.cond``) are mutually exclusive per
+execution — the shift exchange's shard-local lowering switches over the
+traced shard offset, and the sparse candidate select conds between the
+hierarchical path and its full-sort fallback — so every summary charges
+only the most expensive branch of each conditional (worst case actually
+executable per tick), not the sum of all branches in the program text.
 """
 
 from __future__ import annotations
@@ -63,6 +82,7 @@ PHASES = (
     "heal",
     "piggyback-counters",
     "timers-fold",
+    "peer-choice",
     "candidate-select",
     "alloc-seed",
     "commit",
@@ -71,7 +91,14 @@ PHASES = (
     "view-checksum",
     "row-reduce",
     "set-bit",
+    "shard-roll",
 )
+
+# the phases --phase-budget ratchets (r8): the exchange legs must stay
+# ppermute-only and the peer-choice draws collective-free — a regression
+# in either can hide inside a roughly-unchanged global total, which is
+# exactly what the per-phase ratchet exists to catch
+PHASE_BUDGET_PHASES = ("rumor-exchange", "ping-target", "peer-choice", "shard-roll")
 
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
 _SRC_RE = re.compile(r'source_file="([^"]+)" source_line=(\d+)')
@@ -159,12 +186,20 @@ def parse_collectives(hlo_path: str) -> dict:
     """Per-computation collective census of one optimized HLO module.
 
     Returns {computation_name: [{op, kind, bytes}...]} plus, for loop
-    attribution, each computation's while-loop depth: a collective inside
+    attribution, each computation's while-loop depth (a collective inside
     a while BODY executes once per iteration, so depth distinguishes the
-    one-shot entry collectives from the per-tick / per-walk-step ones."""
+    one-shot entry collectives from the per-tick / per-walk-step ones),
+    the ``conditional`` branch groups (lists of sibling branch
+    computations, of which exactly ONE executes per evaluation), and the
+    ``executed`` computation set: everything reachable from the module
+    roots taking only the most expensive branch of each conditional —
+    the worst case one execution can actually pay.  Summaries charge the
+    executed set only; ``by_computation`` keeps the full text census."""
     comps: dict = {}
     bodies: dict = {}  # while-body computation -> owning computation
-    calls: dict = {}  # computation -> called computations (non-while)
+    calls: dict = {}  # computation -> calling computations (reverse edges)
+    fwd: dict = {}  # computation -> called computations (forward edges)
+    cond_groups: list = []  # [{caller, branches: [comp, ...]}, ...]
     cur = None
     # instruction/computation names carry a "%" sigil in older XLA text
     # dumps and none in current ones — accept both, or a format rotation
@@ -194,8 +229,25 @@ def parse_collectives(hlo_path: str) -> dict:
             b = re.search(r"body=%?([\w.\-]+)", line)
             if b:
                 bodies[b.group(1)] = cur
-            for callee in re.findall(r"(?:calls|to_apply|condition)=%?([\w.\-]+)", line):
+            # conditional branches: N-ary (lax.switch) and binary forms
+            branches = []
+            bm = re.search(r"branch_computations=\{([^}]*)\}", line)
+            if bm:
+                branches = [c.strip().lstrip("%") for c in bm.group(1).split(",") if c.strip()]
+            else:
+                tm = re.search(r"true_computation=%?([\w.\-]+)", line)
+                fm = re.search(r"false_computation=%?([\w.\-]+)", line)
+                if tm and fm:
+                    branches = [tm.group(1), fm.group(1)]
+            if branches:
+                cond_groups.append({"caller": cur, "branches": branches})
+            for callee in re.findall(
+                r"(?:calls|to_apply|condition|body|true_computation|"
+                r"false_computation)=%?([\w.\-]+)",
+                line,
+            ) + branches:
                 calls.setdefault(callee, set()).add(cur)
+                fwd.setdefault(cur, set()).add(callee)
 
     def loop_depth(name: str, seen=()) -> int:
         if name in seen:
@@ -207,9 +259,60 @@ def parse_collectives(hlo_path: str) -> dict:
             best = max(best, loop_depth(owner, seen + (name,)))
         return best
 
+    # -- worst-case-executed computation set: at every conditional take the
+    # branch whose subtree carries the most collective bytes (count as
+    # tie-break); sibling branches are mutually exclusive per execution
+    branch_edges = {
+        (g["caller"], b) for g in cond_groups for b in g["branches"]
+    }
+    groups_of = {}
+    for g in cond_groups:
+        groups_of.setdefault(g["caller"], []).append(g["branches"])
+
+    def subtree_cost(name, seen=()):
+        if name in seen:
+            return (0, 0)
+        seen = seen + (name,)
+        by, ct = 0, 0
+        for r in comps.get(name, ()):
+            by += r["bytes"]
+            ct += 1
+        for branches in groups_of.get(name, []):
+            bb, bc = max((subtree_cost(b, seen) for b in branches), default=(0, 0))
+            by += bb
+            ct += bc
+        for callee in fwd.get(name, ()):
+            if (name, callee) in branch_edges:
+                continue
+            cb, cc = subtree_cost(callee, seen)
+            by += cb
+            ct += cc
+        return (by, ct)
+
+    executed: set = set()
+
+    def walk(name):
+        if name in executed:
+            return
+        executed.add(name)
+        for branches in groups_of.get(name, []):
+            walk(max(branches, key=lambda b: subtree_cost(b)))
+        for callee in fwd.get(name, ()):
+            if (name, callee) not in branch_edges:
+                walk(callee)
+
+    all_names = set(comps) | set(fwd) | {c for cs in fwd.values() for c in cs}
+    roots = all_names - {c for cs in fwd.values() for c in cs}
+    for r in sorted(roots):
+        walk(r)
+    if not roots:  # degenerate single-computation module
+        executed = all_names
+
     return {
         "computations": {k: v for k, v in comps.items() if v},
         "loop_depth": {k: loop_depth(k) for k, v in comps.items() if v},
+        "cond_groups": cond_groups,
+        "executed": sorted(executed),
     }
 
 
@@ -222,13 +325,24 @@ def _newest_module(dump: str, marker: str) -> str | None:
     return max(mods, key=os.path.getsize) if mods else None
 
 
+def executed_rows(census: dict):
+    """Iterate (computation, row) over the worst-case EXECUTED collective
+    set: sibling conditional branches contribute only their most expensive
+    member (see parse_collectives) — the census tests and both summaries
+    share this one definition of "per-tick cost"."""
+    executed = set(census.get("executed") or census["computations"])
+    for comp, rows in census["computations"].items():
+        if comp in executed:
+            for r in rows:
+                yield comp, r
+
+
 def _summarize(census: dict) -> dict:
     by_kind: dict = {}
-    for rows in census["computations"].values():
-        for r in rows:
-            e = by_kind.setdefault(r["kind"], {"count": 0, "bytes": 0})
-            e["count"] += 1
-            e["bytes"] += r["bytes"]
+    for _, r in executed_rows(census):
+        e = by_kind.setdefault(r["kind"], {"count": 0, "bytes": 0})
+        e["count"] += 1
+        e["bytes"] += r["bytes"]
     return by_kind
 
 
@@ -236,12 +350,11 @@ def _summarize_phases(census: dict) -> dict:
     """{phase: {kind: {count, bytes}}} — the protocol-phase attribution of
     the collective census (the table PERF.md's budget discussion reads)."""
     by_phase: dict = {}
-    for rows in census["computations"].values():
-        for r in rows:
-            kinds = by_phase.setdefault(r.get("phase", "(unattributed)"), {})
-            e = kinds.setdefault(r["kind"], {"count": 0, "bytes": 0})
-            e["count"] += 1
-            e["bytes"] += r["bytes"]
+    for _, r in executed_rows(census):
+        kinds = by_phase.setdefault(r.get("phase", "(unattributed)"), {})
+        e = kinds.setdefault(r["kind"], {"count": 0, "bytes": 0})
+        e["count"] += 1
+        e["bytes"] += r["bytes"]
     return by_phase
 
 
@@ -265,6 +378,24 @@ def main() -> None:
         "--force-sparse", action="store_true",
         help="drop the sparse candidate path's n floor so small --step-n "
         "profiles exercise the hierarchical select like the 1M step does",
+    )
+    ap.add_argument(
+        "--rng", choices=("counter", "threefry"), default="counter",
+        help="engine PRNG family (default: the sharded-caller default, "
+        "'counter' — partition-invariant, zero peer-choice collectives); "
+        "'threefry' reproduces the r6/r7 program",
+    )
+    ap.add_argument(
+        "--exchange", choices=("shardmap", "gspmd"), default="shardmap",
+        help="shift-exchange roll-leg lowering: 'shardmap' = the shard-local "
+        "crossing-block ppermutes (default), 'gspmd' = the r6/r7 "
+        "partitioner-inferred all-gathers",
+    )
+    ap.add_argument(
+        "--phase-budget", action="store_true",
+        help="with --compare: also ratchet the per-phase table for "
+        f"{PHASE_BUDGET_PHASES} (fails on per-phase regressions that an "
+        "unchanged global total would hide)",
     )
     args = ap.parse_args()
 
@@ -301,11 +432,18 @@ def _run(args, dump: str) -> int:
 
     devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
     mesh = Mesh(devs, ("node", "rumor"))
-    report: dict = {"mesh": "4x2 (node x rumor), virtual CPU devices"}
+    report: dict = {
+        "mesh": "4x2 (node x rumor), virtual CPU devices",
+        "rng": args.rng,
+        "exchange_lowering": args.exchange,
+    }
+    engine_kw = dict(rng=args.rng)
+    if args.exchange == "shardmap":
+        engine_kw["exchange_mesh"] = mesh
 
     # -- 1) one-tick step at headline scale --------------------------------
     n, k = args.step_n, args.step_k
-    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10)
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10, **engine_kw)
     up = np.ones(n, bool)
     up[:: max(n // 1000, 1)] = False
     faults = DeltaFaults(up=jnp.asarray(up))
@@ -341,7 +479,7 @@ def _run(args, dump: str) -> int:
     for f in glob.glob(os.path.join(dump, "*")):
         shutil.rmtree(f) if os.path.isdir(f) else os.remove(f)
     nd = args.detect_n
-    dparams = lifecycle.LifecycleParams(n=nd, k=256, suspect_ticks=10)
+    dparams = lifecycle.LifecycleParams(n=nd, k=256, suspect_ticks=10, **engine_kw)
     dup = np.ones(nd, bool)
     dup[:: max(nd // 100, 1)] = False
     dfaults = DeltaFaults(up=jnp.asarray(dup))
@@ -415,18 +553,35 @@ def _run(args, dump: str) -> int:
     print(json.dumps({"profile_mesh": {k2: report[k2]["by_kind"]
                                        for k2 in ("step", "detect")}}))
     if args.compare:
-        return _compare(report, args.compare, args.tolerance)
+        return _compare(report, args.compare, args.tolerance,
+                        phase_budget=args.phase_budget)
     return 0
 
 
-def _compare(report: dict, base_path: str, tol: float) -> int:
+def _compare(report: dict, base_path: str, tol: float,
+             phase_budget: bool = False) -> int:
     """Per-collective-class delta vs a prior capture; non-zero on any
     regression beyond ``tol`` (relative count/bytes growth, with a small
-    absolute slack so zero-byte classes don't trip on rounding)."""
+    absolute slack so zero-byte classes don't trip on rounding).  With
+    ``phase_budget``, the PHASE_BUDGET_PHASES rows of the per-phase table
+    are ratcheted the same way — so e.g. a new exchange-leg all-gather
+    fails even if a win elsewhere keeps the global class total flat."""
     with open(base_path) as f:
         base = json.load(f)
     rc = 0
     slack_bytes = 64 * 1024  # one stray [16, cap]-class buffer, not an [N]
+    # pre-r8 captures carry no rng/exchange keys — every one of them was
+    # the threefry + partitioner-roll program, so default the comparison
+    # to that instead of silently skipping the program-identity check
+    legacy_program = {"rng": "threefry", "exchange_lowering": "gspmd"}
+    for key in ("rng", "exchange_lowering"):
+        base_val = base.get(key, legacy_program[key])
+        if base_val != report.get(key):
+            print(f"compare: {key} mismatch vs {base_path}: "
+                  f"{report.get(key)} baseline {base_val} — "
+                  "the budgets describe different programs (re-capture the "
+                  f"baseline, or pass --{key.split('_')[0]} {base_val})")
+            return 3
     for prog in ("step", "detect"):
         cur, old = report.get(prog, {}), base.get(prog, {})
         for field in ("n", "k"):
@@ -463,6 +618,27 @@ def _compare(report: dict, base_path: str, tol: float) -> int:
                   f"{ot}-collective baseline — HLO dump format drift? fix "
                   "parse_collectives before trusting any budget result")
             return 3
+        if phase_budget:
+            cur_p = cur.get("by_phase") or {}
+            old_p = old.get("by_phase")
+            if old_p is None:
+                print(f"compare: {prog} baseline {base_path} has no by_phase "
+                      "table — re-capture it before using --phase-budget")
+                return 3
+            print(f"  phase budget ({', '.join(PHASE_BUDGET_PHASES)}):")
+            for phase in PHASE_BUDGET_PHASES:
+                kinds = sorted(set(cur_p.get(phase, {})) | set(old_p.get(phase, {})))
+                for kind in kinds:
+                    c = cur_p.get(phase, {}).get(kind, {"count": 0, "bytes": 0})
+                    o = old_p.get(phase, {}).get(kind, {"count": 0, "bytes": 0})
+                    worse = (c["count"] > o["count"] + max(2, tol * o["count"])
+                             or c["bytes"] > o["bytes"] * (1 + tol) + slack_bytes)
+                    if worse:
+                        rc = 2
+                    print(f"    {phase:>16} {kind:>20} "
+                          f"{o['count']:>4}->{c['count']:<4} "
+                          f"{o['bytes'] / 1e6:>8.2f}->{c['bytes'] / 1e6:<8.2f} "
+                          f"{'REGRESSED' if worse else 'ok'}")
     print("\ncompare:", "REGRESSED beyond tolerance" if rc else "within budget")
     return rc
 

@@ -220,6 +220,69 @@ def main() -> None:
             out["sharded_tick"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         flush()
 
+        # -- 1c: the r8 exchange-leg A/B — shard_map crossing-block ppermutes
+        # vs the partitioner-inferred roll gathers, same counter RNG on both
+        # sides so ONLY the exchange lowering differs.  The r8 budget says
+        # the shard_map legs move ~2.6x fewer exchange bytes (12.6 vs 33
+        # MB/chip/tick at 1M x 256 on the 4x2 census); this section is what
+        # lets certify_cost_model judge that model against real ICI, and
+        # the bit-equality bit certifies the lowering on hardware.
+        try:
+            import functools as _ft
+
+            from jax.sharding import Mesh
+
+            from ringpop_tpu.parallel.mesh import with_exchange_mesh
+
+            n_dev = len(jax.devices())
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            k = 256
+            base_p = lifecycle.LifecycleParams(
+                n=n, k=k, suspect_ticks=10, rng="counter"
+            )
+            sm_p = with_exchange_mesh(base_p, mesh)
+            sec = {"n": n, "k": k, "n_devices": n_dev, "block_ticks": block}
+            out["sharded_exchange"] = sec
+            finals = {}
+            for label, p in (("roll", base_p), ("shardmap", sm_p)):
+                sstate = jax.tree.map(
+                    jax.device_put,
+                    lifecycle.init_state(p, seed=0),
+                    lifecycle.state_shardings(mesh, k=k),
+                )
+                blk_fn = jax.jit(
+                    _ft.partial(lifecycle._run_block, p), static_argnames="ticks"
+                )
+                sstate = blk_fn(sstate, faults, ticks=block)
+                jax.block_until_ready(sstate.learned)  # compile + warm
+                per_rep = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    sstate = blk_fn(sstate, faults, ticks=block)
+                    jax.block_until_ready(sstate.learned)
+                    per_rep.append(time.perf_counter() - t0)
+                finals[label] = sstate
+                sec[f"{label}_ms_per_tick_median"] = round(
+                    sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+                )
+                flush()
+            sec["bit_equal"] = all(
+                bool((np.asarray(a) == np.asarray(b)).all())
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(finals["roll"]),
+                    jax.tree_util.tree_leaves(finals["shardmap"]),
+                )
+            )
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            out.setdefault("sharded_exchange", {})[
+                "error"
+            ] = f"{type(e).__name__}: {e}"[:300]
+        flush()
+
     # -- 2+3: headline detection then convergence at the official config ----
     try:
         sim = lifecycle.LifecycleSim(n=n, k=k_head, seed=0)

@@ -87,8 +87,14 @@ def main() -> None:
     # as drift vs regression (tests/golden_tools.py)
     golden_tools.embed(out)
     os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
-    np.savez_compressed(GOLDEN_PATH, **out)
-    print(f"wrote {GOLDEN_PATH} ({os.path.getsize(GOLDEN_PATH) / 1e6:.2f} MB)")
+    # dual-toolchain goldens: per-fingerprint sibling file, legacy npz
+    # retained (see capture_lifecycle_golden.py)
+    path = golden_tools.versioned_path(GOLDEN_PATH)
+    np.savez_compressed(path, **out)
+    print(f"wrote {path} ({os.path.getsize(path) / 1e6:.2f} MB)")
+    if not os.path.exists(GOLDEN_PATH):
+        np.savez_compressed(GOLDEN_PATH, **out)
+        print(f"wrote {GOLDEN_PATH} (no legacy capture existed)")
 
 
 if __name__ == "__main__":

@@ -126,9 +126,17 @@ def main() -> None:
     # as drift vs regression (tests/golden_tools.py)
     golden_tools.embed(out)
     os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
-    np.savez_compressed(GOLDEN_PATH, **out)
-    size = os.path.getsize(GOLDEN_PATH) / 1e6
-    print(f"wrote {GOLDEN_PATH} ({size:.1f} MB)")
+    # dual-toolchain goldens: the capture lands in the per-fingerprint
+    # sibling file, NEVER over the legacy npz — old-toolchain evidence is
+    # retained and the loader picks whichever matches the running
+    # toolchain (tests/golden_tools.load_golden).  Only a repo with no
+    # legacy capture at all seeds one.
+    path = golden_tools.versioned_path(GOLDEN_PATH)
+    np.savez_compressed(path, **out)
+    print(f"wrote {path} ({os.path.getsize(path) / 1e6:.1f} MB)")
+    if not os.path.exists(GOLDEN_PATH):
+        np.savez_compressed(GOLDEN_PATH, **out)
+        print(f"wrote {GOLDEN_PATH} (no legacy capture existed)")
 
 
 if __name__ == "__main__":

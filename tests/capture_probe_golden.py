@@ -1,0 +1,58 @@
+"""Record the XLA feature-string probe's expected outcome for THIS
+toolchain (``tests/golden/xla_probe.<fp8>.json``).
+
+What ``accel._xla_detected_target_bits`` can extract is a property of the
+container's XLA: older jaxlibs wrote AOT cache entries embedding the
+canonical target-machine feature string (the probe surfaces it as
+``xla-fp:...``); this container's XLA (jax 0.4.37) writes entries that
+carry no plain-text feature string at all, so the honest probe answer
+here is the ``xla-fp-none`` fallback — and the compile cache stays safely
+segmented by the cpuinfo + jax-version bits.  The probe TEST therefore
+needs a per-toolchain expectation, keyed exactly like the trajectory
+goldens (``tests/golden_tools.versioned_path``): this script captures the
+current probe output; ``tests/test_accel_fingerprint.py`` replays it and
+falls back to the legacy strict ``xla-fp:`` expectation on unrecorded
+toolchains (failing with the drift diagnosis there, as before).
+
+Run offline: ``python tests/capture_probe_golden.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from ringpop_tpu.util import accel  # noqa: E402
+from tests import golden_tools  # noqa: E402
+
+
+def main() -> None:
+    bits = accel._xla_detected_target_bits()
+    rec = {
+        "toolchain": golden_tools.fingerprint(),
+        "bits_head": bits[0],
+        "n_bits": len(bits),
+        "note": (
+            "expected _xla_detected_target_bits()[0] on this toolchain; "
+            "'xla-fp-none' means this XLA's cache entries embed no "
+            "plain-text feature string (verified at capture time) and the "
+            "cache keying rests on the cpuinfo/jax-version bits"
+        ),
+    }
+    path = golden_tools.versioned_path(golden_tools.PROBE_GOLDEN_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {path}: bits_head={rec['bits_head']!r} n_bits={rec['n_bits']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,20 +7,36 @@ assertion cannot tell that apart from a protocol regression (the
 ROADMAP's "Golden trajectories vs toolchain drift" open item: 10
 trajectory failures at seed on this container, all pre-existing).
 
-Two pieces:
+Three pieces:
 
 * capture scripts embed :func:`fingerprint` into the npz under
   ``__toolchain__`` (a JSON string), so future captures carry their
   provenance;
+* **dual-toolchain goldens** (r8, implementing the ROADMAP re-freeze
+  decision): capture scripts write ``<stem>.<fp8>.npz`` — keyed by
+  :func:`fp8`, an 8-hex digest of the capture toolchain's fingerprint —
+  ALONGSIDE the legacy capture, and :func:`load_golden` picks the file
+  matching the RUNNING toolchain, falling back to the legacy capture
+  (whose mismatches then fail with the drift diagnosis).  Old-toolchain
+  evidence is never discarded: re-freezing on a new container adds a
+  file instead of overwriting history, and a future return to the old
+  toolchain finds its goldens still green;
 * :func:`fail_golden` replaces the bare mismatch assert in the golden
   tests — it compares the capture-time fingerprint (when recorded)
   against the current one and fails with an explicit *"toolchain drift
   vs real regression"* classification instead of a wall of array diff.
+
+The XLA feature-string probe expectation is keyed the same way
+(:func:`probe_recording` / ``xla_probe.<fp8>.json``): what the probe can
+extract is a property of the container's XLA, so its pass condition is a
+per-toolchain recording too.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 
 import numpy as np
 import pytest
@@ -28,6 +44,46 @@ import pytest
 from ringpop_tpu.sim.telemetry import toolchain_fingerprint as fingerprint
 
 TOOLCHAIN_KEY = "__toolchain__"
+
+PROBE_GOLDEN_PATH = os.path.join(
+    os.path.dirname(__file__), "golden", "xla_probe.json"
+)
+
+
+def fp8(fp: dict | None = None) -> str:
+    """8-hex id of a toolchain fingerprint (sha256 of its sorted JSON) —
+    the filename key of the dual-toolchain goldens."""
+    fp = fingerprint() if fp is None else fp
+    return hashlib.sha256(
+        json.dumps(fp, sort_keys=True).encode()
+    ).hexdigest()[:8]
+
+
+def versioned_path(legacy_path: str, fp: dict | None = None) -> str:
+    """``<stem>.<fp8><ext>`` — the per-toolchain sibling of a legacy
+    golden path."""
+    root, ext = os.path.splitext(legacy_path)
+    return f"{root}.{fp8(fp)}{ext}"
+
+
+def load_golden(legacy_path: str):
+    """``np.load`` the capture matching the RUNNING toolchain fingerprint
+    when one exists, else the legacy capture (whose mismatches fail with
+    the :func:`fail_golden` drift diagnosis)."""
+    p = versioned_path(legacy_path)
+    return np.load(p if os.path.exists(p) else legacy_path)
+
+
+def probe_recording() -> dict | None:
+    """The recorded XLA feature-string probe expectation for the RUNNING
+    toolchain (``tests/golden/xla_probe.<fp8>.json``, written by
+    ``tests/capture_probe_golden.py``), or None when this toolchain has
+    no recording (the test then applies the legacy strict expectation)."""
+    p = versioned_path(PROBE_GOLDEN_PATH)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
 
 
 def embed(out: dict) -> None:

@@ -21,13 +21,46 @@ from ringpop_tpu.util import accel
 
 
 def test_probe_extracts_xla_feature_string():
+    """The probe's output is a property of the container's XLA, so its
+    expectation is fingerprint-keyed like the trajectory goldens
+    (tests/golden_tools.probe_recording; captured by
+    tests/capture_probe_golden.py): on a recorded toolchain the probe must
+    reproduce its recording exactly — 'xla-fp-none' is a legitimate
+    recording where that XLA's cache entries embed no plain-text feature
+    string (this container's jax 0.4.37; verified at capture time), and a
+    deviation from it (e.g. 'xla-fp-error') is a probe regression.  On an
+    UNRECORDED toolchain the legacy strict expectation applies and a
+    fallback marker fails — with the capture script named, so the failure
+    diagnoses itself as drift-vs-regression the way the goldens do."""
+    from tests import golden_tools
+
     bits = accel._xla_detected_target_bits()
     assert bits, "probe returned no fingerprint bits"
-    # on the CPU backend the canary must surface the canonical feature
-    # string (dozens of comma-separated +/-flags) — a fallback marker
-    # ("xla-fp-none"/"xla-fp-error") means the probe is broken here
-    assert bits[0].startswith("xla-fp:"), bits[0]
-    assert bits[0].count(",") > 10, "feature string suspiciously short"
+    rec = golden_tools.probe_recording()
+    if rec is not None:
+        assert bits[0] == rec["bits_head"], (
+            f"probe output {bits[0]!r} deviates from this toolchain's "
+            f"recording {rec['bits_head']!r} "
+            f"(tests/golden/xla_probe.{golden_tools.fp8()}.json) — a probe "
+            "regression, not toolchain drift"
+        )
+        assert len(bits) == rec["n_bits"], (bits, rec)
+        if rec["bits_head"].startswith("xla-fp:"):
+            assert bits[0].count(",") > 10, "feature string suspiciously short"
+    else:
+        # legacy expectation (the toolchain the probe was written on): the
+        # canary must surface the canonical feature string (dozens of
+        # comma-separated +/-flags); a fallback marker on an unrecorded
+        # toolchain is either a broken probe or toolchain drift — run
+        # tests/capture_probe_golden.py after verifying which, exactly
+        # like a trajectory re-freeze
+        assert bits[0].startswith("xla-fp:"), (
+            f"{bits[0]!r} on an UNRECORDED toolchain "
+            f"(fingerprint {golden_tools.fp8()}); if this XLA legitimately "
+            "embeds no feature string, record it via "
+            "tests/capture_probe_golden.py"
+        )
+        assert bits[0].count(",") > 10, "feature string suspiciously short"
     # memoized per process: detection is deterministic, probe runs once
     assert accel._xla_detected_target_bits() is bits
 
