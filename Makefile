@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint clean profile-mesh telemetry-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke
 
 all: native test
 
@@ -16,8 +16,11 @@ all: native test
 # budget ratchet without the slow 1M program; telemetry-smoke gates the
 # telemetry plane (journal produced + telemetry-on digest-equal to off);
 # tests/test_mesh_budget.py re-asserts the while-body budgets from inside
-# pytest.
-test: profile-mesh telemetry-smoke
+# pytest.  lint runs the two-plane jaxlint suite (AST hazards + traced-
+# program invariants; ANALYSIS.md) — the static gate in front of the
+# dynamic certificates, mirroring the reference Makefile's test/lint
+# split.
+test: profile-mesh telemetry-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -82,8 +85,22 @@ certify:
 native:
 	$(PY) -c "from ringpop_tpu import native; assert native._build(), 'g++ build failed'; print('native hash core built')"
 
+# two-plane static analysis (scripts/jaxlint.py; rule catalog ANALYSIS.md):
+# plane 1 AST-lints the package for codebase-specific hazards (raw threefry
+# draws, traced rolls, host syncs in jit, x64 promotion, missing phase
+# scopes); plane 2 traces the public jitted entry points dense + on the
+# 8-way virtual mesh and statically asserts no f64, no host callbacks,
+# donation aliased, collectives confined to their r8 phases (peer-choice =
+# zero), and sharded == unsharded trace structure modulo sharding ops.
+# Waivers: ringpop_tpu/analysis/waivers.toml (justification mandatory).
 lint:
 	$(PY) -m compileall -q ringpop_tpu tests tests_accel bench.py __graft_entry__.py
+	$(PY) scripts/jaxlint.py
+
+# machine-readable rule-outcome listing (every finding incl. waived +
+# unused waivers) — diff this across budget re-baselines
+lint-json:
+	$(PY) scripts/jaxlint.py --format=json
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -1,0 +1,32 @@
+"""jaxlint — the repo's two-plane static-analysis suite.
+
+Every hard-won invariant of the r6–r8 rounds — bit-identical sharded vs.
+unsharded execution, zero-collective peer choice, partition-invariant
+counter RNG, no host sync inside jitted bodies, phase-attributable
+collectives — is a fact of the *traced program*, checkable before a
+single tick runs.  Until this package, each was enforced only
+dynamically (paired runs, budget ratchets), so a regression surfaced
+ticks after it was introduced.  The suite checks them at lint time:
+
+* **Plane 1 — Python AST** (``astlint``): codebase-specific source
+  hazards — raw threefry draws bypassing ``sim/prng.py``'s counter RNG
+  in sharded-capable paths, traced-shift rolls outside
+  ``parallel/shift.shard_roll``, host-sync constructs inside jitted
+  bodies, 64-bit dtype promotion, missing protocol-phase
+  ``jax.named_scope`` coverage.
+* **Plane 2 — jaxpr/HLO** (``trace_checks``): traces the public jitted
+  entry points (lifecycle step, delta step, detect walk, shard_roll
+  exchange, telemetry fetch) dense AND under the 8-way virtual mesh and
+  statically asserts no f64, no host callbacks, donation actually
+  aliased, collectives confined to the phases the r8 budget allows
+  (peer-choice = zero), and structural equality of the sharded vs.
+  unsharded traces modulo sharding ops — the static shadow of the
+  bit-identity certificates.
+
+Rules are individually waivable via the checked-in
+``analysis/waivers.toml`` (mandatory justification strings; see
+``waivers``).  ``scripts/jaxlint.py`` drives both planes; ``make lint``
+runs it and joins ``make test``.  Rule catalog: ``ANALYSIS.md``.
+"""
+
+from ringpop_tpu.analysis.findings import Finding  # noqa: F401

@@ -1,0 +1,554 @@
+"""Plane 1 of jaxlint: Python-AST lint rules for codebase-specific hazards.
+
+Each rule guards an invariant a previous PR bought with measurements and
+paired-run certificates (rule catalog with the full story: ANALYSIS.md):
+
+* **RPA101 raw-threefry** — a raw ``jax.random.*`` draw in a
+  sharded-capable module with no counter-RNG dispatch in the enclosing
+  function.  Threefry is not partitionable: under GSPMD the draw either
+  materializes replicated (the pre-r8 ~12 MB/chip/tick peer-choice
+  all-reduce) or silently generates DIFFERENT lanes sharded vs unsharded
+  (the r7 telemetry finding).  Engines must route draws through
+  ``sim/prng.py``'s partition-invariant counter RNG or gate the threefry
+  family behind the ``rng`` param dispatch.
+* **RPA102 traced-roll** — ``jnp.roll`` (or ``np.roll`` on device
+  values) outside ``parallel/shift.py``.  A traced-shift roll lowers to
+  a slice-select chain XLA:CPU re-derives per consuming element, and the
+  SPMD partitioner can only serve it with a plane-sized all-gather; the
+  blessed lowerings are materialized-index gathers and
+  ``parallel/shift.shard_roll``.
+* **RPA103 host-sync-in-jit** — ``.item()``/``.tolist()``,
+  ``jax.device_get``, host-numpy coercions (``np.asarray`` & friends),
+  or ``int()``/``float()``/``bool()`` casts of non-literals inside
+  functions reachable from a ``jax.jit`` root.  Each is a concretization
+  fence: at best a trace-time error on an untested branch, at worst a
+  silent device→host sync serializing the dispatch pipeline.
+* **RPA104 x64-promotion** — 64-bit jnp dtypes, ``dtype="float64"``
+  strings, or ``jax_enable_x64`` anywhere in device code.  The sim runs
+  x64-disabled, so ``jnp.int64`` silently produces int32 (a real
+  overflow hazard this rule's first repo run caught in
+  ``ops/ring_ops.py``), and enabling x64 would double the packed planes'
+  HBM traffic.
+* **RPA105 phase-scope** — ``jax.named_scope`` strings must come from
+  the canonical phase vocabulary (``analysis/phases.PHASES``), and the
+  protocol-phase functions the r7 telemetry attribution depends on must
+  carry a scope at all; a scope-less collective censuses as
+  "(unattributed)", defeating the phase budget.
+
+The linter is file-local by design: alias-aware name resolution plus a
+per-module call-graph closure from ``jax.jit`` roots.  Cross-module
+closure is deliberately out of scope — the jaxpr plane
+(``trace_checks``) catches what source locality cannot.
+
+Fixture corpus convention: a file under
+``tests/analysis_fixtures/<slug>/`` is linted by exactly the rule whose
+slug matches its directory — trip/clean snippets stay minimal without
+accidentally tripping neighbouring rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ringpop_tpu.analysis.findings import Finding
+from ringpop_tpu.analysis.phases import PHASES
+
+FIXTURE_DIR = "analysis_fixtures"
+
+RULES = {
+    "RPA101": "raw-threefry",
+    "RPA102": "traced-roll",
+    "RPA103": "host-sync-in-jit",
+    "RPA104": "x64-promotion",
+    "RPA105": "phase-scope",
+}
+
+# modules whose programs run (or may run) under a device mesh — the
+# RPA101 scope.  sim/fullview.py matches the pattern but never shards
+# (the O(N²) oracle engine, threefry pinned by the conformance harness):
+# its draw sites are waived in analysis/waivers.toml with that
+# justification rather than carved out here, so the exception stays
+# visible and reasoned.
+SHARDED_CAPABLE = (
+    "ringpop_tpu/sim/",
+    "ringpop_tpu/parallel/",
+)
+
+# jax.random functions that CONSUME randomness (draws / key evolution).
+# PRNGKey construction is init-time host work and stays legal.
+_RANDOM_DRAWS_EXEMPT = {"PRNGKey", "key", "wrap_key_data"}
+
+# protocol-phase functions that must contain a jax.named_scope block —
+# the census attributes collectives by these scopes, so a missing scope
+# regresses every budget table to "(unattributed)" (RPA105).
+REQUIRED_SCOPED = {
+    "ringpop_tpu/sim/lifecycle.py": (
+        "step",
+        "detection_complete",
+        "_walk_subject_slots",
+        "view_checksums",
+    ),
+    "ringpop_tpu/sim/delta.py": ("step",),
+    "ringpop_tpu/parallel/shift.py": ("shard_roll",),
+    "ringpop_tpu/sim/packbits.py": ("_tree_reduce_rows", "set_bit", "set_bit_per_row"),
+}
+# in the rule's fixture dir, the function named "step" plays the role of
+# a protocol-phase function
+_FIXTURE_REQUIRED_SCOPED = ("step",)
+
+_BAD_64 = ("int64", "uint64", "float64", "complex128")
+
+# host-numpy calls that force materialization of their argument — on a
+# tracer, a concretization error (or worse, a silent sync)
+_NP_COERCIONS = {
+    "asarray", "array", "flatnonzero", "nonzero", "unique", "copy",
+    "frombuffer", "save", "load", "concatenate", "stack",
+}
+# numpy helpers legal inside traced code because the engines only ever
+# apply them to STATIC config scalars (trace-time constants): dtype
+# constructors, dtype metadata, and host math on param-derived Python
+# numbers (e.g. resolve_max_p's ceil/log10)
+_NP_STATIC_OK = {
+    "bool_", "int8", "int16", "int32", "int64", "uint8", "uint16",
+    "uint32", "uint64", "float16", "float32", "float64", "dtype",
+    "iinfo", "finfo", "shape", "ndim", "ceil", "floor", "log", "log2",
+    "log10", "sqrt", "prod", "arange",
+}
+
+
+def _fixture_slug(relpath: str) -> str | None:
+    """The rule slug a fixture path belongs to, or None outside the
+    corpus (``tests/analysis_fixtures/<slug>/x.py`` → ``<slug>``)."""
+    parts = relpath.replace(os.sep, "/").split("/")
+    if FIXTURE_DIR in parts:
+        i = parts.index(FIXTURE_DIR)
+        if len(parts) > i + 2:  # .../analysis_fixtures/<slug>/file.py
+            return parts[i + 1]
+    return None
+
+
+def _rule_applies(rule: str, relpath: str) -> bool:
+    slug = _fixture_slug(relpath)
+    if slug is not None:
+        return RULES[rule] == slug
+    if rule == "RPA101":
+        return relpath.startswith(SHARDED_CAPABLE)
+    if rule == "RPA102":
+        return relpath != "ringpop_tpu/parallel/shift.py"
+    if rule == "RPA104":
+        return relpath.startswith(("ringpop_tpu/", "scripts/", "examples/"))
+    if rule == "RPA105":
+        return relpath.startswith("ringpop_tpu/")
+    return True  # RPA103: anywhere a jit root lives
+
+
+class _Module:
+    """One parsed file: alias map, function table, jit-root closure."""
+
+    def __init__(self, tree: ast.Module, relpath: str):
+        self.tree = tree
+        self.relpath = relpath
+        self.aliases: dict[str, str] = {}
+        # function simple name -> list of (node, qualname) (defs can be
+        # nested or duplicated; simple name is what call sites use)
+        self.functions: dict[str, list[tuple[ast.AST, str]]] = {}
+        self.qualname_of: dict[ast.AST, str] = {}
+        self._collect()
+        self.jit_marked = self._mark_jit_reachable()
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+        def visit(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}.{child.name}" if prefix else child.name
+                    self.functions.setdefault(child.name, []).append((child, qn))
+                    self.qualname_of[child] = qn
+                    visit(child, qn)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}.{child.name}" if prefix else child.name)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def resolve(self, node) -> str | None:
+        """Dotted name of an expression through the import-alias map:
+        ``jnp.roll`` → ``jax.numpy.roll`` — or None for non-name trees."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    # -- jit-root closure ---------------------------------------------------
+
+    def _jit_target_names(self, call: ast.Call) -> list[str]:
+        """Function simple names a ``jax.jit(...)`` call traces: a bare
+        name, or the first argument of a ``functools.partial`` wrapper."""
+        out = []
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name):
+                out.append(arg.id)
+            elif isinstance(arg, ast.Call):
+                fn = self.resolve(arg.func)
+                if fn in ("functools.partial", "partial") and arg.args:
+                    if isinstance(arg.args[0], ast.Name):
+                        out.append(arg.args[0].id)
+        return out
+
+    def _mark_jit_reachable(self) -> set[str]:
+        """Simple names of module functions reachable from a jit root:
+        decorator roots (``@jax.jit``, ``@functools.partial(jax.jit,
+        ...)``) plus every function handed to a ``jax.jit(...)`` call,
+        closed transitively over same-module references."""
+        roots: set[str] = set()
+        for name, defs in self.functions.items():
+            for node, _ in defs:
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    r = self.resolve(target)
+                    if r == "jax.jit":
+                        roots.add(name)
+                    elif r in ("functools.partial", "partial") and isinstance(
+                        dec, ast.Call
+                    ):
+                        if dec.args and self.resolve(dec.args[0]) == "jax.jit":
+                            roots.add(name)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and self.resolve(node.func) == "jax.jit":
+                roots.update(self._jit_target_names(node))
+
+        refs: dict[str, set[str]] = {}
+        for name, defs in self.functions.items():
+            names: set[str] = set()
+            for node, _ in defs:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            refs[name] = names
+
+        marked = set(n for n in roots if n in self.functions)
+        frontier = list(marked)
+        while frontier:
+            fn = frontier.pop()
+            for ref in refs.get(fn, ()):
+                if ref in self.functions and ref not in marked:
+                    marked.add(ref)
+                    frontier.append(ref)
+        return marked
+
+    def enclosing(self, lineno: int) -> str:
+        """Qualname of the innermost function containing ``lineno``
+        (``<module>`` at top level)."""
+        best, best_span = "<module>", None
+        for defs in self.functions.values():
+            for node, qn in defs:
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= lineno <= end:
+                    span = end - node.lineno
+                    if best_span is None or span < best_span:
+                        best, best_span = qn, span
+        return best
+
+    def in_jit(self, lineno: int) -> bool:
+        for name, defs in self.functions.items():
+            if name not in self.jit_marked:
+                continue
+            for node, _ in defs:
+                if node.lineno <= lineno <= getattr(node, "end_lineno", node.lineno):
+                    return True
+        return False
+
+
+def _is_static_cast_arg(node) -> bool:
+    """True when an int()/float()/bool() argument is a trace-time
+    constant: literals, unary ops on them, len()/min()/max() of anything
+    (shape-land), or attribute chains ending in shape/size/ndim."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_cast_arg(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_static_cast_arg(node.left) and _is_static_cast_arg(node.right)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("len", "min", "max", "round"):
+            return True
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        root = node
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            if isinstance(root, ast.Attribute) and root.attr in (
+                "shape", "size", "ndim", "dtype",
+            ):
+                return True
+            root = root.value
+    return False
+
+
+def lint_source(src: str, relpath: str) -> list[Finding]:
+    """Lint one file's source; ``relpath`` is repo-relative (it decides
+    rule scoping and appears in findings/waivers)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [
+            Finding("RPA000", relpath, e.lineno or 0, "<module>",
+                    f"syntax error: {e.msg}")
+        ]
+    mod = _Module(tree, relpath)
+    findings: list[Finding] = []
+
+    def add(rule, node, msg):
+        findings.append(
+            Finding(rule, relpath, node.lineno, mod.enclosing(node.lineno), msg)
+        )
+
+    # per-top-level-function counter-RNG dispatch detection for RPA101: a
+    # draw is "guarded" when its enclosing function also references the
+    # counter stream (the sim/prng module or the use_counter dispatch
+    # flag) — i.e. the threefry call is one branch of the rng-family
+    # dispatch, not a bypass.
+    def counter_guarded(lineno: int) -> bool:
+        for defs in mod.functions.values():
+            for node, _ in defs:
+                if not (node.lineno <= lineno <= getattr(node, "end_lineno", node.lineno)):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and (
+                        mod.aliases.get(sub.id, "").endswith("sim.prng")
+                        or sub.id == "use_counter"
+                    ):
+                        return True
+                    if isinstance(sub, ast.ImportFrom) and sub.module and (
+                        sub.module.endswith("sim") or sub.module.endswith("prng")
+                    ):
+                        for a in sub.names:
+                            if a.name == "prng":
+                                return True
+        return False
+
+    named_scope_spans: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) and mod.resolve(ce.func) == "jax.named_scope":
+                    named_scope_spans.append(
+                        (node.lineno, getattr(node, "end_lineno", node.lineno))
+                    )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            target = mod.resolve(node.func)
+
+            # RPA101 -----------------------------------------------------
+            if (
+                _rule_applies("RPA101", relpath)
+                and target
+                and target.startswith("jax.random.")
+                and target.split(".")[-1] not in _RANDOM_DRAWS_EXEMPT
+                and not counter_guarded(node.lineno)
+            ):
+                add(
+                    "RPA101", node,
+                    f"raw threefry draw {target} in a sharded-capable path "
+                    "with no counter-RNG dispatch in the enclosing function "
+                    "— route through sim/prng.py (partition-invariant, "
+                    "zero-collective) or gate behind the rng-family param",
+                )
+
+            # RPA102 -----------------------------------------------------
+            if (
+                _rule_applies("RPA102", relpath)
+                and target in ("jax.numpy.roll", "numpy.roll")
+            ):
+                add(
+                    "RPA102", node,
+                    f"{target} outside parallel/shift.py: a traced-shift "
+                    "roll re-derives its slice-select chain per consuming "
+                    "element on CPU and all-gathers the plane under GSPMD — "
+                    "use a materialized-index gather, or shard_roll for "
+                    "sharded exchange legs",
+                )
+
+            # RPA103 -----------------------------------------------------
+            if _rule_applies("RPA103", relpath) and mod.in_jit(node.lineno):
+                if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                    "item", "tolist", "block_until_ready",
+                ):
+                    add(
+                        "RPA103", node,
+                        f".{node.func.attr}() inside a jit-traced function "
+                        "— a device→host sync (trace-time error on a "
+                        "tracer); hoist to the host caller",
+                    )
+                elif target and target.startswith("numpy."):
+                    leaf = target.split(".")[-1]
+                    if leaf in _NP_COERCIONS:
+                        add(
+                            "RPA103", node,
+                            f"np.{leaf} inside a jit-traced function "
+                            "materializes its operand on host — use the "
+                            "jnp equivalent or hoist to the caller",
+                        )
+                elif target in ("jax.device_get", "jax.device_put"):
+                    add(
+                        "RPA103", node,
+                        f"{target} inside a jit-traced function — host "
+                        "transfer constructs belong outside the trace",
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")
+                    and node.args
+                    and not _is_static_cast_arg(node.args[0])
+                ):
+                    add(
+                        "RPA103", node,
+                        f"{node.func.id}(...) on a non-literal inside a "
+                        "jit-traced function — concretizes a tracer; keep "
+                        "values as jnp scalars or compute on static config",
+                    )
+
+            # RPA104: dtype= string form + x64 flag ----------------------
+            if _rule_applies("RPA104", relpath):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in _BAD_64
+                    ):
+                        add(
+                            "RPA104", node,
+                            f'dtype="{kw.value.value}" in device code: the '
+                            "sim runs x64-disabled, so this silently "
+                            "becomes 32-bit (overflow hazard) — use an "
+                            "explicit 32-bit dtype or restructure",
+                        )
+                if (
+                    target in ("jax.config.update",)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"
+                ):
+                    add(
+                        "RPA104", node,
+                        "jax_enable_x64: x64 promotion doubles the packed "
+                        "planes' HBM traffic and breaks the uint32 "
+                        "bit-packing contracts — forbidden in device code",
+                    )
+
+            # RPA105 (a): canonical scope names --------------------------
+            if (
+                _rule_applies("RPA105", relpath)
+                and target == "jax.named_scope"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value not in PHASES
+            ):
+                add(
+                    "RPA105", node,
+                    f'named_scope "{node.args[0].value}" is not in the '
+                    "canonical phase vocabulary (analysis/phases.PHASES) — "
+                    "collectives under it census as unattributable; add "
+                    "the phase to the vocabulary or reuse an existing one",
+                )
+
+        # RPA104: bare 64-bit dtype attribute ----------------------------
+        elif isinstance(node, ast.Attribute) and _rule_applies("RPA104", relpath):
+            target = mod.resolve(node)
+            if target and target.startswith("jax.numpy.") and target.split(".")[-1] in _BAD_64:
+                add(
+                    "RPA104", node,
+                    f"{target.replace('jax.numpy', 'jnp')}: with x64 "
+                    "disabled this silently produces a 32-bit value "
+                    "(overflow hazard, as in the ring_ops composite-sort "
+                    "bug this rule first caught) — restructure to stay in "
+                    "32-bit, e.g. a stable argsort instead of a packed "
+                    "composite key",
+                )
+            elif (
+                target
+                and target.startswith("numpy.")
+                and target.split(".")[-1] in _BAD_64
+                and mod.in_jit(node.lineno)
+            ):
+                add(
+                    "RPA104", node,
+                    f"np.{target.split('.')[-1]} inside a jit-traced "
+                    "function — 64-bit host dtypes do not exist on the "
+                    "x64-disabled device; use 32-bit",
+                )
+
+    # RPA105 (b): required protocol-phase functions carry a scope --------
+    if _rule_applies("RPA105", relpath):
+        required = REQUIRED_SCOPED.get(relpath, ())
+        if _fixture_slug(relpath) == RULES["RPA105"]:
+            required = _FIXTURE_REQUIRED_SCOPED
+        for fname in required:
+            for node, qn in mod.functions.get(fname, ()):
+                end = getattr(node, "end_lineno", node.lineno)
+                if not any(a >= node.lineno and b <= end for a, b in named_scope_spans):
+                    findings.append(
+                        Finding(
+                            "RPA105", relpath, node.lineno, qn,
+                            f"protocol-phase function {qn} carries no "
+                            "jax.named_scope — its collectives census as "
+                            "(unattributed), breaking the r7 phase "
+                            "attribution and the r8 phase budget",
+                        )
+                    )
+            if not mod.functions.get(fname) and relpath in REQUIRED_SCOPED:
+                findings.append(
+                    Finding(
+                        "RPA105", relpath, 1, "<module>",
+                        f"required protocol-phase function {fname!r} not "
+                        "found — update analysis/astlint.REQUIRED_SCOPED "
+                        "if it moved",
+                    )
+                )
+
+    return findings
+
+
+def lint_paths(paths, repo_root: str) -> list[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    files: list[str] = []
+    for p in paths:
+        ap = os.path.join(repo_root, p) if not os.path.isabs(p) else p
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames) if f.endswith(".py")
+                )
+        elif ap.endswith(".py"):
+            files.append(ap)
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, repo_root).replace(os.sep, "/")
+        try:
+            src = open(f).read()
+        except OSError as e:
+            findings.append(Finding("RPA000", rel, 0, "<module>", f"unreadable: {e}"))
+            continue
+        findings.extend(lint_source(src, rel))
+    return findings

@@ -1,0 +1,51 @@
+"""Finding record + rendering shared by both jaxlint planes."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Finding:
+    """One rule violation.
+
+    ``path`` is repo-relative for AST findings and the ``<trace:entry>``
+    pseudo-path for jaxpr/HLO-plane findings (there is no single source
+    line for a traced-program property).  ``scope`` is the enclosing
+    function qualname (``<module>`` at file top level) or the trace entry
+    point name — it is what waivers key on, so a waiver survives the line
+    churn of ordinary edits."""
+
+    rule: str
+    path: str
+    line: int
+    scope: str
+    message: str
+    waived: bool = field(default=False)
+    justification: str = field(default="")
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def render(self) -> str:
+        tag = " [waived]" if self.waived else ""
+        out = f"{self.location()}: {self.rule} ({self.scope}): {self.message}{tag}"
+        if self.waived and self.justification:
+            out += f"\n    waiver: {self.justification}"
+        return out
+
+
+def to_json(findings, unused_waivers=(), extra=None) -> str:
+    """The ``--format=json`` listing mode: every finding (waived ones
+    included, flagged) plus unused waivers — a stable machine-readable
+    surface so future budget re-baselines can diff rule outcomes."""
+    doc = {
+        "findings": [asdict(f) for f in findings],
+        "unwaived_count": sum(1 for f in findings if not f.waived),
+        "waived_count": sum(1 for f in findings if f.waived),
+        "unused_waivers": [dict(w) for w in unused_waivers],
+    }
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=1, sort_keys=True)

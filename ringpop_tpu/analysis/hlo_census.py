@@ -1,0 +1,304 @@
+"""Optimized-HLO collective census with protocol-phase attribution.
+
+Moved out of ``scripts/profile_mesh.py`` (which still re-exports every
+name here for its callers) so the jaxlint HLO plane
+(``analysis/trace_checks.check_hlo_confinement``) and the pytest budget
+guards (``tests/test_mesh_budget.py``) share ONE parser: the r6 lesson —
+an HLO text-format rotation silently reporting an empty census as a
+passing budget — must only ever need fixing in one place.
+
+Census semantics (r8): collectives inside sibling branches of one
+``conditional`` (``lax.switch``/``lax.cond``) are mutually exclusive per
+execution — the shift exchange's shard-local lowering switches over the
+traced shard offset, and the sparse candidate select conds between the
+hierarchical path and its full-sort fallback — so every summary charges
+only the most expensive branch of each conditional (worst case actually
+executable per tick), not the sum of all branches in the program text.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+from ringpop_tpu.analysis.phases import PHASES
+
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+    "reduce-scatter",
+)
+
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_SRC_RE = re.compile(r'source_file="([^"]+)" source_line=(\d+)')
+_PHASE_SPAN_CACHE: dict = {}
+
+
+def _source_spans(path: str):
+    """(named-scope spans, function starts) of one source file — the
+    fallback attributor for collectives whose op_name lost its scope (the
+    SPMD partitioner re-homes resharding ops onto loop boundaries, whose
+    metadata names only the enclosing while)."""
+    if path not in _PHASE_SPAN_CACHE:
+        spans, funcs = [], []
+        try:
+            src = open(path).read().split("\n")
+        except OSError:
+            src = []
+        for i, ln in enumerate(src):
+            m = re.match(r'(\s*)with jax\.named_scope\("([^"]+)"\):', ln)
+            if m:
+                indent = len(m.group(1))
+                j = i + 1
+                while j < len(src) and (
+                    not src[j].strip()
+                    or len(src[j]) - len(src[j].lstrip()) > indent
+                ):
+                    j += 1
+                spans.append((i + 1, j, m.group(2)))
+            d = re.match(r"def (\w+)\(", ln)
+            if d:
+                funcs.append((i + 1, d.group(1)))
+        _PHASE_SPAN_CACHE[path] = (spans, funcs)
+    return _PHASE_SPAN_CACHE[path]
+
+
+def _phase_of(line: str) -> str:
+    """Protocol phase of one HLO instruction line: the named-scope path
+    XLA keeps in metadata op_name when present (fusions inherit a
+    representative instruction's metadata), else the scope lexically
+    enclosing the op's source line, else ``loop:<function>`` for ops the
+    partitioner re-homed onto a loop boundary (e.g. the detect walk's
+    learned-plane replication hoisted to the tick loop)."""
+    m = _OPNAME_RE.search(line)
+    if m:
+        for part in m.group(1).split("/"):
+            if part in PHASES:
+                return part
+    s = _SRC_RE.search(line)
+    if s:
+        spans, funcs = _source_spans(s.group(1))
+        ln = int(s.group(2))
+        for a, b, name in spans:
+            if a <= ln <= b:
+                return name
+        owner = None
+        for a, name in funcs:
+            if a <= ln:
+                owner = name
+            else:
+                break
+        if owner:
+            return f"loop:{owner}"
+    return "(unattributed)"
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of every array in an HLO result type string (handles
+    tuples; layout annotations ignored)."""
+    total = 0
+    for dtype, dims in re.findall(r"(pred|[suf]\d+|bf16)\[([\d,]*)\]", shape_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def parse_collectives(hlo_path: str) -> dict:
+    """Per-computation collective census of one optimized HLO module.
+
+    Returns {computation_name: [{op, kind, bytes}...]} plus, for loop
+    attribution, each computation's while-loop depth (a collective inside
+    a while BODY executes once per iteration, so depth distinguishes the
+    one-shot entry collectives from the per-tick / per-walk-step ones),
+    the ``conditional`` branch groups (lists of sibling branch
+    computations, of which exactly ONE executes per evaluation), and the
+    ``executed`` computation set: everything reachable from the module
+    roots taking only the most expensive branch of each conditional —
+    the worst case one execution can actually pay.  Summaries charge the
+    executed set only; ``by_computation`` keeps the full text census.
+
+    ``total_computations`` counts EVERY computation header parsed
+    (collective-bearing or not): zero on a non-empty file means the dump
+    format rotated out from under the parser — callers must treat that
+    as an error, not an empty budget (see ``profile_mesh`` and
+    jaxlint's ``check_hlo_confinement``)."""
+    comps: dict = {}
+    bodies: dict = {}  # while-body computation -> owning computation
+    calls: dict = {}  # computation -> calling computations (reverse edges)
+    fwd: dict = {}  # computation -> called computations (forward edges)
+    cond_groups: list = []  # [{caller, branches: [comp, ...]}, ...]
+    total_computations = 0
+    cur = None
+    # instruction/computation names carry a "%" sigil in older XLA text
+    # dumps and none in current ones — accept both, or a format rotation
+    # silently reports an empty census (bit us once: the r6 'before'
+    # capture came out all-zero against a 297-collective program)
+    for line in open(hlo_path):
+        stripped = line.rstrip()
+        if stripped.endswith("{") and not line.lstrip().startswith("ROOT"):
+            cur = stripped.split()[0].lstrip("%")
+            comps.setdefault(cur, [])
+            total_computations += 1
+        elif cur is not None and line.strip() == "}":
+            cur = None
+        elif cur is not None:
+            m = re.search(
+                r"%?([\w.\-]+) = (.+?) (" + "|".join(COLLECTIVES) + r")(?:-start)?\(",
+                line,
+            )
+            if m and "-done" not in line.split("=", 1)[1][:60]:
+                comps[cur].append(
+                    {
+                        "op": m.group(1),
+                        "kind": m.group(3),
+                        "bytes": _shape_bytes(m.group(2)),
+                        "phase": _phase_of(line),
+                    }
+                )
+            b = re.search(r"body=%?([\w.\-]+)", line)
+            if b:
+                bodies[b.group(1)] = cur
+            # conditional branches: N-ary (lax.switch) and binary forms
+            branches = []
+            bm = re.search(r"branch_computations=\{([^}]*)\}", line)
+            if bm:
+                branches = [c.strip().lstrip("%") for c in bm.group(1).split(",") if c.strip()]
+            else:
+                tm = re.search(r"true_computation=%?([\w.\-]+)", line)
+                fm = re.search(r"false_computation=%?([\w.\-]+)", line)
+                if tm and fm:
+                    branches = [tm.group(1), fm.group(1)]
+            if branches:
+                cond_groups.append({"caller": cur, "branches": branches})
+            for callee in re.findall(
+                r"(?:calls|to_apply|condition|body|true_computation|"
+                r"false_computation)=%?([\w.\-]+)",
+                line,
+            ) + branches:
+                calls.setdefault(callee, set()).add(cur)
+                fwd.setdefault(cur, set()).add(callee)
+
+    def loop_depth(name: str, seen=()) -> int:
+        if name in seen:
+            return 0
+        best = 0
+        if name in bodies:
+            best = 1 + loop_depth(bodies[name], seen + (name,))
+        for owner in calls.get(name, ()):
+            best = max(best, loop_depth(owner, seen + (name,)))
+        return best
+
+    # -- worst-case-executed computation set: at every conditional take the
+    # branch whose subtree carries the most collective bytes (count as
+    # tie-break); sibling branches are mutually exclusive per execution
+    branch_edges = {
+        (g["caller"], b) for g in cond_groups for b in g["branches"]
+    }
+    groups_of = {}
+    for g in cond_groups:
+        groups_of.setdefault(g["caller"], []).append(g["branches"])
+
+    def subtree_cost(name, seen=()):
+        if name in seen:
+            return (0, 0)
+        seen = seen + (name,)
+        by, ct = 0, 0
+        for r in comps.get(name, ()):
+            by += r["bytes"]
+            ct += 1
+        for branches in groups_of.get(name, []):
+            bb, bc = max((subtree_cost(b, seen) for b in branches), default=(0, 0))
+            by += bb
+            ct += bc
+        for callee in fwd.get(name, ()):
+            if (name, callee) in branch_edges:
+                continue
+            cb, cc = subtree_cost(callee, seen)
+            by += cb
+            ct += cc
+        return (by, ct)
+
+    executed: set = set()
+
+    def walk(name):
+        if name in executed:
+            return
+        executed.add(name)
+        for branches in groups_of.get(name, []):
+            walk(max(branches, key=lambda b: subtree_cost(b)))
+        for callee in fwd.get(name, ()):
+            if (name, callee) not in branch_edges:
+                walk(callee)
+
+    all_names = set(comps) | set(fwd) | {c for cs in fwd.values() for c in cs}
+    roots = all_names - {c for cs in fwd.values() for c in cs}
+    for r in sorted(roots):
+        walk(r)
+    if not roots:  # degenerate single-computation module
+        executed = all_names
+
+    return {
+        "computations": {k: v for k, v in comps.items() if v},
+        "loop_depth": {k: loop_depth(k) for k, v in comps.items() if v},
+        "cond_groups": cond_groups,
+        "executed": sorted(executed),
+        "total_computations": total_computations,
+    }
+
+
+def newest_module(dump: str, marker: str) -> str | None:
+    """Largest after-optimizations text dump in ``dump`` whose file name
+    contains ``marker`` (buffer/memory sidecar dumps excluded)."""
+    mods = [
+        p
+        for p in glob.glob(os.path.join(dump, "*after_optimizations.txt"))
+        if marker in os.path.basename(p) and "buffer" not in p and "memory" not in p
+    ]
+    return max(mods, key=os.path.getsize) if mods else None
+
+
+def executed_rows(census: dict):
+    """Iterate (computation, row) over the worst-case EXECUTED collective
+    set: sibling conditional branches contribute only their most expensive
+    member (see parse_collectives) — the census tests and both summaries
+    share this one definition of "per-tick cost"."""
+    executed = set(census.get("executed") or census["computations"])
+    for comp, rows in census["computations"].items():
+        if comp in executed:
+            for r in rows:
+                yield comp, r
+
+
+def summarize(census: dict) -> dict:
+    """{kind: {count, bytes}} over the executed collective set."""
+    by_kind: dict = {}
+    for _, r in executed_rows(census):
+        e = by_kind.setdefault(r["kind"], {"count": 0, "bytes": 0})
+        e["count"] += 1
+        e["bytes"] += r["bytes"]
+    return by_kind
+
+
+def summarize_phases(census: dict) -> dict:
+    """{phase: {kind: {count, bytes}}} — the protocol-phase attribution of
+    the collective census (the table PERF.md's budget discussion reads)."""
+    by_phase: dict = {}
+    for _, r in executed_rows(census):
+        kinds = by_phase.setdefault(r.get("phase", "(unattributed)"), {})
+        e = kinds.setdefault(r["kind"], {"count": 0, "bytes": 0})
+        e["count"] += 1
+        e["bytes"] += r["bytes"]
+    return by_phase

@@ -1,0 +1,66 @@
+"""Canonical protocol-phase vocabulary — ONE source of truth.
+
+The r7 telemetry plane attributes every censused collective to the
+``jax.named_scope`` protocol phase that emitted it; the r8 phase budget
+ratchets the exchange/peer-choice rows; and the jaxlint planes (both the
+AST scope-coverage rule and the jaxpr/HLO confinement checks) decide
+"is this scope name meaningful" and "may this phase carry collectives"
+from the same vocabulary.  Scattered copies of these tuples silently
+drifting apart is exactly the class of bug a linter exists to prevent,
+so ``scripts/profile_mesh.py``, ``analysis/astlint.py`` and
+``analysis/trace_checks.py`` all import from here.
+"""
+
+from __future__ import annotations
+
+# protocol-phase named scopes (jax.named_scope in sim/lifecycle.py,
+# sim/delta.py, sim/packbits.py, parallel/shift.py) — XLA carries them
+# through to each instruction's metadata op_name, which is how a censused
+# collective gets attributed to the protocol phase that emitted it.
+# Outermost-first: a collective under "rumor-exchange/row-reduce" belongs
+# to the exchange phase.
+PHASES = (
+    "tick-prologue",
+    "ping-target",
+    "rumor-exchange",
+    "heal",
+    "piggyback-counters",
+    "timers-fold",
+    "peer-choice",
+    "candidate-select",
+    "alloc-seed",
+    "commit",
+    "telemetry",
+    "detect-walk",
+    "view-checksum",
+    "row-reduce",
+    "set-bit",
+    "shard-roll",
+)
+
+# the phases profile_mesh --phase-budget ratchets (r8): the exchange legs
+# must stay ppermute-only and the peer-choice draws collective-free — a
+# regression in either can hide inside a roughly-unchanged global total,
+# which is exactly what the per-phase ratchet exists to catch
+PHASE_BUDGET_PHASES = ("rumor-exchange", "ping-target", "peer-choice", "shard-roll")
+
+# phases that must carry ZERO cross-chip collectives in any compiled
+# sharded program (jaxlint RPJ203/RPJ206 "forbid by construction" — the
+# static extension of the r8 ratchet).  peer-choice: under rng="counter"
+# the [N, P] draw is elementwise in (node, column), so a collective here
+# means the partition-invariant RNG stopped being shard-local (the
+# ~12 MB/chip/tick threefry all-reduce coming back).  "(unattributed)" is
+# forbidden too: a collective with no phase scope defeats the whole
+# attribution plane — extend the named_scope coverage instead.
+FORBIDDEN_COLLECTIVE_PHASES = ("peer-choice", "(unattributed)")
+
+
+def collective_phase_allowed(phase: str) -> bool:
+    """May an HLO/jaxpr collective be attributed to ``phase``?  Canonical
+    phases other than the forbidden set, plus the ``loop:<function>``
+    bucket the census uses for ops the SPMD partitioner re-homed onto a
+    loop boundary (e.g. the detect walk's learned-plane replication
+    hoisted to the tick loop)."""
+    if phase in FORBIDDEN_COLLECTIVE_PHASES:
+        return False
+    return phase in PHASES or phase.startswith("loop:")

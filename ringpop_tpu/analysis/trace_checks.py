@@ -1,0 +1,563 @@
+"""Plane 2 of jaxlint: jaxpr/HLO invariant checks on the public jitted
+entry points.
+
+Plane 1 reads source; this plane reads the TRACED PROGRAM — the artifact
+the r6–r8 invariants are actually facts about.  Five entry points
+(lifecycle step, delta step, detect walk, shard_roll exchange, telemetry
+fetch) are traced dense AND under the 8-way virtual mesh (4×2
+node × rumor — the ``profile_mesh`` topology), then checked:
+
+* **RPJ201 f64-in-trace** — no 64-bit aval anywhere (the engines are
+  built on uint32 bit-packing and int32 keys; a stray f64/i64 doubles
+  HBM traffic or — x64 being disabled — silently truncates).
+* **RPJ202 host-callback-in-trace** — no callback/infeed primitives: a
+  host round-trip inside a jitted body serializes the dispatch pipeline
+  (the round-1 lesson that moved the detect walk on-device).
+* **RPJ203 collective-confinement (jaxpr)** — every *explicit*
+  collective primitive sits under an allowed protocol phase scope, and
+  the forbidden phases (peer-choice — the r8 zero-collective
+  certificate) carry none.
+* **RPJ204 donation-aliased** — lowering the tick block with the state
+  donated must actually alias every state leaf to an output
+  (``tf.aliasing_output``); a silent copy doubles peak memory at the 1M
+  headline.
+* **RPJ205 sharded-trace-equivalence** — the sharded
+  (``exchange_mesh``) and unsharded traces of the SAME engine must be
+  structurally equal modulo sharding ops and the exchange region (the
+  one deliberately different lowering, excised by its ``rumor-exchange``
+  scope on both sides).  This is the static shadow of the r8
+  bit-identity certificates: any OTHER structural divergence between the
+  two programs is a partition-dependence bug by construction.
+* **RPJ206 collective-confinement (HLO)** — the compiled sharded tick's
+  full collective census (``analysis/hlo_census``, the profile_mesh
+  parser) re-checked against the phase whitelist: this is where
+  partitioner-INTRODUCED collectives (resharding all-gathers etc.)
+  appear, extending the jaxpr-level check from "no explicit collective
+  escaped its phase" to "no collective at all, however it arose, lands
+  in a forbidden phase".
+
+Fixture corpus: ``tests/analysis_fixtures/<slug>/{trip,clean}.py`` for
+the jaxpr-plane rules define ``build()`` (returning ``(fn, args)``) plus
+``JAXLINT_TRACE_RULE = "<rule id>"``; ``scripts/jaxlint.py`` dispatches
+them to :func:`check_fixture`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import tempfile
+
+from ringpop_tpu.analysis import hlo_census
+from ringpop_tpu.analysis.findings import Finding
+from ringpop_tpu.analysis.phases import (
+    PHASES,
+    collective_phase_allowed,
+)
+
+TRACE_RULES = {
+    "RPJ201": "f64-in-trace",
+    "RPJ202": "host-callback-in-trace",
+    "RPJ203": "collective-confinement",
+    "RPJ204": "donation-aliased",
+    "RPJ205": "sharded-trace-equivalence",
+    "RPJ206": "hlo-collective-confinement",
+}
+
+# explicit cross-device collective primitives at jaxpr level
+COLLECTIVE_PRIMS = {
+    "ppermute", "pshuffle", "psum", "pmax", "pmin", "pmean", "all_gather",
+    "all_to_all", "pgather", "pbroadcast", "psum_scatter", "reduce_scatter",
+    "psum_invariant",
+}
+# host round-trip primitives
+CALLBACK_PRIMS = {"infeed", "outfeed", "outside_call"}
+
+# primitives that exist only to express placement/partitioning — the
+# "modulo sharding ops" of the RPJ205 equivalence statement
+SHARDING_PRIMS = {
+    "shard_map", "sharding_constraint", "with_sharding_constraint",
+    "device_put",
+} | COLLECTIVE_PRIMS
+
+# scopes excised from the RPJ205 skeletons: the exchange region is the
+# one place the sharded program intentionally lowers differently
+# (shard_roll's switch/ppermute/stitch vs the materialized-index
+# gathers); everything outside it must match exactly
+EXCISED_SCOPES = ("rumor-exchange", "shard-roll")
+
+_BAD_AVAL_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+# -- jaxpr walking -----------------------------------------------------------
+
+
+def _sub_jaxprs(eqn):
+    """Inner (Closed)Jaxprs of one eqn, wherever its params keep them."""
+    import jax
+
+    out = []
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            if isinstance(item, jax.core.ClosedJaxpr):
+                out.append(item.jaxpr)
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                out.append(item)
+    return out
+
+
+def iter_eqns(closed):
+    """Yield ``(eqn, scope)`` over a ClosedJaxpr recursively; ``scope`` is
+    the '/'-joined named-scope path with enclosing eqns' stacks prefixed
+    (inner jaxpr eqns carry stacks relative to their trace point)."""
+    def rec(jaxpr, prefix):
+        for eqn in jaxpr.eqns:
+            stack = str(eqn.source_info.name_stack)
+            scope = "/".join(p for p in (prefix, stack) if p)
+            yield eqn, scope
+            for sub in _sub_jaxprs(eqn):
+                yield from rec(sub, scope)
+
+    yield from rec(closed.jaxpr, "")
+
+
+def _phase_of_scope(scope: str) -> str:
+    """Outermost canonical phase in a scope path, mirroring the HLO
+    census's op_name attribution."""
+    for part in scope.split("/"):
+        if part in PHASES:
+            return part
+    return "(unattributed)"
+
+
+# -- the jaxpr-plane checks --------------------------------------------------
+
+
+def check_no_64bit(entry: str, closed) -> list[Finding]:
+    findings = []
+    seen = set()
+    for eqn, scope in iter_eqns(closed):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in _BAD_AVAL_DTYPES and (eqn.primitive.name, dt) not in seen:
+                seen.add((eqn.primitive.name, dt))
+                findings.append(
+                    Finding(
+                        "RPJ201", f"<trace:{entry}>", 0, entry,
+                        f"{dt} aval on primitive {eqn.primitive.name!r} "
+                        f"(scope {scope or '-'}): the engines contract to "
+                        "32-bit device types — a 64-bit value doubles HBM "
+                        "traffic and breaks the packed-plane layout",
+                    )
+                )
+    return findings
+
+
+def check_no_callbacks(entry: str, closed) -> list[Finding]:
+    findings = []
+    for eqn, scope in iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMS or "callback" in name:
+            findings.append(
+                Finding(
+                    "RPJ202", f"<trace:{entry}>", 0, entry,
+                    f"host callback primitive {name!r} (scope "
+                    f"{scope or '-'}) inside a jitted entry point — a "
+                    "device→host round-trip per execution serializes the "
+                    "dispatch pipeline",
+                )
+            )
+    return findings
+
+
+def check_collective_confinement(entry: str, closed) -> list[Finding]:
+    """Jaxpr-level RPJ203: explicit collectives only under allowed phase
+    scopes; the forbidden phases carry none."""
+    findings = []
+    for eqn, scope in iter_eqns(closed):
+        if eqn.primitive.name not in COLLECTIVE_PRIMS:
+            continue
+        phase = _phase_of_scope(scope)
+        if not collective_phase_allowed(phase):
+            findings.append(
+                Finding(
+                    "RPJ203", f"<trace:{entry}>", 0, entry,
+                    f"collective {eqn.primitive.name!r} attributed to "
+                    f"phase {phase!r} (scope {scope or '-'}): the r8 "
+                    "budget allows this phase ZERO collectives — the "
+                    "partition-invariant construction regressed",
+                )
+            )
+    return findings
+
+
+# -- RPJ205: structural equivalence modulo sharding --------------------------
+
+
+def trace_skeleton(closed, excised_scopes=EXCISED_SCOPES) -> list[tuple]:
+    """Canonical structural skeleton of a trace: the recursive sequence of
+    (primitive, out-shapes/dtypes), with sharding primitives and the
+    excised scopes removed (sub-jaxprs of excised/sharding eqns are not
+    descended — a shard_map region vanishes whole)."""
+    skel: list[tuple] = []
+
+    def rec(jaxpr, prefix):
+        for eqn in jaxpr.eqns:
+            stack = str(eqn.source_info.name_stack)
+            scope = "/".join(p for p in (prefix, stack) if p)
+            parts = scope.split("/")
+            if any(s in parts for s in excised_scopes):
+                continue
+            if eqn.primitive.name in SHARDING_PRIMS:
+                continue
+            outs = tuple(
+                (str(v.aval.dtype), tuple(v.aval.shape))
+                for v in eqn.outvars
+                if hasattr(v, "aval") and hasattr(v.aval, "dtype")
+            )
+            subs = _sub_jaxprs(eqn)
+            if subs:
+                skel.append(("enter", eqn.primitive.name, outs))
+                for sub in subs:
+                    rec(sub, scope)
+                skel.append(("exit", eqn.primitive.name))
+            else:
+                skel.append((eqn.primitive.name, outs))
+
+    rec(closed.jaxpr, "")
+    return skel
+
+
+def check_structural_equivalence(entry: str, dense, sharded) -> list[Finding]:
+    """RPJ205: the two skeletons must be identical.  On mismatch, report
+    the first divergence with local context — enough to name the op that
+    exists in one program and not the other."""
+    a, b = trace_skeleton(dense), trace_skeleton(sharded)
+    if a == b:
+        return []
+    i = 0
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            break
+    else:
+        i = min(len(a), len(b))
+    ctx = (
+        f"first divergence at op {i}/{max(len(a), len(b))}: "
+        f"dense={a[i] if i < len(a) else '<end>'} vs "
+        f"sharded={b[i] if i < len(b) else '<end>'}"
+    )
+    return [
+        Finding(
+            "RPJ205", f"<trace:{entry}>", 0, entry,
+            "sharded and unsharded traces differ structurally OUTSIDE the "
+            f"exchange region ({ctx}) — a partition-dependent computation "
+            "crept in; the bit-identity certificates no longer have a "
+            "static shadow",
+        )
+    ]
+
+
+# -- RPJ204: donation aliasing ----------------------------------------------
+
+
+def check_donation(entry: str, lowered_text: str, n_leaves: int) -> list[Finding]:
+    """The lowered module must alias every donated state leaf to an
+    output (``tf.aliasing_output`` arg attributes)."""
+    aliased = lowered_text.count("tf.aliasing_output")
+    if aliased >= n_leaves:
+        return []
+    return [
+        Finding(
+            "RPJ204", f"<trace:{entry}>", 0, entry,
+            f"only {aliased} of {n_leaves} donated state leaves alias an "
+            "output (tf.aliasing_output) — a donated buffer is being "
+            "silently copied, doubling peak memory at the 1M headline "
+            "(shape/dtype drift between a carried leaf and its update?)",
+        )
+    ]
+
+
+# -- RPJ206: compiled-HLO confinement ----------------------------------------
+
+
+@contextlib.contextmanager
+def _no_compile_cache():
+    """Disable the persistent compilation cache around a censused compile.
+
+    The cache keys executables on the metadata-STRIPPED program, so two
+    programs differing only in named_scope/op_name alias to one cached
+    text — a confinement check could then read another program's phase
+    attribution (observed: a clean fixture served its trip twin's
+    peer-choice metadata once a prior test dropped the cache's
+    min-compile-time threshold to zero).  Phase attribution is only
+    trustworthy on a fresh compile."""
+    import jax
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", old)
+
+
+def census_of_text(hlo_text: str) -> dict:
+    """``hlo_census.parse_collectives`` over an in-memory compiled module
+    (``compiled.as_text()``)."""
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write(hlo_text)
+        path = f.name
+    try:
+        return hlo_census.parse_collectives(path)
+    finally:
+        os.unlink(path)
+
+
+def check_hlo_confinement(entry: str, hlo_text: str) -> list[Finding]:
+    census = census_of_text(hlo_text)
+    findings = []
+    if census.get("total_computations", 0) == 0:
+        return [
+            Finding(
+                "RPJ000", f"<trace:{entry}>", 0, entry,
+                "compiled-HLO census parsed ZERO computations from a "
+                "non-trivial module — dump/text format drift; fix "
+                "analysis/hlo_census.parse_collectives before trusting "
+                "any confinement result",
+            )
+        ]
+    rows = list(hlo_census.executed_rows(census))
+    if not rows:
+        return [
+            Finding(
+                "RPJ000", f"<trace:{entry}>", 0, entry,
+                "compiled sharded program censused ZERO collectives — "
+                "either the parser drifted (r6 failure mode) or the mesh "
+                "stopped partitioning; both need a human",
+            )
+        ]
+    flagged = set()
+    for comp, r in rows:
+        phase = r.get("phase", "(unattributed)")
+        if not collective_phase_allowed(phase) and (phase, r["kind"]) not in flagged:
+            flagged.add((phase, r["kind"]))
+            findings.append(
+                Finding(
+                    "RPJ206", f"<trace:{entry}>", 0, entry,
+                    f"compiled {r['kind']} ({r['bytes']} B, computation "
+                    f"{comp}) attributed to phase {phase!r}: the r8 "
+                    "budget allows this phase ZERO collectives — the "
+                    "partitioner found a way back in (run "
+                    "scripts/profile_mesh.py for the full table)",
+                )
+            )
+    return findings
+
+
+# -- entry-point registry ----------------------------------------------------
+
+# small but structurally faithful configs: big enough for every code path
+# the 1M program runs (hierarchical select forced separately), divisible
+# by the 4-way node axis, k a multiple of 32 * rumor-shards
+_N, _K = 256, 64
+_HLO_N = 2048
+
+
+def _mesh8():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        raise RuntimeError(
+            f"jaxlint plane 2 needs the 8-way virtual mesh but only "
+            f"{len(devs)} devices exist — set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            "jax initializes (scripts/jaxlint.py does this)"
+        )
+    return Mesh(np.asarray(devs[:8]).reshape(4, 2), ("node", "rumor"))
+
+
+def _faults(n):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    up = np.ones(n, bool)
+    up[:: max(n // 16, 1)] = False
+    return DeltaFaults(up=jnp.asarray(up), drop_rate=0.05)
+
+
+def build_entrypoints(mesh=None) -> dict:
+    """{name: ClosedJaxpr} for the five public jitted entry points, traced
+    dense (``mesh=None``) or with the shard-local exchange lowering
+    (``mesh`` = the 4×2 virtual mesh).  rng="counter" — the sharded-caller
+    default whose zero-collective peer choice the confinement rules pin."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ringpop_tpu.parallel.shift import shard_roll
+    from ringpop_tpu.sim import delta, lifecycle, telemetry
+
+    out = {}
+    lparams = lifecycle.LifecycleParams(
+        n=_N, k=_K, suspect_ticks=5, rng="counter", exchange_mesh=mesh
+    )
+    lstate = lifecycle.init_state(lparams, seed=0)
+    lfaults = _faults(_N)
+    out["lifecycle_step"] = jax.make_jaxpr(
+        lambda s, f: lifecycle.step(lparams, s, f)
+    )(lstate, lfaults)
+
+    dparams = delta.DeltaParams(n=_N, k=_K, rng="counter", exchange_mesh=mesh)
+    dstate = delta.init_state(dparams, seed=0)
+    out["delta_step"] = jax.make_jaxpr(
+        lambda s, f: delta.step(dparams, s, f)
+    )(dstate, lfaults)
+
+    subjects = jnp.asarray(np.flatnonzero(~np.asarray(lfaults.up))[:8], jnp.int32)
+    learned_sharding = (
+        NamedSharding(mesh, P("node", None)) if mesh is not None else None
+    )
+    out["detect_walk"] = jax.make_jaxpr(
+        lambda s, f: lifecycle.detection_complete(
+            s, subjects, f, lifecycle.FAULTY, learned_sharding=learned_sharding
+        )
+    )(lstate, lfaults)
+
+    tel = telemetry.zeros(lparams)
+    out["telemetry_fetch"] = jax.make_jaxpr(
+        lambda t, s, f: telemetry.fetch(t, s, f)
+    )(tel, lstate, lfaults)
+
+    if mesh is not None:
+        plane = jnp.zeros((_N, lifecycle.n_words(_K)), jnp.uint32)
+        out["shard_roll"] = jax.make_jaxpr(
+            lambda x, sh: shard_roll(
+                (x,), sh, mesh, "node", (P("node", None),)
+            )
+        )(plane, jnp.int32(3))
+    return out
+
+
+def run_trace_checks() -> list[Finding]:
+    """The full plane-2 jaxpr suite: every entry point, dense + sharded."""
+    mesh = _mesh8()
+    dense = build_entrypoints(mesh=None)
+    sharded = build_entrypoints(mesh=mesh)
+    findings: list[Finding] = []
+    for variant, entries in (("dense", dense), ("sharded", sharded)):
+        for name, closed in entries.items():
+            tag = f"{name}[{variant}]"
+            findings += check_no_64bit(tag, closed)
+            findings += check_no_callbacks(tag, closed)
+            findings += check_collective_confinement(tag, closed)
+    for name in ("lifecycle_step", "delta_step", "detect_walk"):
+        findings += check_structural_equivalence(name, dense[name], sharded[name])
+    findings += _donation_checks()
+    return findings
+
+
+def _donation_checks() -> list[Finding]:
+    import jax
+
+    from ringpop_tpu.sim import delta, lifecycle
+
+    findings: list[Finding] = []
+    lparams = lifecycle.LifecycleParams(n=_N, k=_K, suspect_ticks=5, rng="counter")
+    lstate = lifecycle.init_state(lparams, seed=0)
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, lparams),
+        static_argnames="ticks",
+        donate_argnums=(0,),
+    )
+    findings += check_donation(
+        "lifecycle_block",
+        blk.lower(lstate, _faults(_N), ticks=1).as_text(),
+        len(jax.tree.leaves(lstate)),
+    )
+    dparams = delta.DeltaParams(n=_N, k=_K, rng="counter")
+    dstate = delta.init_state(dparams, seed=0)
+
+    def dblk(s, f):
+        return jax.lax.fori_loop(0, 2, lambda _, st: delta.step(dparams, st, f), s)
+
+    jblk = jax.jit(dblk, donate_argnums=(0,))
+    findings += check_donation(
+        "delta_block",
+        jblk.lower(dstate, _faults(_N)).as_text(),
+        len(jax.tree.leaves(dstate)),
+    )
+    return findings
+
+
+def run_hlo_checks() -> list[Finding]:
+    """RPJ206: compile the sharded lifecycle tick (hierarchical select
+    forced, the sharded-caller defaults) on the virtual mesh and confine
+    its full collective census."""
+    import dataclasses
+
+    import jax
+
+    from ringpop_tpu.sim import lifecycle
+
+    mesh = _mesh8()
+    params = lifecycle.LifecycleParams(
+        n=_HLO_N, k=_K, suspect_ticks=5, rng="counter", exchange_mesh=mesh
+    )
+    state = jax.tree.map(
+        jax.device_put,
+        lifecycle.init_state(params, seed=0),
+        lifecycle.state_shardings(mesh, k=_K),
+    )
+    old_min_n = lifecycle._SPARSE_TOPK_MIN_N
+    lifecycle._SPARSE_TOPK_MIN_N = 0
+    try:
+        blk = jax.jit(
+            functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+        )
+        with _no_compile_cache():
+            text = blk.lower(state, _faults(_HLO_N), ticks=1).compile().as_text()
+    finally:
+        lifecycle._SPARSE_TOPK_MIN_N = old_min_n
+    return check_hlo_confinement("lifecycle_step[hlo,sharded]", text)
+
+
+# -- fixture dispatch --------------------------------------------------------
+
+
+def check_fixture(rule: str, fn, args) -> list[Finding]:
+    """Run one plane-2 rule against a fixture's ``build()`` output.  For
+    RPJ205 ``build()`` returns ``(fn_a, fn_b, args)`` — two programs to
+    compare; for RPJ204, ``(fn, args)`` with arg 0 donated; for RPJ206,
+    ``(fn, args)`` compiled and censused; else ``(fn, args)`` traced."""
+    import jax
+
+    entry = f"fixture:{rule}"
+    if rule == "RPJ205":
+        fn_a, fn_b = fn
+        a = jax.make_jaxpr(fn_a)(*args)
+        b = jax.make_jaxpr(fn_b)(*args)
+        return check_structural_equivalence(entry, a, b)
+    if rule == "RPJ204":
+        low = jax.jit(fn, donate_argnums=(0,)).lower(*args)
+        return check_donation(entry, low.as_text(), len(jax.tree.leaves(args[0])))
+    if rule == "RPJ206":
+        with _no_compile_cache():
+            text = jax.jit(fn).lower(*args).compile().as_text()
+        return check_hlo_confinement(entry, text)
+    closed = jax.make_jaxpr(fn)(*args)
+    if rule == "RPJ201":
+        return check_no_64bit(entry, closed)
+    if rule == "RPJ202":
+        return check_no_callbacks(entry, closed)
+    if rule == "RPJ203":
+        return check_collective_confinement(entry, closed)
+    raise ValueError(f"unknown trace rule {rule!r}")

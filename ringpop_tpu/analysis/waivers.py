@@ -1,0 +1,104 @@
+"""Checked-in rule waivers with mandatory justifications.
+
+``analysis/waivers.toml`` is an array of ``[[waiver]]`` tables:
+
+.. code-block:: toml
+
+    [[waiver]]
+    rule = "RPA101"                      # rule id, or "*"
+    path = "ringpop_tpu/sim/fullview.py" # repo-relative file, or "*"
+    scope = "step"                       # enclosing-function qualname
+                                         # (prefix match on dotted parts),
+                                         # or "*"
+    justification = "why this violation is deliberate"
+
+A waiver with an empty/missing ``justification`` is a CONFIGURATION
+ERROR (jaxlint exits 2): the file exists to record *reasoned* exceptions,
+not to silence rules.  Unused waivers are reported so stale entries rot
+visibly instead of silently.
+
+Python 3.10 has no ``tomllib``, and the repo adds no dependencies, so
+``load_waivers`` parses the TOML subset the file needs: ``[[waiver]]``
+array-of-table headers, ``key = "string"`` pairs, comments, blank lines.
+Anything else in the file is rejected loudly (better than a waiver
+half-parsing into a rule silencer it never promised to be).
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class WaiverError(ValueError):
+    """Malformed waivers file — a config error, not a lint finding."""
+
+
+_KV_RE = re.compile(r'^([A-Za-z_][\w\-]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:#.*)?$')
+
+REQUIRED_KEYS = ("rule", "path", "scope", "justification")
+
+
+def load_waivers(path: str) -> list[dict]:
+    """Parse the waiver file into a list of dicts, validating that every
+    entry carries the required keys and a non-empty justification."""
+    waivers: list[dict] = []
+    cur: dict | None = None
+    try:
+        lines = open(path).read().split("\n")
+    except OSError:
+        return []
+    for ln, raw in enumerate(lines, 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[waiver]]":
+            cur = {"_line": ln}
+            waivers.append(cur)
+            continue
+        m = _KV_RE.match(line)
+        if m and cur is not None:
+            cur[m.group(1)] = m.group(2).replace('\\"', '"')
+            continue
+        raise WaiverError(
+            f"{path}:{ln}: unparseable waiver line {line!r} — the file "
+            "accepts only [[waiver]] headers and key = \"string\" pairs"
+        )
+    for w in waivers:
+        for key in REQUIRED_KEYS:
+            if not str(w.get(key, "")).strip():
+                raise WaiverError(
+                    f"{path}:{w['_line']}: waiver missing required "
+                    f"non-empty {key!r} (every waiver must say what it "
+                    "waives and WHY)"
+                )
+    return waivers
+
+
+def _scope_matches(pattern: str, scope: str) -> bool:
+    return (
+        pattern == "*"
+        or scope == pattern
+        or scope.startswith(pattern + ".")
+        or scope.startswith(pattern + ".<locals>")
+    )
+
+
+def apply_waivers(findings, waivers) -> list[dict]:
+    """Mark matching findings waived (in place) and return the UNUSED
+    waiver entries.  A waiver matches on (rule, path, scope); ``*``
+    wildcards each field; scope matches the enclosing qualname or any of
+    its nested functions."""
+    used = [False] * len(waivers)
+    for f in findings:
+        for i, w in enumerate(waivers):
+            if w["rule"] not in ("*", f.rule):
+                continue
+            if w["path"] not in ("*", f.path):
+                continue
+            if not _scope_matches(w["scope"], f.scope):
+                continue
+            f.waived = True
+            f.justification = w["justification"]
+            used[i] = True
+            break
+    return [w for i, w in enumerate(waivers) if not used[i]]

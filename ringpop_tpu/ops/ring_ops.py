@@ -52,13 +52,17 @@ def _lookup_n_window(tokens, owners, key_hashes, n: int, w: int):
     offs = (start[:, None] + pos[None, :]) % tokens.shape[0]
     cand = owners[offs].astype(jnp.int32)  # [B, w]
 
-    # first occurrence of each owner along the walk, via an O(w log w) sort:
-    # sort (owner, walk-pos) pairs; the head of each equal-owner run is the
-    # owner's first sighting, scattered back to walk position
-    comp = cand.astype(jnp.int64) * w + pos[None, :]
-    sc = jnp.sort(comp, axis=1)
-    sowner = sc // w
-    spos = (sc % w).astype(jnp.int32)
+    # first occurrence of each owner along the walk, via an O(w log w)
+    # STABLE argsort by owner: walk positions are already ascending, so a
+    # stable sort yields (owner asc, pos asc) — the head of each
+    # equal-owner run is the owner's first sighting, scattered back to
+    # walk position.  (The previous formulation packed (owner, pos) into
+    # an int64 composite key, which with x64 disabled silently computes
+    # in int32 and overflows once owner*w exceeds 2^31 — e.g. ~7k
+    # servers at 100 vnodes each with a wide rescue window.  jaxlint
+    # RPA104 guards against the pattern returning.)
+    spos = jnp.argsort(cand, axis=1).astype(jnp.int32)
+    sowner = jnp.take_along_axis(cand, spos, axis=1)
     head = jnp.concatenate(
         [jnp.ones((b, 1), bool), sowner[:, 1:] != sowner[:, :-1]], axis=1
     )

@@ -171,140 +171,153 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     no scatter, and no traced-shift rolls, whose slice-select lowering
     XLA:CPU re-derives per consuming element; see PERF.md "Round 3").
     Value-identical to the unpacked formulation — certified bit-for-bit
-    by tests/test_delta_golden.py."""
-    n, k = params.n, params.k
-    max_p = jnp.int8(clamped_max_p(params))
-    if params.rng not in ("threefry", "counter"):
-        raise ValueError(f"unknown rng family {params.rng!r}")
-    use_counter = params.rng == "counter"
-    if use_counter:
-        # stateless counter stream (sim/prng.py): the key leaf carries the
-        # seed material unchanged and the tick counter advances the stream
-        from ringpop_tpu.sim import prng as _prng
-
-        key = state.key
-        cseed = _prng.fold_key(state.key)
-        ctick = state.tick
-    else:
-        key, k_target, k_drop = jax.random.split(state.key, 3)
-    i_all = jnp.arange(n, dtype=jnp.int32)
-
-    shift_mode = params.exchange == "shift"
-    emesh = params.exchange_mesh
-    use_sm = (
-        shift_mode
-        and emesh is not None
-        and emesh.shape.get("node", 1) > 1
-        and n % emesh.shape["node"] == 0
-    )
-    if shift_mode:
+    by tests/test_delta_golden.py.  The ``jax.named_scope`` sections name
+    the protocol phase in profiler traces and HLO metadata — the same
+    vocabulary as the lifecycle engine (``analysis/phases.PHASES``), so
+    the collective census can attribute this engine's sharded traffic
+    too; scopes are metadata-only and change no values (jaxlint RPA105
+    requires them)."""
+    with jax.named_scope("tick-prologue"):
+        n, k = params.n, params.k
+        max_p = jnp.int8(clamped_max_p(params))
+        if params.rng not in ("threefry", "counter"):
+            raise ValueError(f"unknown rng family {params.rng!r}")
+        use_counter = params.rng == "counter"
         if use_counter:
-            s = _prng.draw_randint(cseed, ctick, _prng.D_SHIFT, 0, 1, n)
-        else:
-            s = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
-        targets = (i_all + s) % n
-    else:
-        if use_counter:
-            targets = _prng.draw_randint(cseed, ctick, _prng.D_TARGET, i_all, 0, n - 1)
-        else:
-            targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
-        targets = jnp.where(targets >= i_all, targets + 1, targets)
+            # stateless counter stream (sim/prng.py): the key leaf carries
+            # the seed material unchanged and the tick counter advances the
+            # stream
+            from ringpop_tpu.sim import prng as _prng
 
-    up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
-    conn = up & up[targets]
-    if faults.group is not None:
-        g = faults.group
-        conn &= (g < 0) | (g[targets] < 0) | (g == g[targets])
-    if faults.drop_rate > 0:
-        drop_u = (
-            _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
-            if use_counter
-            else jax.random.uniform(k_drop, (n,))
+            key = state.key
+            cseed = _prng.fold_key(state.key)
+            ctick = state.tick
+        else:
+            key, k_target, k_drop = jax.random.split(state.key, 3)
+        i_all = jnp.arange(n, dtype=jnp.int32)
+
+    with jax.named_scope("ping-target"):
+        shift_mode = params.exchange == "shift"
+        emesh = params.exchange_mesh
+        use_sm = (
+            shift_mode
+            and emesh is not None
+            and emesh.shape.get("node", 1) > 1
+            and n % emesh.shape["node"] == 0
         )
-        conn &= drop_u >= faults.drop_rate
+        if shift_mode:
+            if use_counter:
+                s = _prng.draw_randint(cseed, ctick, _prng.D_SHIFT, 0, 1, n)
+            else:
+                s = jax.random.randint(k_target, (), 1, n, dtype=jnp.int32)
+            targets = (i_all + s) % n
+        else:
+            if use_counter:
+                targets = _prng.draw_randint(cseed, ctick, _prng.D_TARGET, i_all, 0, n - 1)
+            else:
+                targets = jax.random.randint(k_target, (n,), 0, n - 1, dtype=jnp.int32)
+            targets = jnp.where(targets >= i_all, targets + 1, targets)
 
-    if shift_mode:
-        ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
-        cmask = row_mask(conn)
-        riding_w = state.learned & ride_ok_w
-        # request leg: sender i's rumors land at targets[i].  The cyclic
-        # permutation makes delivery a row gather (receipt uniqueness is
-        # structural: node j is pinged only by j-s).
-        sent_w = riding_w & cmask
-        if use_sm:
-            # sharded callers: both roll legs as explicit shard-local
-            # crossing-block ppermutes (parallel/shift.shard_roll) instead
-            # of GSPMD's plane-sized all-gathers; bit-identical data motion
-            from jax.sharding import PartitionSpec as _P
-
-            from ringpop_tpu.parallel.shift import shard_roll
-
-            wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
-            inbound_w, got_pinged = shard_roll(
-                (sent_w, conn), s, emesh, "node", (wspec, _P("node"))
+        up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
+        conn = up & up[targets]
+        if faults.group is not None:
+            g = faults.group
+            conn &= (g < 0) | (g[targets] < 0) | (g == g[targets])
+        if faults.drop_rate > 0:
+            drop_u = (
+                _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
+                if use_counter
+                else jax.random.uniform(k_drop, (n,))
             )
-        else:
-            idx_fwd = jnp.mod(i_all - s, n)
-            inbound_w = sent_w[idx_fwd]
-            got_pinged = conn[idx_fwd]
-        learned1_w = state.learned | inbound_w
-        # response leg: the target's riding rumors come back to the pinger
-        answerable_w = learned1_w & ride_ok_w
-        if use_sm:
-            (resp_src,) = shard_roll((answerable_w,), n - s, emesh, "node", (wspec,))
-        else:
-            resp_src = answerable_w[jnp.mod(i_all + s, n)]
-        resp_w = resp_src & cmask
-        learned2_w = learned1_w | resp_w
-        # bump = sent + (riding & got_pinged) = riding * (conn + got):
-        # the bit factor is ONE materialized-plane product (learned &
-        # ride_ok are both state carries), the rest is per-row scalars —
-        # so the int8 pass reads two words per 32 elements instead of
-        # re-deriving the sent/resp gather chains per bit
-        riding_bit = unpack_bits(riding_w, k)
-        bump = riding_bit.astype(jnp.int8) * (
-            conn.astype(jnp.int8) + got_pinged.astype(jnp.int8)
-        )[:, None]
-        newly_bit = unpack_bits(learned2_w & ~state.learned, k)
-    else:
-        learned0_b = unpack_bits(state.learned, k)
-        ride_ok_b = state.pcount < max_p
-        riding_b = learned0_b & ride_ok_b
-        sent_b = riding_b & conn[:, None]
-        # scatter-or by target (bool max == or; duplicate targets merge)
-        inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
-        got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
-        learned1_b = learned0_b | inbound_b
-        answerable_b = learned1_b & ride_ok_b
-        resp_b = answerable_b[targets] & conn[:, None]
-        learned2_b = learned1_b | resp_b
-        learned2_w = pack_bool(learned2_b)
-        bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
-            jnp.int8
-        )
-        newly_bit = learned2_b & ~learned0_b
+            conn &= drop_u >= faults.drop_rate
 
-    # piggyback bumps: sender on success; receiver once per busy tick;
-    # newly learned rumors start at pcount 0 (RecordChange)
-    pcount_mid = jnp.minimum(state.pcount + bump, max_p)
-    pcount_mid = jnp.where(newly_bit, jnp.int8(0), pcount_mid)
+    with jax.named_scope("rumor-exchange"):
+        if shift_mode:
+            ride_ok_w = state.ride_ok  # carried, materialized at the tick edge
+            cmask = row_mask(conn)
+            riding_w = state.learned & ride_ok_w
+            # request leg: sender i's rumors land at targets[i].  The cyclic
+            # permutation makes delivery a row gather (receipt uniqueness is
+            # structural: node j is pinged only by j-s).
+            sent_w = riding_w & cmask
+            if use_sm:
+                # sharded callers: both roll legs as explicit shard-local
+                # crossing-block ppermutes (parallel/shift.shard_roll) instead
+                # of GSPMD's plane-sized all-gathers; bit-identical data motion
+                from jax.sharding import PartitionSpec as _P
 
-    # full-sync analog (disseminator.go:156-304): a rumor whose piggyback
-    # counters all expired short of full coverage (e.g. it saturated one
-    # side of a partition) is re-seeded, the way checksum-mismatch full
-    # syncs repair divergence the maxP bound left behind
-    up_mask = row_mask(up)
-    mid_ride_w = pack_bool(pcount_mid < max_p)  # materialized reduce output
-    fully = unpack_bits(and_reduce_rows(learned2_w | row_mask(~up)), k)
-    riding_now_w = learned2_w & up_mask & mid_ride_w
-    stuck = ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully
-    stuck_w = pack_bool(stuck)
-    # one fused reset pass over the int8 plane, reading packed words
-    reset_w = learned2_w & stuck_w[None, :]
-    pcount = jnp.where(unpack_bits(reset_w, k), jnp.int8(0), pcount_mid)
-    # maintain the carried invariant: riding resumes where the stuck reset
-    # re-opened counters, plus wherever the mid gate was already open
-    ride_ok_next = mid_ride_w | reset_w
+                from ringpop_tpu.parallel.shift import shard_roll
+
+                wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
+                inbound_w, got_pinged = shard_roll(
+                    (sent_w, conn), s, emesh, "node", (wspec, _P("node"))
+                )
+            else:
+                idx_fwd = jnp.mod(i_all - s, n)
+                inbound_w = sent_w[idx_fwd]
+                got_pinged = conn[idx_fwd]
+            learned1_w = state.learned | inbound_w
+            # response leg: the target's riding rumors come back to the pinger
+            answerable_w = learned1_w & ride_ok_w
+            if use_sm:
+                (resp_src,) = shard_roll((answerable_w,), n - s, emesh, "node", (wspec,))
+            else:
+                resp_src = answerable_w[jnp.mod(i_all + s, n)]
+            resp_w = resp_src & cmask
+            learned2_w = learned1_w | resp_w
+        else:
+            learned0_b = unpack_bits(state.learned, k)
+            ride_ok_b = state.pcount < max_p
+            riding_b = learned0_b & ride_ok_b
+            sent_b = riding_b & conn[:, None]
+            # scatter-or by target (bool max == or; duplicate targets merge)
+            inbound_b = jax.ops.segment_max(sent_b, targets, num_segments=n)
+            got_pinged = jax.ops.segment_max(conn.astype(jnp.int8), targets, num_segments=n) > 0
+            learned1_b = learned0_b | inbound_b
+            answerable_b = learned1_b & ride_ok_b
+            resp_b = answerable_b[targets] & conn[:, None]
+            learned2_b = learned1_b | resp_b
+            learned2_w = pack_bool(learned2_b)
+
+    with jax.named_scope("piggyback-counters"):
+        if shift_mode:
+            # bump = sent + (riding & got_pinged) = riding * (conn + got):
+            # the bit factor is ONE materialized-plane product (learned &
+            # ride_ok are both state carries), the rest is per-row scalars —
+            # so the int8 pass reads two words per 32 elements instead of
+            # re-deriving the sent/resp gather chains per bit
+            riding_bit = unpack_bits(riding_w, k)
+            bump = riding_bit.astype(jnp.int8) * (
+                conn.astype(jnp.int8) + got_pinged.astype(jnp.int8)
+            )[:, None]
+            newly_bit = unpack_bits(learned2_w & ~state.learned, k)
+        else:
+            bump = sent_b.astype(jnp.int8) + (riding_b & got_pinged[:, None]).astype(
+                jnp.int8
+            )
+            newly_bit = learned2_b & ~learned0_b
+
+        # piggyback bumps: sender on success; receiver once per busy tick;
+        # newly learned rumors start at pcount 0 (RecordChange)
+        pcount_mid = jnp.minimum(state.pcount + bump, max_p)
+        pcount_mid = jnp.where(newly_bit, jnp.int8(0), pcount_mid)
+
+        # full-sync analog (disseminator.go:156-304): a rumor whose piggyback
+        # counters all expired short of full coverage (e.g. it saturated one
+        # side of a partition) is re-seeded, the way checksum-mismatch full
+        # syncs repair divergence the maxP bound left behind
+        up_mask = row_mask(up)
+        mid_ride_w = pack_bool(pcount_mid < max_p)  # materialized reduce output
+        fully = unpack_bits(and_reduce_rows(learned2_w | row_mask(~up)), k)
+        riding_now_w = learned2_w & up_mask & mid_ride_w
+        stuck = ~unpack_bits(or_reduce_rows(riding_now_w), k) & ~fully
+        stuck_w = pack_bool(stuck)
+        # one fused reset pass over the int8 plane, reading packed words
+        reset_w = learned2_w & stuck_w[None, :]
+        pcount = jnp.where(unpack_bits(reset_w, k), jnp.int8(0), pcount_mid)
+        # maintain the carried invariant: riding resumes where the stuck reset
+        # re-opened counters, plus wherever the mid gate was already open
+        ride_ok_next = mid_ride_w | reset_w
 
     return DeltaState(
         learned=learned2_w, pcount=pcount, ride_ok=ride_ok_next, tick=state.tick + 1, key=key

@@ -1,0 +1,184 @@
+"""jaxlint — drive both static-analysis planes (``make lint``).
+
+Plane 1 (``ringpop_tpu/analysis/astlint``) lints the package source for
+codebase-specific hazards; plane 2 (``ringpop_tpu/analysis/trace_checks``)
+traces the public jitted entry points dense + under the 8-way virtual
+mesh and checks the invariants of the traced programs themselves.  Rule
+catalog and the story behind each rule: ANALYSIS.md.
+
+Usage:
+    python scripts/jaxlint.py                      # full repo, both planes
+    python scripts/jaxlint.py --plane 1            # AST plane only (fast)
+    python scripts/jaxlint.py --format json        # machine-readable listing
+    python scripts/jaxlint.py path/to/file.py ...  # explicit files
+
+Explicit file arguments are linted by every applicable AST rule; a file
+defining ``JAXLINT_TRACE_RULE = "RPJ2xx"`` and ``build()`` is a trace
+fixture and additionally runs that jaxpr/HLO-plane rule on its built
+program — this is how the fixture corpus under
+``tests/analysis_fixtures/`` exercises plane 2 (and how ``make lint``
+can be pointed at a trip-case to prove it fails).
+
+Exit codes: 0 clean, 1 unwaived findings, 2 waiver-file config error.
+``--format json`` emits every finding (waived ones flagged) plus unused
+waivers — a stable diffable surface for future budget re-baselines.
+
+Waivers: ``ringpop_tpu/analysis/waivers.toml`` — (rule, path, scope)
+matches with mandatory justification strings; unused entries are
+reported so they rot visibly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# plane 2 traces under the same 8-virtual-device CPU topology as the
+# tests and profile_mesh; must be pinned before jax initializes (the
+# import is deferred until a plane-2 check actually runs, so plane-1-only
+# invocations never pay jax startup)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# the default plane-1 sweep: every tree that holds device code or drives
+# it (tests are deliberately out — they pin threefry goldens and host
+# coercions by design; the fixture corpus routes through explicit paths)
+DEFAULT_PATHS = ("ringpop_tpu", "scripts", "examples", "bench.py", "__graft_entry__.py")
+WAIVERS_PATH = os.path.join("ringpop_tpu", "analysis", "waivers.toml")
+
+
+def _trace_fixture_rule(path: str) -> str | None:
+    """The JAXLINT_TRACE_RULE marker of a fixture file, or None."""
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "JAXLINT_TRACE_RULE"
+                    and isinstance(node.value, ast.Constant)
+                ):
+                    return str(node.value.value)
+    return None
+
+
+def _run_trace_fixture(path: str, rule: str):
+    """Load a fixture module and run its declared plane-2 rule."""
+    import importlib.util
+
+    from ringpop_tpu.analysis import trace_checks
+
+    spec = importlib.util.spec_from_file_location(
+        "jaxlint_fixture_" + os.path.basename(path)[:-3], path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    built = mod.build()
+    fn, args = built[:-1], built[-1]
+    if len(fn) == 1:
+        fn = fn[0]
+    findings = trace_checks.check_fixture(rule, fn, args)
+    rel = os.path.relpath(path, _REPO).replace(os.sep, "/")
+    for f in findings:
+        f.path = rel  # anchor fixture findings at the file, not the trace tag
+    return findings
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("paths", nargs="*", help="explicit files/dirs (default: repo sweep)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--plane", choices=("1", "2", "all"), default="all",
+        help="1 = AST lint only (no jax import), 2 = trace checks only, "
+        "all = both (default)",
+    )
+    ap.add_argument(
+        "--waivers", default=os.path.join(_REPO, WAIVERS_PATH),
+        help="waiver file (default: ringpop_tpu/analysis/waivers.toml)",
+    )
+    args = ap.parse_args()
+
+    from ringpop_tpu.analysis import astlint, findings as findings_mod, waivers
+
+    all_findings = []
+    explicit = bool(args.paths)
+    paths = args.paths or list(DEFAULT_PATHS)
+
+    if args.plane in ("1", "all"):
+        all_findings += astlint.lint_paths(paths, _REPO)
+
+    if args.plane in ("2", "all"):
+        if explicit:
+            files = []
+            for p in paths:
+                ap_ = p if os.path.isabs(p) else os.path.join(_REPO, p)
+                if os.path.isdir(ap_):
+                    for dirpath, dirnames, filenames in os.walk(ap_):
+                        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                        files += [
+                            os.path.join(dirpath, f)
+                            for f in sorted(filenames) if f.endswith(".py")
+                        ]
+                elif os.path.isfile(ap_):
+                    files.append(ap_)
+            for ap_ in files:
+                rule = _trace_fixture_rule(ap_)
+                if rule:
+                    all_findings += _run_trace_fixture(ap_, rule)
+        else:
+            from ringpop_tpu.analysis import trace_checks
+
+            all_findings += trace_checks.run_trace_checks()
+            all_findings += trace_checks.run_hlo_checks()
+
+    try:
+        wlist = waivers.load_waivers(args.waivers)
+        unused = waivers.apply_waivers(all_findings, wlist)
+    except waivers.WaiverError as e:
+        print(f"jaxlint: waiver config error: {e}", file=sys.stderr)
+        return 2
+    if explicit:
+        # a scoped run only lints a subset — a waiver for an un-linted
+        # file is not stale, so the unused report would mislead (and its
+        # "delete it" advice would break the full sweep)
+        unused = []
+
+    unwaived = [f for f in all_findings if not f.waived]
+    if args.format == "json":
+        print(findings_mod.to_json(
+            all_findings, unused,
+            extra={"planes": args.plane, "paths": paths},
+        ))
+    else:
+        for f in all_findings:
+            print(f.render())
+        for w in unused:
+            print(
+                f"jaxlint: WARNING unused waiver {w['rule']} {w['path']} "
+                f"{w['scope']} (waivers.toml:{w['_line']}) — delete or fix it"
+            )
+        n_wv = len(all_findings) - len(unwaived)
+        print(
+            f"jaxlint: {len(unwaived)} finding(s), {n_wv} waived"
+            + (f", {len(unused)} unused waiver(s)" if unused else "")
+        )
+    return 1 if unwaived else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

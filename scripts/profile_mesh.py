@@ -56,307 +56,29 @@ import argparse
 import glob
 import json
 import os
-import re
 import shutil
 import sys
 import tempfile
 
-COLLECTIVES = (
-    "all-reduce",
-    "all-gather",
-    "all-to-all",
-    "collective-permute",
-    "collective-broadcast",
-    "reduce-scatter",
+# the census parser and the phase vocabulary live in the analysis package
+# (ringpop_tpu/analysis/{hlo_census,phases}.py) so the jaxlint HLO plane
+# and the pytest budget guards share ONE implementation; this script
+# re-exports the names its callers (tests/test_mesh_budget.py,
+# tests/test_prng.py) historically imported from here.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ringpop_tpu.analysis.hlo_census import (  # noqa: E402
+    COLLECTIVES,  # noqa: F401 - re-export
+    executed_rows,
+    newest_module as _newest_module,
+    parse_collectives,
+    summarize as _summarize,
+    summarize_phases as _summarize_phases,
 )
-
-# protocol-phase named scopes (jax.named_scope in sim/lifecycle.py and
-# sim/packbits.py) — XLA carries them through to each instruction's
-# metadata op_name, which is how a censused collective gets attributed to
-# the protocol phase that emitted it.  Outermost-first: a collective under
-# "rumor-exchange/row-reduce" belongs to the exchange phase.
-PHASES = (
-    "tick-prologue",
-    "ping-target",
-    "rumor-exchange",
-    "heal",
-    "piggyback-counters",
-    "timers-fold",
-    "peer-choice",
-    "candidate-select",
-    "alloc-seed",
-    "commit",
-    "telemetry",
-    "detect-walk",
-    "view-checksum",
-    "row-reduce",
-    "set-bit",
-    "shard-roll",
+from ringpop_tpu.analysis.phases import (  # noqa: E402,F401 - re-exports
+    PHASES,
+    PHASE_BUDGET_PHASES,
 )
-
-# the phases --phase-budget ratchets (r8): the exchange legs must stay
-# ppermute-only and the peer-choice draws collective-free — a regression
-# in either can hide inside a roughly-unchanged global total, which is
-# exactly what the per-phase ratchet exists to catch
-PHASE_BUDGET_PHASES = ("rumor-exchange", "ping-target", "peer-choice", "shard-roll")
-
-_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
-_SRC_RE = re.compile(r'source_file="([^"]+)" source_line=(\d+)')
-_PHASE_SPAN_CACHE: dict = {}
-
-
-def _source_spans(path: str):
-    """(named-scope spans, function starts) of one source file — the
-    fallback attributor for collectives whose op_name lost its scope (the
-    SPMD partitioner re-homes resharding ops onto loop boundaries, whose
-    metadata names only the enclosing while)."""
-    if path not in _PHASE_SPAN_CACHE:
-        spans, funcs = [], []
-        try:
-            src = open(path).read().split("\n")
-        except OSError:
-            src = []
-        for i, ln in enumerate(src):
-            m = re.match(r'(\s*)with jax\.named_scope\("([^"]+)"\):', ln)
-            if m:
-                indent = len(m.group(1))
-                j = i + 1
-                while j < len(src) and (
-                    not src[j].strip()
-                    or len(src[j]) - len(src[j].lstrip()) > indent
-                ):
-                    j += 1
-                spans.append((i + 1, j, m.group(2)))
-            d = re.match(r"def (\w+)\(", ln)
-            if d:
-                funcs.append((i + 1, d.group(1)))
-        _PHASE_SPAN_CACHE[path] = (spans, funcs)
-    return _PHASE_SPAN_CACHE[path]
-
-
-def _phase_of(line: str) -> str:
-    """Protocol phase of one HLO instruction line: the named-scope path
-    XLA keeps in metadata op_name when present (fusions inherit a
-    representative instruction's metadata), else the scope lexically
-    enclosing the op's source line, else ``loop:<function>`` for ops the
-    partitioner re-homed onto a loop boundary (e.g. the detect walk's
-    learned-plane replication hoisted to the tick loop)."""
-    m = _OPNAME_RE.search(line)
-    if m:
-        for part in m.group(1).split("/"):
-            if part in PHASES:
-                return part
-    s = _SRC_RE.search(line)
-    if s:
-        spans, funcs = _source_spans(s.group(1))
-        ln = int(s.group(2))
-        for a, b, name in spans:
-            if a <= ln <= b:
-                return name
-        owner = None
-        for a, name in funcs:
-            if a <= ln:
-                owner = name
-            else:
-                break
-        if owner:
-            return f"loop:{owner}"
-    return "(unattributed)"
-
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-}
-
-
-def _shape_bytes(shape_text: str) -> int:
-    """Total bytes of every array in an HLO result type string (handles
-    tuples; layout annotations ignored)."""
-    total = 0
-    for dtype, dims in re.findall(r"(pred|[suf]\d+|bf16)\[([\d,]*)\]", shape_text):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
-    return total
-
-
-def parse_collectives(hlo_path: str) -> dict:
-    """Per-computation collective census of one optimized HLO module.
-
-    Returns {computation_name: [{op, kind, bytes}...]} plus, for loop
-    attribution, each computation's while-loop depth (a collective inside
-    a while BODY executes once per iteration, so depth distinguishes the
-    one-shot entry collectives from the per-tick / per-walk-step ones),
-    the ``conditional`` branch groups (lists of sibling branch
-    computations, of which exactly ONE executes per evaluation), and the
-    ``executed`` computation set: everything reachable from the module
-    roots taking only the most expensive branch of each conditional —
-    the worst case one execution can actually pay.  Summaries charge the
-    executed set only; ``by_computation`` keeps the full text census."""
-    comps: dict = {}
-    bodies: dict = {}  # while-body computation -> owning computation
-    calls: dict = {}  # computation -> calling computations (reverse edges)
-    fwd: dict = {}  # computation -> called computations (forward edges)
-    cond_groups: list = []  # [{caller, branches: [comp, ...]}, ...]
-    cur = None
-    # instruction/computation names carry a "%" sigil in older XLA text
-    # dumps and none in current ones — accept both, or a format rotation
-    # silently reports an empty census (bit us once: the r6 'before'
-    # capture came out all-zero against a 297-collective program)
-    for line in open(hlo_path):
-        stripped = line.rstrip()
-        if stripped.endswith("{") and not line.lstrip().startswith("ROOT"):
-            cur = stripped.split()[0].lstrip("%")
-            comps.setdefault(cur, [])
-        elif cur is not None and line.strip() == "}":
-            cur = None
-        elif cur is not None:
-            m = re.search(
-                r"%?([\w.\-]+) = (.+?) (" + "|".join(COLLECTIVES) + r")(?:-start)?\(",
-                line,
-            )
-            if m and "-done" not in line.split("=", 1)[1][:60]:
-                comps[cur].append(
-                    {
-                        "op": m.group(1),
-                        "kind": m.group(3),
-                        "bytes": _shape_bytes(m.group(2)),
-                        "phase": _phase_of(line),
-                    }
-                )
-            b = re.search(r"body=%?([\w.\-]+)", line)
-            if b:
-                bodies[b.group(1)] = cur
-            # conditional branches: N-ary (lax.switch) and binary forms
-            branches = []
-            bm = re.search(r"branch_computations=\{([^}]*)\}", line)
-            if bm:
-                branches = [c.strip().lstrip("%") for c in bm.group(1).split(",") if c.strip()]
-            else:
-                tm = re.search(r"true_computation=%?([\w.\-]+)", line)
-                fm = re.search(r"false_computation=%?([\w.\-]+)", line)
-                if tm and fm:
-                    branches = [tm.group(1), fm.group(1)]
-            if branches:
-                cond_groups.append({"caller": cur, "branches": branches})
-            for callee in re.findall(
-                r"(?:calls|to_apply|condition|body|true_computation|"
-                r"false_computation)=%?([\w.\-]+)",
-                line,
-            ) + branches:
-                calls.setdefault(callee, set()).add(cur)
-                fwd.setdefault(cur, set()).add(callee)
-
-    def loop_depth(name: str, seen=()) -> int:
-        if name in seen:
-            return 0
-        best = 0
-        if name in bodies:
-            best = 1 + loop_depth(bodies[name], seen + (name,))
-        for owner in calls.get(name, ()):
-            best = max(best, loop_depth(owner, seen + (name,)))
-        return best
-
-    # -- worst-case-executed computation set: at every conditional take the
-    # branch whose subtree carries the most collective bytes (count as
-    # tie-break); sibling branches are mutually exclusive per execution
-    branch_edges = {
-        (g["caller"], b) for g in cond_groups for b in g["branches"]
-    }
-    groups_of = {}
-    for g in cond_groups:
-        groups_of.setdefault(g["caller"], []).append(g["branches"])
-
-    def subtree_cost(name, seen=()):
-        if name in seen:
-            return (0, 0)
-        seen = seen + (name,)
-        by, ct = 0, 0
-        for r in comps.get(name, ()):
-            by += r["bytes"]
-            ct += 1
-        for branches in groups_of.get(name, []):
-            bb, bc = max((subtree_cost(b, seen) for b in branches), default=(0, 0))
-            by += bb
-            ct += bc
-        for callee in fwd.get(name, ()):
-            if (name, callee) in branch_edges:
-                continue
-            cb, cc = subtree_cost(callee, seen)
-            by += cb
-            ct += cc
-        return (by, ct)
-
-    executed: set = set()
-
-    def walk(name):
-        if name in executed:
-            return
-        executed.add(name)
-        for branches in groups_of.get(name, []):
-            walk(max(branches, key=lambda b: subtree_cost(b)))
-        for callee in fwd.get(name, ()):
-            if (name, callee) not in branch_edges:
-                walk(callee)
-
-    all_names = set(comps) | set(fwd) | {c for cs in fwd.values() for c in cs}
-    roots = all_names - {c for cs in fwd.values() for c in cs}
-    for r in sorted(roots):
-        walk(r)
-    if not roots:  # degenerate single-computation module
-        executed = all_names
-
-    return {
-        "computations": {k: v for k, v in comps.items() if v},
-        "loop_depth": {k: loop_depth(k) for k, v in comps.items() if v},
-        "cond_groups": cond_groups,
-        "executed": sorted(executed),
-    }
-
-
-def _newest_module(dump: str, marker: str) -> str | None:
-    mods = [
-        p
-        for p in glob.glob(os.path.join(dump, "*after_optimizations.txt"))
-        if marker in os.path.basename(p) and "buffer" not in p and "memory" not in p
-    ]
-    return max(mods, key=os.path.getsize) if mods else None
-
-
-def executed_rows(census: dict):
-    """Iterate (computation, row) over the worst-case EXECUTED collective
-    set: sibling conditional branches contribute only their most expensive
-    member (see parse_collectives) — the census tests and both summaries
-    share this one definition of "per-tick cost"."""
-    executed = set(census.get("executed") or census["computations"])
-    for comp, rows in census["computations"].items():
-        if comp in executed:
-            for r in rows:
-                yield comp, r
-
-
-def _summarize(census: dict) -> dict:
-    by_kind: dict = {}
-    for _, r in executed_rows(census):
-        e = by_kind.setdefault(r["kind"], {"count": 0, "bytes": 0})
-        e["count"] += 1
-        e["bytes"] += r["bytes"]
-    return by_kind
-
-
-def _summarize_phases(census: dict) -> dict:
-    """{phase: {kind: {count, bytes}}} — the protocol-phase attribution of
-    the collective census (the table PERF.md's budget discussion reads)."""
-    by_phase: dict = {}
-    for _, r in executed_rows(census):
-        kinds = by_phase.setdefault(r.get("phase", "(unattributed)"), {})
-        e = kinds.setdefault(r["kind"], {"count": 0, "bytes": 0})
-        e["count"] += 1
-        e["bytes"] += r["bytes"]
-    return by_phase
-
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -412,6 +134,42 @@ def main() -> None:
         shutil.rmtree(dump, ignore_errors=True)
 
 
+class DumpError(SystemExit):
+    """XLA dump missing/unparseable — exit 4, never an empty passing budget."""
+
+
+def _census_or_die(mod: str | None, dump: str, prog: str) -> dict:
+    """Parse the dumped module or die loudly.  An empty/unparseable dump
+    used to report an empty census — which ``--compare`` then scored as a
+    miracle optimization "within budget" (the exact r6 'before'-capture
+    failure mode).  A missing module, a module the parser cannot see a
+    single computation in, or a sharded program censusing ZERO
+    collectives are all hard errors (exit 4) with the actual dump dir
+    contents in the message."""
+    if mod is None:
+        listing = sorted(os.path.basename(p) for p in glob.glob(os.path.join(dump, "*")))[:12]
+        print(f"profile_mesh: {prog}: no *after_optimizations.txt module in "
+              f"the XLA dump dir — nothing compiled, or the dump flag/file "
+              f"naming rotated.  dump dir holds: {listing or '(empty)'}",
+              file=sys.stderr)
+        raise DumpError(4)
+    census = parse_collectives(mod)
+    if census.get("total_computations", 0) == 0:
+        print(f"profile_mesh: {prog}: parsed ZERO computations from "
+              f"{os.path.basename(mod)} ({os.path.getsize(mod)} bytes) — "
+              "HLO text format drift; fix "
+              "ringpop_tpu/analysis/hlo_census.parse_collectives before "
+              "trusting any budget result", file=sys.stderr)
+        raise DumpError(4)
+    if not any(census["computations"].values()):
+        print(f"profile_mesh: {prog}: censused ZERO collectives in a "
+              f"sharded-mesh program ({os.path.basename(mod)}) — parser "
+              "drift or the mesh stopped partitioning; refusing to report "
+              "an empty census as a passing budget", file=sys.stderr)
+        raise DumpError(4)
+    return census
+
+
 def _run(args, dump: str) -> int:
     import jax
 
@@ -423,7 +181,6 @@ def _run(args, dump: str) -> int:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from ringpop_tpu.sim import lifecycle
     from ringpop_tpu.sim.delta import DeltaFaults
 
@@ -459,10 +216,10 @@ def _run(args, dump: str) -> int:
     mod = _newest_module(dump, "_run_block")
     if mod is None:
         mod = _newest_module(dump, "")
-    census = parse_collectives(mod) if mod else {"computations": {}, "loop_depth": {}}
+    census = _census_or_die(mod, dump, "step")
     report["step"] = {
         "n": n, "k": k, "compile_s": round(step_compile_s, 1),
-        "module": os.path.basename(mod) if mod else None,
+        "module": os.path.basename(mod),
         "by_kind": _summarize(census),
         "by_phase": _summarize_phases(census),
         "by_computation": {
@@ -506,10 +263,10 @@ def _run(args, dump: str) -> int:
         ).compile()
     detect_compile_s = time.perf_counter() - t0
     mod = _newest_module(dump, "")
-    census = parse_collectives(mod) if mod else {"computations": {}, "loop_depth": {}}
+    census = _census_or_die(mod, dump, "detect")
     report["detect"] = {
         "n": nd, "k": 256, "compile_s": round(detect_compile_s, 1),
-        "module": os.path.basename(mod) if mod else None,
+        "module": os.path.basename(mod),
         "by_kind": _summarize(census),
         "by_phase": _summarize_phases(census),
         "by_computation": {

@@ -1,0 +1,13 @@
+"""RPJ204 clean: a same-shape carry — the donated buffer aliases the
+output (the tick-block shape: state in, state out)."""
+
+JAXLINT_TRACE_RULE = "RPJ204"
+
+
+def build():
+    import jax.numpy as jnp
+
+    def fn(x):
+        return x * 2 + 1
+
+    return fn, (jnp.ones((8, 8)),)

@@ -1,0 +1,13 @@
+"""RPJ204 trip: the donated argument cannot alias any output (shape
+mismatch) — the donation is silently a copy."""
+
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ204"
+
+
+def build():
+    def fn(x):
+        return x[::2].sum()
+
+    return fn, (jnp.ones((8, 8)),)

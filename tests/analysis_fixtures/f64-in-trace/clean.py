@@ -1,0 +1,12 @@
+"""RPJ201 clean: the same reduction, 32-bit throughout."""
+
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ201"
+
+
+def build():
+    def fn(x):
+        return x.astype(jnp.float32).sum()
+
+    return fn, (jnp.ones(8),)

@@ -1,0 +1,16 @@
+"""RPJ201 trip: an f64 aval inside the traced program (x64 enabled
+mid-trace — the only way a 64-bit value sneaks past the global config)."""
+
+import jax
+import jax.experimental
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ201"
+
+
+def build():
+    def fn(x):
+        with jax.experimental.enable_x64(True):
+            return x.astype(jnp.float64).sum()
+
+    return fn, (jnp.ones(8),)

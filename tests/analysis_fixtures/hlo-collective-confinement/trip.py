@@ -1,0 +1,34 @@
+"""RPJ206 trip: the COMPILED program carries an all-reduce attributed to
+the forbidden peer-choice phase — the census-level (partitioner-aware)
+form of the confinement rule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+JAXLINT_TRACE_RULE = "RPJ206"
+
+
+def build():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("node",))
+
+    def fn(x):
+        def body(xl):
+            with jax.named_scope("peer-choice"):
+                return jax.lax.psum(xl, "node")
+
+        try:
+            f = _shard_map(body, mesh=mesh, in_specs=(P("node"),),
+                           out_specs=P(), check_vma=False)
+        except TypeError:  # pragma: no cover
+            f = _shard_map(body, mesh=mesh, in_specs=(P("node"),),
+                           out_specs=P(), check_rep=False)
+        return f(x)
+
+    return fn, (jnp.arange(64.0),)

@@ -1,0 +1,12 @@
+"""RPJ202 clean: the doubling stays on device."""
+
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ202"
+
+
+def build():
+    def fn(x):
+        return x * 2
+
+    return fn, (jnp.ones(4),)

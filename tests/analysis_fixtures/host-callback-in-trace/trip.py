@@ -1,0 +1,19 @@
+"""RPJ202 trip: a host callback inside the traced program — one
+device→host round-trip per execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+JAXLINT_TRACE_RULE = "RPJ202"
+
+
+def build():
+    def fn(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a) * 2,
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            x,
+        )
+
+    return fn, (jnp.ones(4),)

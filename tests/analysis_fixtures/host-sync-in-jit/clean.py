@@ -1,0 +1,15 @@
+"""RPA103 clean: device code stays jnp; the host coercion lives in an
+un-jitted host helper, where it belongs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_norm(x):
+    return jnp.sum(x)
+
+
+def host_report(x):
+    return float(np.asarray(x).sum())

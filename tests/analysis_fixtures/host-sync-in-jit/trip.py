@@ -1,0 +1,21 @@
+"""RPA103 trip: host-sync constructs inside a jit-traced function — a
+host numpy coercion and a ``.item()`` readback, both concretization
+fences."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_norm(x):
+    total = np.asarray(x).sum()
+    return total
+
+
+def helper(x):
+    # reachable from the jit root below — the call-graph closure must
+    # flag the .item() here too
+    return x.sum().item()
+
+
+bad_jitted = jax.jit(helper)

@@ -1,0 +1,11 @@
+"""RPA105 clean: the protocol-phase function carries a canonical scope."""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(x):
+    with jax.named_scope("tick-prologue"):
+        y = x * 2
+    with jax.named_scope("commit"):
+        return jnp.sum(y)

@@ -1,0 +1,15 @@
+"""RPA105 trip: a protocol-phase function (``step``) with no
+``jax.named_scope`` — its collectives would census as (unattributed) —
+plus a scope name outside the canonical phase vocabulary."""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(x):
+    return jnp.sum(x * 2)
+
+
+def misnamed(x):
+    with jax.named_scope("my-cool-phase"):
+        return x + 1

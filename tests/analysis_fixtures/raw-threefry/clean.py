@@ -1,0 +1,17 @@
+"""RPA101 clean: the threefry draw is one branch of the rng-family
+dispatch (the enclosing function references the counter stream), and
+PRNGKey construction alone is always legal."""
+
+import jax
+
+from ringpop_tpu.sim import prng as _prng
+
+
+def make_key(seed):
+    return jax.random.PRNGKey(seed)
+
+
+def draw_targets(key, n, use_counter):
+    if use_counter:
+        return _prng.draw_randint(_prng.fold_key(key), 0, _prng.D_TARGET, 0, 0, n)
+    return jax.random.randint(key, (n,), 0, n)

@@ -1,0 +1,9 @@
+"""RPA101 trip: a raw threefry draw with no counter-RNG dispatch in the
+enclosing function — under GSPMD this either materializes replicated or
+draws different lanes sharded vs unsharded."""
+
+import jax
+
+
+def draw_targets(key, n):
+    return jax.random.randint(key, (n,), 0, n)

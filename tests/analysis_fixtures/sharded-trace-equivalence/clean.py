@@ -1,0 +1,22 @@
+"""RPJ205 clean: the programs differ ONLY inside the rumor-exchange
+scope (the one intentionally different lowering) — structurally equal
+after excision, like the shard_roll vs roll-gather legs."""
+
+import jax
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ205"
+
+
+def build():
+    def dense(x):
+        with jax.named_scope("rumor-exchange"):
+            y = x * 3
+        return (y - x).sum()
+
+    def sharded(x):
+        with jax.named_scope("rumor-exchange"):
+            y = x + 1
+        return (y - x).sum()
+
+    return dense, sharded, (jnp.ones(8),)

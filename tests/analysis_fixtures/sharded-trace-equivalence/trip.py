@@ -1,0 +1,16 @@
+"""RPJ205 trip: the two programs differ structurally OUTSIDE the
+excised exchange region — a partition-dependent computation."""
+
+import jax.numpy as jnp
+
+JAXLINT_TRACE_RULE = "RPJ205"
+
+
+def build():
+    def dense(x):
+        return (x * 2).sum()
+
+    def sharded(x):
+        return (x + 2).sum()
+
+    return dense, sharded, (jnp.ones(8),)

@@ -1,0 +1,10 @@
+"""RPA102 clean: the blessed lowering — a gather through a materialized
+index vector (one address lookup per element, fuses cheaply)."""
+
+import jax.numpy as jnp
+
+
+def exchange_leg(plane, shift):
+    n = plane.shape[0]
+    idx = jnp.mod(jnp.arange(n, dtype=jnp.int32) - shift, n)
+    return plane[idx]

@@ -1,0 +1,8 @@
+"""RPA102 trip: a traced-shift roll — slice-select chain on CPU,
+plane-sized all-gather under GSPMD."""
+
+import jax.numpy as jnp
+
+
+def exchange_leg(plane, shift):
+    return jnp.roll(plane, shift, axis=0)

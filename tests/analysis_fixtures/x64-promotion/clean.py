@@ -1,0 +1,13 @@
+"""RPA104 clean: stays in 32-bit — the stable-argsort restructure that
+replaces a packed 64-bit composite key."""
+
+import jax.numpy as jnp
+
+
+def first_occurrence_order(owner):
+    order = jnp.argsort(owner, axis=1)
+    return jnp.take_along_axis(owner, order, axis=1), order.astype(jnp.int32)
+
+
+def zeros32(n):
+    return jnp.zeros(n, dtype="float32")

@@ -1,0 +1,13 @@
+"""RPA104 trip: 64-bit dtype usage in device code — with x64 disabled,
+``jnp.int64`` silently computes in int32 (the ring_ops composite-sort
+overflow), and a dtype string asks for the same hazard."""
+
+import jax.numpy as jnp
+
+
+def composite_key(owner, pos, w):
+    return owner.astype(jnp.int64) * w + pos
+
+
+def zeros64(n):
+    return jnp.zeros(n, dtype="float64")

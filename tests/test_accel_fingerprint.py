@@ -85,19 +85,30 @@ def test_cache_write_lands_in_configured_dir_after_probe(tmp_path):
     d = accel.configure_compile_cache(str(tmp_path))
     assert d and d.startswith(str(tmp_path))
     # remove the 1 s write-threshold timing dependence: the assertion is
-    # about WHERE the entry lands, not how slow the compile was
+    # about WHERE the entry lands, not how slow the compile was.  MUST be
+    # restored afterwards: the zero threshold persists for the process,
+    # and with it every later tiny compile in the suite gets cached —
+    # whose keys ignore HLO metadata, so two programs differing only in
+    # named_scope/op_name alias to one executable text (this bit the
+    # jaxlint RPJ206 fixtures, whose trip/clean pair differs only in the
+    # scope name).
+    old_threshold = jax.config.jax_persistent_cache_min_compile_time_secs
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-
-    # a genuinely slow-to-compile program (the real engine step at a tiny
-    # scale compiles in seconds; toy matmul stacks dedup below the 1 s
-    # write threshold and prove nothing)
-    params = lifecycle.LifecycleParams(n=1500, k=32)
-    state = lifecycle.init_state(params, seed=3)
-    up = np.ones(1500, bool)
-    up[7] = False
-    faults = DeltaFaults(up=jnp.asarray(up))
-    step = jax.jit(lambda s: lifecycle.step(params, s, faults))
-    jax.block_until_ready(step(state).learned)
+    try:
+        # a genuinely slow-to-compile program (the real engine step at a
+        # tiny scale compiles in seconds; toy matmul stacks dedup below
+        # the 1 s write threshold and prove nothing)
+        params = lifecycle.LifecycleParams(n=1500, k=32)
+        state = lifecycle.init_state(params, seed=3)
+        up = np.ones(1500, bool)
+        up[7] = False
+        faults = DeltaFaults(up=jnp.asarray(up))
+        step = jax.jit(lambda s: lifecycle.step(params, s, faults))
+        jax.block_until_ready(step(state).learned)
+    finally:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", old_threshold
+        )
 
     assert glob.glob(d + "/*"), (
         "no cache entry in the configured dir — the compilation-cache "

@@ -1,0 +1,287 @@
+"""jaxlint (ISSUE 4) — fixture-corpus coverage for every rule, waiver
+semantics, and the repo-at-HEAD clean gate.
+
+Each rule has a minimal tripping snippet and a clean snippet under
+``tests/analysis_fixtures/<slug>/`` — the trip case MUST produce its
+rule's finding and the clean case must produce none (the fixture-dir
+scoping in ``astlint`` means only the directory's own rule applies, so a
+clean fixture asserts zero findings of ANY rule).  Plane-2 fixtures
+declare ``JAXLINT_TRACE_RULE`` + ``build()`` and run through
+``trace_checks.check_fixture`` — the same dispatch ``scripts/jaxlint.py``
+uses, so `make lint` pointed at a trip case provably exits non-zero.
+
+The repo-at-HEAD tests are the real gate: plane 1 over the default sweep
+and plane 2 over the five public entry points (dense + 8-way virtual
+mesh) must be clean modulo the justified waivers in
+``analysis/waivers.toml`` — tier-1 fails the moment an engine edit
+reintroduces a threefry bypass, a forbidden-phase collective, or a
+structural sharded/unsharded divergence.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ringpop_tpu.analysis import astlint, trace_checks, waivers
+from ringpop_tpu.analysis.findings import Finding
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIX = os.path.join(_REPO, "tests", "analysis_fixtures")
+_JAXLINT = os.path.join(_REPO, "scripts", "jaxlint.py")
+
+
+def _lint_fixture(slug: str, name: str):
+    rel = f"tests/analysis_fixtures/{slug}/{name}"
+    return astlint.lint_source(open(os.path.join(_REPO, rel)).read(), rel)
+
+
+def _load_fixture(slug: str, name: str):
+    path = os.path.join(_FIX, slug, name)
+    spec = importlib.util.spec_from_file_location(f"fx_{slug}_{name[:-3]}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_trace_fixture(rule: str, name: str):
+    mod = _load_fixture(trace_checks.TRACE_RULES[rule], name)
+    assert mod.JAXLINT_TRACE_RULE == rule, "fixture declares the wrong rule"
+    built = mod.build()
+    fn, args = built[:-1], built[-1]
+    if len(fn) == 1:
+        fn = fn[0]
+    return trace_checks.check_fixture(rule, fn, args)
+
+
+# -- plane 1: one trip + one clean snippet per AST rule ----------------------
+
+
+@pytest.mark.parametrize("rule", sorted(astlint.RULES))
+def test_ast_rule_trips_on_fixture(rule):
+    found = _lint_fixture(astlint.RULES[rule], "trip.py")
+    assert any(f.rule == rule for f in found), (
+        f"{rule} trip fixture produced no {rule} finding: "
+        f"{[f.render() for f in found]}"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(astlint.RULES))
+def test_ast_rule_clean_fixture_is_clean(rule):
+    found = _lint_fixture(astlint.RULES[rule], "clean.py")
+    assert not found, [f.render() for f in found]
+
+
+def test_host_sync_call_graph_closure():
+    """RPA103 must flag host syncs in functions only REACHABLE from a jit
+    root, not just directly decorated ones (the trip fixture's helper)."""
+    found = _lint_fixture("host-sync-in-jit", "trip.py")
+    scopes = {f.scope for f in found if f.rule == "RPA103"}
+    assert "helper" in scopes, scopes
+    assert "bad_norm" in scopes, scopes
+
+
+# -- plane 2: one trip + one clean program per trace rule --------------------
+
+
+@pytest.mark.parametrize("rule", sorted(trace_checks.TRACE_RULES))
+def test_trace_rule_trips_on_fixture(rule):
+    found = _run_trace_fixture(rule, "trip.py")
+    assert any(f.rule == rule for f in found), (
+        f"{rule} trip fixture produced no {rule} finding: "
+        f"{[f.render() for f in found]}"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(trace_checks.TRACE_RULES))
+def test_trace_rule_clean_fixture_is_clean(rule):
+    found = _run_trace_fixture(rule, "clean.py")
+    assert not found, [f.render() for f in found]
+
+
+# -- waiver semantics --------------------------------------------------------
+
+
+def test_waiver_requires_justification(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text('[[waiver]]\nrule = "RPA101"\npath = "x.py"\nscope = "*"\n')
+    with pytest.raises(waivers.WaiverError):
+        waivers.load_waivers(str(p))
+
+
+def test_waiver_rejects_unknown_syntax(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text("[[waiver]]\nrule = [1, 2]\n")
+    with pytest.raises(waivers.WaiverError):
+        waivers.load_waivers(str(p))
+
+
+def test_waiver_matching_and_unused_report(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text(
+        '[[waiver]]\nrule = "RPA101"\npath = "a.py"\nscope = "step"\n'
+        'justification = "reasoned"\n'
+        '[[waiver]]\nrule = "RPA102"\npath = "b.py"\nscope = "*"\n'
+        'justification = "never matches"\n'
+    )
+    wl = waivers.load_waivers(str(p))
+    fs = [
+        Finding("RPA101", "a.py", 3, "step", "m"),
+        Finding("RPA101", "a.py", 9, "step.<locals>.inner", "m"),
+        Finding("RPA101", "other.py", 3, "step", "m"),
+    ]
+    unused = waivers.apply_waivers(fs, wl)
+    assert fs[0].waived and fs[1].waived and not fs[2].waived
+    assert fs[0].justification == "reasoned"
+    assert [w["rule"] for w in unused] == ["RPA102"]
+
+
+def test_checked_in_waivers_all_load_and_none_unused():
+    """The committed waiver file parses, and every entry still matches a
+    real finding at HEAD (stale waivers must be deleted, not hoarded)."""
+    wl = waivers.load_waivers(
+        os.path.join(_REPO, "ringpop_tpu", "analysis", "waivers.toml")
+    )
+    assert wl, "committed waiver file disappeared or parses empty"
+    findings = astlint.lint_paths(list(_DEFAULT_PATHS), _REPO)
+    unused = waivers.apply_waivers(findings, wl)
+    assert not unused, [dict(w) for w in unused]
+
+
+# -- repo at HEAD is clean ---------------------------------------------------
+
+_DEFAULT_PATHS = ("ringpop_tpu", "scripts", "examples", "bench.py", "__graft_entry__.py")
+
+
+def test_repo_plane1_clean_at_head():
+    findings = astlint.lint_paths(list(_DEFAULT_PATHS), _REPO)
+    wl = waivers.load_waivers(
+        os.path.join(_REPO, "ringpop_tpu", "analysis", "waivers.toml")
+    )
+    waivers.apply_waivers(findings, wl)
+    unwaived = [f for f in findings if not f.waived]
+    assert not unwaived, "\n".join(f.render() for f in unwaived)
+
+
+def test_repo_plane2_jaxpr_clean_at_head():
+    """The five entry points, dense + sharded: no f64, no callbacks,
+    confinement holds, donation aliases, sharded == unsharded modulo
+    sharding ops — the acceptance bar of the jaxpr plane."""
+    found = trace_checks.run_trace_checks()
+    assert not found, "\n".join(f.render() for f in found)
+
+
+def test_repo_plane2_hlo_confinement_clean_at_head():
+    """Compiled sharded tick on the virtual mesh: no collective lands in
+    a forbidden phase (peer-choice zero, nothing unattributed)."""
+    found = trace_checks.run_hlo_checks()
+    assert not found, "\n".join(f.render() for f in found)
+
+
+def test_sharded_skeletons_are_nonvacuous():
+    """The RPJ205 equivalence must compare real programs (hundreds of
+    ops), and the comparator must actually see differences — guard
+    against an excision set that silently swallows everything."""
+    mesh = trace_checks._mesh8()
+    dense = trace_checks.build_entrypoints(mesh=None)
+    sharded = trace_checks.build_entrypoints(mesh=mesh)
+    skel = trace_checks.trace_skeleton(dense["lifecycle_step"])
+    assert len(skel) > 500, len(skel)
+    assert trace_checks.check_structural_equivalence(
+        "x", dense["lifecycle_step"], dense["delta_step"]
+    ), "comparator failed to distinguish two different engines"
+    colls = [
+        (e.primitive.name, s)
+        for e, s in trace_checks.iter_eqns(sharded["lifecycle_step"])
+        if e.primitive.name in trace_checks.COLLECTIVE_PRIMS
+    ]
+    assert colls, "sharded trace shows no explicit collectives — mesh lost?"
+    assert all("rumor-exchange" in s for _, s in colls), (
+        "exchange collectives escaped their scope"
+    )
+
+
+# -- the CLI (what `make lint` runs) -----------------------------------------
+
+
+def _run_cli(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, _JAXLINT, *argv],
+        capture_output=True, text=True, cwd=_REPO, timeout=timeout,
+    )
+
+
+def test_cli_trips_nonzero_on_ast_fixture():
+    r = _run_cli("tests/analysis_fixtures/traced-roll/trip.py", "--plane", "1")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "RPA102" in r.stdout
+
+
+def test_cli_clean_fixture_exits_zero():
+    r = _run_cli("tests/analysis_fixtures/traced-roll/clean.py", "--plane", "1")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_json_listing_plane1():
+    r = _run_cli("--plane", "1", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["unwaived_count"] == 0
+    assert doc["waived_count"] >= 1  # the fullview threefry waivers
+    assert doc["unused_waivers"] == []
+    assert all(f["justification"] for f in doc["findings"] if f["waived"])
+
+
+def test_cli_trips_nonzero_on_trace_fixture():
+    """A plane-2 trip case through the real CLI: the fixture marker routes
+    it to check_fixture and the process exits non-zero."""
+    r = _run_cli(
+        "tests/analysis_fixtures/donation-aliased/trip.py", timeout=300
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "RPJ204" in r.stdout
+
+
+# -- profile_mesh empty-dump hard failure (satellite) ------------------------
+
+
+def _profile_mesh_module():
+    spec = importlib.util.spec_from_file_location(
+        "profile_mesh", os.path.join(_REPO, "scripts", "profile_mesh.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_profile_mesh_dies_on_missing_module(tmp_path):
+    pm = _profile_mesh_module()
+    with pytest.raises(SystemExit) as ei:
+        pm._census_or_die(None, str(tmp_path), "step")
+    assert ei.value.code == 4
+
+
+def test_profile_mesh_dies_on_unparseable_dump(tmp_path):
+    pm = _profile_mesh_module()
+    bogus = tmp_path / "mod.after_optimizations.txt"
+    bogus.write_text("this is not an HLO module\nat all\n")
+    with pytest.raises(SystemExit) as ei:
+        pm._census_or_die(str(bogus), str(tmp_path), "step")
+    assert ei.value.code == 4
+
+
+def test_profile_mesh_dies_on_collective_free_census(tmp_path):
+    pm = _profile_mesh_module()
+    plain = tmp_path / "mod.after_optimizations.txt"
+    plain.write_text(
+        "HloModule jit_f\n\nENTRY %main (p: f32[4]) -> f32[4] {\n"
+        "  ROOT %add = f32[4] add(p, p)\n}\n"
+    )
+    with pytest.raises(SystemExit) as ei:
+        pm._census_or_die(str(plain), str(tmp_path), "step")
+    assert ei.value.code == 4
