@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -29,18 +29,28 @@ test: profile-mesh telemetry-smoke lint
 telemetry-smoke:
 	$(PY) scripts/telemetry_smoke.py
 
+# tiny churn+flap chaos scenario (sim/chaos.py): scorer output shape +
+# telemetry-on/off bit-identity under a time-varying FaultPlan + the
+# scored JSONL journal round-trip.
+chaos-smoke:
+	$(PY) scripts/chaos_smoke.py
+
 # compile the sharded programs at CI scale (8k, hierarchical select forced
 # on, the sharded-caller defaults rng=counter + shard-local exchange) and
 # diff the collective census against the committed budget capture — non-zero
 # exit if any collective class regressed beyond tolerance.  --phase-budget
 # additionally ratchets the exchange/peer-choice phase rows (r8), so a
-# regression there can't hide inside an unchanged global total.
+# regression there can't hide inside an unchanged global total.  --chaos
+# drives the profiled step with the canonical churn+flap+loss FaultPlan —
+# the chaos plane's zero-added-collectives claim is ratcheted against the
+# UNCHANGED static budget (verified identical at re-introduction: 147
+# collectives / 0.29 MB, collective-for-collective equal).
 # Re-baseline (after an INTENDED budget change, with PERF.md updated):
 #   $(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
 #     --force-sparse --out captures/mesh_profile_small_budget.json
 profile-mesh:
 	$(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
-	  --force-sparse --compare captures/mesh_profile_small_budget.json \
+	  --force-sparse --chaos --compare captures/mesh_profile_small_budget.json \
 	  --phase-budget --out /tmp/mesh_profile_small.json
 
 # skip the scale spot-checks

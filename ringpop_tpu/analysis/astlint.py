@@ -90,6 +90,7 @@ REQUIRED_SCOPED = {
         "view_checksums",
     ),
     "ringpop_tpu/sim/delta.py": ("step",),
+    "ringpop_tpu/sim/chaos.py": ("faults_at",),
     "ringpop_tpu/parallel/shift.py": ("shard_roll",),
     "ringpop_tpu/sim/packbits.py": ("_tree_reduce_rows", "set_bit", "set_bit_per_row"),
 }
@@ -128,10 +129,18 @@ def _fixture_slug(relpath: str) -> str | None:
     return None
 
 
+# fixture directories that exercise an existing rule under a scenario-
+# specific name (the <alias> dir is linted by the rule whose slug it maps
+# to): chaos-host-sync pins RPA103 catching a host-synced faults_at — the
+# chaos plane's one banned implementation shape (a concretized tick
+# turns the device-resident timeline into a per-tick host round-trip).
+FIXTURE_SLUG_ALIASES = {"chaos-host-sync": "host-sync-in-jit"}
+
+
 def _rule_applies(rule: str, relpath: str) -> bool:
     slug = _fixture_slug(relpath)
     if slug is not None:
-        return RULES[rule] == slug
+        return RULES[rule] == FIXTURE_SLUG_ALIASES.get(slug, slug)
     if rule == "RPA101":
         return relpath.startswith(SHARDED_CAPABLE)
     if rule == "RPA102":

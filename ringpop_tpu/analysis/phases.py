@@ -20,6 +20,7 @@ from __future__ import annotations
 # Outermost-first: a collective under "rumor-exchange/row-reduce" belongs
 # to the exchange phase.
 PHASES = (
+    "fault-plan",
     "tick-prologue",
     "ping-target",
     "rumor-exchange",
@@ -49,10 +50,13 @@ PHASE_BUDGET_PHASES = ("rumor-exchange", "ping-target", "peer-choice", "shard-ro
 # static extension of the r8 ratchet).  peer-choice: under rng="counter"
 # the [N, P] draw is elementwise in (node, column), so a collective here
 # means the partition-invariant RNG stopped being shard-local (the
-# ~12 MB/chip/tick threefry all-reduce coming back).  "(unattributed)" is
+# ~12 MB/chip/tick threefry all-reduce coming back).  fault-plan: the
+# chaos plane's ``faults_at`` timeline evaluation is elementwise in the
+# node lane by construction (sim/chaos.py) — a collective here means
+# fault evaluation stopped being shard-local.  "(unattributed)" is
 # forbidden too: a collective with no phase scope defeats the whole
 # attribution plane — extend the named_scope coverage instead.
-FORBIDDEN_COLLECTIVE_PHASES = ("peer-choice", "(unattributed)")
+FORBIDDEN_COLLECTIVE_PHASES = ("peer-choice", "fault-plan", "(unattributed)")
 
 
 def collective_phase_allowed(phase: str) -> bool:

@@ -2,8 +2,10 @@
 entry points.
 
 Plane 1 reads source; this plane reads the TRACED PROGRAM — the artifact
-the r6–r8 invariants are actually facts about.  Five entry points
-(lifecycle step, delta step, detect walk, shard_roll exchange, telemetry
+the r6–r8 invariants are actually facts about.  Seven entry points
+(lifecycle step, delta step, the chaos-enabled variants of both — the
+same engines driven by a time-varying ``chaos.FaultPlan`` with every
+scenario leg populated — detect walk, shard_roll exchange, telemetry
 fetch) are traced dense AND under the 8-way virtual mesh (4×2
 node × rumor — the ``profile_mesh`` topology), then checked:
 
@@ -393,8 +395,35 @@ def _faults(n):
     return DeltaFaults(up=jnp.asarray(up), drop_rate=0.05)
 
 
+def _chaos_plan(n):
+    """A FaultPlan exercising EVERY leg of the chaos vocabulary (churn +
+    flap + scalar drop from the canonical smoke plan, plus a directed
+    partition window and per-node loss) — the traced program whose
+    fault evaluation RPJ203/RPJ206 pin collective-free and whose
+    sharded/unsharded skeletons RPJ205 pins equal."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim import chaos
+
+    group = np.zeros(n, np.int32)
+    group[: n // 3] = 1
+    dn = np.zeros(n, np.float32)
+    dn[:: max(n // 16, 1)] = 0.2
+    return chaos._merge_plans(
+        chaos.scenario_plan("smoke", n, seed=0, horizon=64),
+        chaos.FaultPlan(
+            group=jnp.asarray(group),
+            part_from=jnp.asarray(np.int32(4)),
+            part_until=jnp.asarray(np.int32(32)),
+            reach=jnp.asarray(np.asarray([[True, False], [True, True]])),
+            drop_node=jnp.asarray(dn),
+        ),
+    )
+
+
 def build_entrypoints(mesh=None) -> dict:
-    """{name: ClosedJaxpr} for the five public jitted entry points, traced
+    """{name: ClosedJaxpr} for the seven public jitted entry points, traced
     dense (``mesh=None``) or with the shard-local exchange lowering
     (``mesh`` = the 4×2 virtual mesh).  rng="counter" — the sharded-caller
     default whose zero-collective peer choice the confinement rules pin."""
@@ -437,6 +466,18 @@ def build_entrypoints(mesh=None) -> dict:
         lambda t, s, f: telemetry.fetch(t, s, f)
     )(tel, lstate, lfaults)
 
+    # the chaos-enabled steps: the same engines driven by a time-varying
+    # FaultPlan with every leg populated — fault evaluation (the
+    # fault-plan phase) must stay collective-free (RPJ203/RPJ206) and the
+    # sharded/unsharded chaos traces structurally equal (RPJ205)
+    plan = _chaos_plan(_N)
+    out["lifecycle_step_chaos"] = jax.make_jaxpr(
+        lambda s, p: lifecycle.step(lparams, s, p)
+    )(lstate, plan)
+    out["delta_step_chaos"] = jax.make_jaxpr(
+        lambda s, p: delta.step(dparams, s, p)
+    )(dstate, plan)
+
     if mesh is not None:
         plane = jnp.zeros((_N, lifecycle.n_words(_K)), jnp.uint32)
         out["shard_roll"] = jax.make_jaxpr(
@@ -459,7 +500,13 @@ def run_trace_checks() -> list[Finding]:
             findings += check_no_64bit(tag, closed)
             findings += check_no_callbacks(tag, closed)
             findings += check_collective_confinement(tag, closed)
-    for name in ("lifecycle_step", "delta_step", "detect_walk"):
+    for name in (
+        "lifecycle_step",
+        "delta_step",
+        "detect_walk",
+        "lifecycle_step_chaos",
+        "delta_step_chaos",
+    ):
         findings += check_structural_equivalence(name, dense[name], sharded[name])
     findings += _donation_checks()
     return findings
@@ -500,10 +547,11 @@ def _donation_checks() -> list[Finding]:
 
 def run_hlo_checks() -> list[Finding]:
     """RPJ206: compile the sharded lifecycle tick (hierarchical select
-    forced, the sharded-caller defaults) on the virtual mesh and confine
-    its full collective census."""
-    import dataclasses
-
+    forced, the sharded-caller defaults) on the virtual mesh — once with
+    the static fault model and once chaos-enabled (the full FaultPlan) —
+    and confine each program's full collective census.  The chaos compile
+    is where a partitioner-introduced collective inside the fault-plan
+    phase would surface."""
     import jax
 
     from ringpop_tpu.sim import lifecycle
@@ -519,15 +567,21 @@ def run_hlo_checks() -> list[Finding]:
     )
     old_min_n = lifecycle._SPARSE_TOPK_MIN_N
     lifecycle._SPARSE_TOPK_MIN_N = 0
+    findings: list[Finding] = []
     try:
         blk = jax.jit(
             functools.partial(lifecycle._run_block, params), static_argnames="ticks"
         )
         with _no_compile_cache():
             text = blk.lower(state, _faults(_HLO_N), ticks=1).compile().as_text()
+            chaos_text = (
+                blk.lower(state, _chaos_plan(_HLO_N), ticks=1).compile().as_text()
+            )
     finally:
         lifecycle._SPARSE_TOPK_MIN_N = old_min_n
-    return check_hlo_confinement("lifecycle_step[hlo,sharded]", text)
+    findings += check_hlo_confinement("lifecycle_step[hlo,sharded]", text)
+    findings += check_hlo_confinement("lifecycle_step_chaos[hlo,sharded]", chaos_text)
+    return findings
 
 
 # -- fixture dispatch --------------------------------------------------------

@@ -30,6 +30,23 @@ Beyond the five BASELINE configs:
                      virtual device mesh, asserted bit-equal to the
                      unsharded step.
 
+Chaos-plane scenarios (``sim/chaos.py`` FaultPlans evaluated inside the
+jitted step; each emits a SCORED journal — the per-block telemetry
+records plus one ``kind: "score"`` verdict — and certifies
+sharded == unsharded state digests for its plan on the 4x2 virtual
+mesh):
+
+- ``churn100k``    — staggered crash/restart churn waves (a few nodes
+                     permanently down): time-to-detect per crash wave,
+                     rumor half-life, re-join convergence after the last
+                     restart.
+- ``flap1k``       — 1k nodes with ~1% flapping members under 2% loss:
+                     false-positive suspicion/refutation churn, scored.
+- ``asym_partition`` — a DIRECTED partition window (majority→minority
+                     blocked, minority→majority delivering): false
+                     accusations pile up and refute through the open
+                     direction, then the window heals.
+
 Scale auto-shrinks on CPU hosts (full sizes on an accelerator or with
 ``--full``).  Usage::
 
@@ -1118,6 +1135,153 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
     }
 
 
+# -- chaos-plane scenarios (sim/chaos.py) ------------------------------------
+
+
+def _chaos_sharded_twin(name: str, seed: int, n=4096, k=64, ticks=24, horizon=64) -> dict:
+    """Certify the scenario's FaultPlan partition-invariant: run the SAME
+    plan (same builder, ``chaos.scenario_plan``) unsharded and over the
+    4×2 virtual mesh in a child process (the 8-device CPU mesh needs
+    ``xla_force_host_platform_device_count`` before backend init) and
+    compare state digests + every leaf.  Small config on purpose — the
+    certificate is about the chaos-enabled program, which is
+    shape-uniform in n."""
+    import os
+    import subprocess
+    import sys
+
+    code = f"""
+import os, json, functools
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ringpop_tpu.util.accel import configure_compile_cache
+configure_compile_cache()
+import numpy as np
+from jax.sharding import Mesh
+from ringpop_tpu.sim import chaos, lifecycle, telemetry
+from ringpop_tpu.parallel.mesh import with_exchange_mesh
+
+n, k, ticks, seed = {n}, {k}, {ticks}, {seed}
+plan = chaos.scenario_plan({name!r}, n, seed=seed, horizon={horizon})
+params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=6, rng="counter")
+blk = jax.jit(functools.partial(lifecycle._run_block, params), static_argnames="ticks")
+ref = blk(lifecycle.init_state(params, seed=seed), plan, ticks=ticks)
+jax.block_until_ready(ref.learned)
+
+devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+mesh = Mesh(devs, ("node", "rumor"))
+sm_params = with_exchange_mesh(params, mesh)
+sm_blk = jax.jit(functools.partial(lifecycle._run_block, sm_params), static_argnames="ticks")
+sstate = jax.tree.map(jax.device_put, lifecycle.init_state(params, seed=seed),
+                      lifecycle.state_shardings(mesh, k=k))
+sout = sm_blk(sstate, plan, ticks=ticks)
+jax.block_until_ready(sout.learned)
+equal = all(bool((np.asarray(a) == np.asarray(b)).all())
+            for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(sout)))
+print(json.dumps(dict(
+    digest_unsharded=int(telemetry.tree_digest(ref)),
+    digest_sharded=int(telemetry.tree_digest(sout)),
+    equal=equal, n=n, k=k, ticks=ticks,
+    mesh="4x2 (node x rumor), virtual CPU devices",
+)))
+"""
+    env = dict(os.environ)
+    env.pop("BENCH_PIN", None)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=1200, env=env)
+    except subprocess.TimeoutExpired:
+        return {"equal": False, "error": "twin subprocess timed out"}
+    for ln in reversed(r.stdout.strip().splitlines()):
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return {"equal": False,
+            "error": f"twin child rc={r.returncode}: " + (r.stderr or "")[-300:]}
+
+
+def _run_chaos_scenario(scenario: str, plan_name: str, n: int, k: int,
+                        horizon: int, seed: int, suspect_ticks: int = 10,
+                        journal_every: int = 16) -> dict:
+    """Shared runner for the chaos scenarios: run the lifecycle engine
+    under the plan for ``horizon`` ticks with telemetry on (journaled to
+    the --telemetry file when given), score the journal
+    (``chaos.score_blocks``), append the verdict record, and attach the
+    sharded-twin digest certificate."""
+    import jax
+
+    from ringpop_tpu.sim import chaos, telemetry
+    from ringpop_tpu.sim.lifecycle import LifecycleSim
+
+    plan = chaos.scenario_plan(plan_name, n, seed=seed, horizon=horizon)
+    sink = _telemetry_sink(scenario, "lifecycle", {"n": n, "k": k, "seed": seed})
+    if sink is None:
+        sink = telemetry.TelemetrySink()  # records still needed for scoring
+    sim = LifecycleSim(n=n, k=k, seed=seed, suspect_ticks=suspect_ticks,
+                       rng="counter", telemetry=sink)
+    try:
+        sim.run(journal_every, plan)  # compile + first block
+        jax.block_until_ready(sim.state.learned)
+        t0 = time.perf_counter()
+        for _ in range(horizon // journal_every - 1):
+            sim.run(journal_every, plan)
+        jax.block_until_ready(sim.state.learned)
+        elapsed = time.perf_counter() - t0
+        score = chaos.score_blocks(sink.records, plan, n=n, scenario=scenario)
+        if sink.journal is not None:
+            sink.journal.score(score)
+    finally:
+        _close_sink(sink)
+    twin = _chaos_sharded_twin(plan_name, seed)
+    return {
+        "metric": f"chaos_{scenario}",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "n_nodes": n,
+        "n_rumor_slots": k,
+        "ticks": horizon,
+        "events": len(score["events"]),
+        "time_to_detect_median": score["time_to_detect_median"],
+        "rumor_half_life_median": score["rumor_half_life_median"],
+        "false_positive_suspects": score["false_positive_suspects"],
+        "rejoin_convergence_ticks": score["rejoin_convergence_ticks"],
+        "final_detect_frac": score["final_detect_frac"],
+        "sharded_digest_equal": twin.get("equal"),
+        "sharded_twin": twin,
+    }
+
+
+def bench_churn100k(seed: int, full: bool) -> dict:
+    """Crash/restart churn waves at scale: staggered crash cohorts (a few
+    permanently down), scored for time-to-detect per wave, rumor
+    half-life, and re-join convergence after the last restart."""
+    n = 100_000 if full else 8192
+    k = 256 if full else 64
+    return _run_chaos_scenario("churn100k", "churn", n, k, horizon=256, seed=seed)
+
+
+def bench_flap1k(seed: int, full: bool) -> dict:
+    """Flapping members under background loss: the false-positive
+    suspicion/refutation churn Lifeguard targets, scored."""
+    del full  # 1k nodes IS the scenario
+    return _run_chaos_scenario("flap1k", "flap", 1000, 64, horizon=256, seed=seed,
+                               suspect_ticks=8)
+
+
+def bench_asym_partition(seed: int, full: bool) -> dict:
+    """A DIRECTED partition window (majority→minority blocked,
+    minority→majority delivering) over a small permanent crash cohort:
+    false accusations pile up and refute through the open direction, the
+    crashes must be detected THROUGH the window, then it heals."""
+    n = 50_000 if full else 4096
+    return _run_chaos_scenario("asym_partition", "asym", n, 64, horizon=256,
+                               seed=seed)
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
@@ -1132,6 +1296,9 @@ BENCHES = {
     "partition_lc": bench_partition_lifecycle,
     "sharded100k": bench_sharded100k,
     "delta16m": bench_delta16m,
+    "churn100k": bench_churn100k,
+    "flap1k": bench_flap1k,
+    "asym_partition": bench_asym_partition,
 }
 
 

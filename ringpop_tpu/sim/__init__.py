@@ -32,15 +32,25 @@ protocol period at once:
   run journal.  Off by default and bit-transparent when on — see
   OBSERVABILITY.md.
 
-Fault injection is first-class: partition group arrays, per-edge drop
-probability, process-liveness masks — plain arrays applied to the message
-exchange step (BASELINE.json's 5% loss / 30% partition configs).
+* :mod:`ringpop_tpu.sim.chaos` — the chaos plane: declarative
+  time-varying fault scenarios (crash/restart churn, flapping members,
+  asymmetric partition split/heal windows, per-node loss / slow-node
+  timeout inflation) compiled into dense device arrays and evaluated
+  shard-locally inside the jitted step, plus the convergence scorer
+  that reduces a telemetry journal into scenario verdicts.
+
+Fault injection is first-class: partition group arrays (symmetric or
+directed via ``reach[G, G]``), scalar and per-node drop probabilities,
+process-liveness masks — plain traced arrays applied to the message
+exchange step (BASELINE.json's 5% loss / 30% partition configs), or a
+whole ``chaos.FaultPlan`` timeline in their place.
 """
 
 from ringpop_tpu.sim.fullview import FullViewSim, FullViewParams
 from ringpop_tpu.sim.delta import DeltaSim, DeltaParams
 from ringpop_tpu.sim.lifecycle import LifecycleSim, LifecycleParams
 from ringpop_tpu.sim.montecarlo import MonteCarlo, detection_latency_distribution
+from ringpop_tpu.sim.chaos import FaultPlan, faults_at, score_blocks
 
 __all__ = [
     "FullViewSim",
@@ -51,4 +61,7 @@ __all__ = [
     "LifecycleParams",
     "MonteCarlo",
     "detection_latency_distribution",
+    "FaultPlan",
+    "faults_at",
+    "score_blocks",
 ]

@@ -1,0 +1,546 @@
+"""Chaos plane: device-resident, time-varying fault scenarios with
+convergence scoring.
+
+The fault surface used to be one static triple frozen for a whole run
+(``DeltaFaults(up, group, drop_rate)``), so the suspect-timer and
+partition-healer machinery was only ever exercised against step-function
+partitions.  SWIM's original evaluation (Das et al.) and Lifeguard
+(Dadgar et al., PAPERS.md) are precisely about behavior under message
+loss, slow processors, and flapping members — regimes a static mask
+cannot express.
+
+This module is that missing plane, in three parts:
+
+1. **FaultPlan** — a declarative scenario timeline compiled (host-side,
+   once) into dense per-node device arrays: crash/restart churn windows,
+   flapping schedules, an asymmetric partition window with a directed
+   ``reach[G, G]`` matrix, scalar + per-node drop rates, and slow-node
+   probe-timeout inflation (folded into the per-node drop plane — an ack
+   that tends to arrive after the timeout IS a lost leg at that
+   probability).
+2. **faults_at(plan, tick)** — the pure shard-local evaluator: every
+   output leaf is an elementwise function of the plan's [N] arrays and
+   the replicated tick scalar, so under a device mesh fault evaluation
+   adds ZERO cross-chip collectives (the ``fault-plan`` named scope is
+   in ``analysis/phases.FORBIDDEN_COLLECTIVE_PHASES`` — jaxlint
+   RPJ203/RPJ206 forbid a collective there by construction).  Both
+   engines call it through ``delta.resolve_faults`` at the top of
+   ``step`` (and every convergence/telemetry query), so plans flow
+   through ``_run_block``/``run_until_*`` carries unchanged.  A CONSTANT
+   plan (only static legs) emits no ops at all — it traces to the exact
+   static-``DeltaFaults`` program, which is what keeps the frozen
+   goldens green without recapture (``constant_plan``,
+   tests/test_chaos.py).
+3. **score_blocks** — the convergence scorer: reduces an r7 telemetry
+   journal (the per-block counter records ``sim/telemetry.py`` emits)
+   plus the plan's event timeline into scenario verdicts — time-to-detect
+   per fault event, rumor half-life (the epidemic's half-coverage time),
+   false-positive suspect count (counted as refutations: only a LIVE
+   accused node ever reincarnates), and re-join convergence ticks after
+   the last restart.  Host-side numpy over host scalars; granularity is
+   the journal's block size, which the verdict records.
+
+Scenario vocabulary: ``scenario_plan(name, n, ...)`` builds the three
+canonical simbench scenarios (``churn``, ``flap``, ``asym``) plus the
+``smoke`` churn+flap used by ``make chaos-smoke`` and the profile-mesh
+chaos ratchet — one builder shared by the bench, its sharded-twin
+subprocess, and the tests, so the certified plan can't drift from the
+measured one.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim.delta import DeltaFaults
+
+# "this never happens" tick sentinel (same convention as the engines'
+# NO_DEADLINE): comparisons against it are always false for real ticks
+NO_TICK = np.int32(np.iinfo(np.int32).max)
+
+
+class FaultPlan(NamedTuple):
+    """A compiled scenario timeline.  Every leg is optional; ``None``
+    legs are static structure and compile out — a plan with only the
+    static legs (``base_up``/``group``/``reach``/drop) traces to the
+    exact static-``DeltaFaults`` program.
+
+    Liveness is the AND of three legs (a node is up iff no leg holds it
+    down):
+
+    * ``base_up`` — permanently-down overlay (the classic crash set);
+    * crash window — down during ``[crash_tick, restart_tick)``
+      (``NO_TICK`` restart = crashed forever);
+    * flapping — nodes with ``flap_period > 0`` are down for
+      ``flap_down`` ticks out of every ``flap_period``, offset by
+      ``flap_phase``.
+
+    The partition leg applies ``group`` (with the optional directed
+    ``reach`` matrix — see ``DeltaFaults``) only inside
+    ``[part_from, part_until)``; outside the window every node reports
+    group -1 (unpartitioned), so a split/heal is one plan, not a
+    host-side fault swap.  Loss legs (``drop_rate``/``drop_node``) are
+    time-invariant and pass through.
+
+    Ticks are in the engine clock: the plan is evaluated at
+    ``state.tick`` as the step ENTERS (tick t's exchange sees
+    ``faults_at(plan, t)``).
+    """
+
+    base_up: Optional[jax.Array] = None  # bool[N]
+    crash_tick: Optional[jax.Array] = None  # int32[N], NO_TICK = never
+    restart_tick: Optional[jax.Array] = None  # int32[N], NO_TICK = never
+    flap_period: Optional[jax.Array] = None  # int32[N], 0 = not flapping
+    flap_phase: Optional[jax.Array] = None  # int32[N]
+    flap_down: Optional[jax.Array] = None  # int32[N] down ticks per period
+    group: Optional[jax.Array] = None  # int32[N], -1 = unpartitioned
+    part_from: Optional[jax.Array] = None  # int32[] split tick (None = 0)
+    part_until: Optional[jax.Array] = None  # int32[] heal tick (None = never)
+    reach: Optional[jax.Array] = None  # bool[G, G] directed reachability
+    drop_rate: Optional[jax.Array] = None  # float32[] scalar loss
+    drop_node: Optional[jax.Array] = None  # float32[N] per-node loss
+
+    def at_tick(self, tick) -> DeltaFaults:
+        """The duck-typed seam ``delta.resolve_faults`` dispatches on."""
+        return faults_at(self, tick)
+
+
+def faults_at(plan: FaultPlan, tick) -> DeltaFaults:
+    """Evaluate the plan's timeline at ``tick`` → a concrete DeltaFaults.
+
+    Pure and shard-local by construction: the only array inputs are the
+    plan's [N] per-node legs (node-sharded like every other [N] vector)
+    and the replicated tick scalar, and every op is elementwise — the
+    SPMD partitioner keeps the whole evaluation on the shard that owns
+    each lane, with zero collectives under any mesh.  The ``fault-plan``
+    named scope makes that statically checkable (jaxlint RPJ203/RPJ206
+    forbid collectives in this phase)."""
+    with jax.named_scope("fault-plan"):
+        t = jnp.asarray(tick, jnp.int32)
+        up = plan.base_up
+        if plan.crash_tick is not None:
+            down = t >= plan.crash_tick
+            if plan.restart_tick is not None:
+                down &= t < plan.restart_tick
+            up = ~down if up is None else up & ~down
+        if plan.flap_period is not None:
+            if plan.flap_down is None:
+                raise ValueError("flap_period without flap_down: how long is a flap?")
+            period = jnp.maximum(plan.flap_period, 1)
+            phase = plan.flap_phase if plan.flap_phase is not None else jnp.int32(0)
+            pos = jnp.mod(t + phase, period)
+            flapped = (plan.flap_period > 0) & (pos < plan.flap_down)
+            up = ~flapped if up is None else up & ~flapped
+        group = plan.group
+        if group is not None and (
+            plan.part_from is not None or plan.part_until is not None
+        ):
+            in_part = jnp.bool_(True)
+            if plan.part_from is not None:
+                in_part &= t >= plan.part_from
+            if plan.part_until is not None:
+                in_part &= t < plan.part_until
+            group = jnp.where(in_part, group, jnp.int32(-1))
+        return DeltaFaults(
+            up=up,
+            group=group,
+            drop_rate=plan.drop_rate,
+            drop_node=plan.drop_node,
+            reach=plan.reach,
+        )
+
+
+def constant_plan(faults: DeltaFaults) -> FaultPlan:
+    """A FaultPlan encoding a static DeltaFaults: ``faults_at`` then
+    returns the same leaves with ZERO added ops, so trajectories — state
+    and telemetry — are bit-identical to running the DeltaFaults
+    directly (the constant-plan equivalence the goldens pin)."""
+    return FaultPlan(
+        base_up=faults.up,
+        group=faults.group,
+        reach=faults.reach,
+        drop_rate=faults.drop_rate,
+        drop_node=faults.drop_node,
+    )
+
+
+# -- scenario builders (host-side; dense device arrays out) -------------------
+
+
+def churn_plan(
+    n: int,
+    *,
+    n_churn: Optional[int] = None,
+    n_permanent: int = 0,
+    first: int = 8,
+    stagger: int = 8,
+    waves: int = 4,
+    down_ticks: int = 64,
+    seed: int = 0,
+) -> FaultPlan:
+    """Crash/restart churn: ``n_churn`` nodes (default ~1%) crash in
+    ``waves`` staggered waves starting at tick ``first``, each down for
+    ``down_ticks`` before restarting; the first ``n_permanent`` of them
+    never restart (the detection workload)."""
+    if n_churn is None:
+        n_churn = max(4, n // 100)
+    rng = np.random.default_rng(seed)
+    nodes = rng.choice(n, size=min(n_churn, n), replace=False)
+    crash = np.full(n, NO_TICK, np.int32)
+    restart = np.full(n, NO_TICK, np.int32)
+    for j, node in enumerate(nodes):
+        t = first + (j % waves) * stagger
+        crash[node] = t
+        if j >= n_permanent:
+            restart[node] = t + down_ticks
+    return FaultPlan(crash_tick=jnp.asarray(crash), restart_tick=jnp.asarray(restart))
+
+
+def flap_plan(
+    n: int,
+    *,
+    n_flap: Optional[int] = None,
+    period: int = 24,
+    down: int = 6,
+    start: int = 8,
+    seed: int = 0,
+) -> FaultPlan:
+    """Flapping members: ``n_flap`` nodes (default ~1%) cycle
+    ``down``-ticks-down out of every ``period``, phases staggered so the
+    flaps don't synchronize.  ``start`` delays the first down-phase so
+    the cluster boots clean."""
+    if n_flap is None:
+        n_flap = max(2, n // 100)
+    rng = np.random.default_rng(seed)
+    nodes = rng.choice(n, size=min(n_flap, n), replace=False)
+    fperiod = np.zeros(n, np.int32)
+    fphase = np.zeros(n, np.int32)
+    fdown = np.zeros(n, np.int32)
+    for j, node in enumerate(nodes):
+        fperiod[node] = period
+        # phase chosen so the node's first down window opens at
+        # start + j (staggered): down iff (t + phase) % period < down
+        fphase[node] = (-(start + j)) % period
+        fdown[node] = down
+    return FaultPlan(
+        flap_period=jnp.asarray(fperiod),
+        flap_phase=jnp.asarray(fphase),
+        flap_down=jnp.asarray(fdown),
+    )
+
+
+def asym_partition_plan(
+    n: int,
+    *,
+    minority: float = 0.3,
+    split_at: int = 8,
+    heal_at: int = 128,
+) -> FaultPlan:
+    """One-way partition window: the first ``minority`` fraction of nodes
+    becomes group 1 during ``[split_at, heal_at)``; the directed reach
+    matrix blocks majority→minority exchanges while minority→majority
+    still delivers.  The majority therefore piles up FALSE suspicions
+    about minority nodes; the minority keeps learning them off the
+    response legs of its own probes and refutes — the Lifeguard-class
+    regime the symmetric group model could not express."""
+    group = np.zeros(n, np.int32)
+    group[: int(minority * n)] = 1
+    # reach[a, b]: may group a send to group b?  majority(0) -> minority(1)
+    # blocked; everything else delivers.
+    reach = np.asarray([[True, False], [True, True]])
+    return FaultPlan(
+        group=jnp.asarray(group),
+        part_from=jnp.asarray(np.int32(split_at)),
+        part_until=jnp.asarray(np.int32(heal_at)),
+        reach=jnp.asarray(reach),
+    )
+
+
+def _merge_plans(*plans: FaultPlan) -> FaultPlan:
+    """Combine plans with disjoint legs (a leg set in two plans is a
+    scenario-construction error, not a merge)."""
+    merged = {}
+    for plan in plans:
+        for field, value in zip(plan._fields, plan):
+            if value is None:
+                continue
+            if merged.get(field) is not None:
+                raise ValueError(f"leg {field!r} set by more than one plan")
+            merged[field] = value
+    return FaultPlan(**merged)
+
+
+def scenario_plan(name: str, n: int, seed: int = 0, horizon: int = 256) -> FaultPlan:
+    """The canonical simbench/chaos-smoke scenario plans, parameterized
+    only by (name, n, seed, horizon) so the measuring bench, its
+    sharded-twin subprocess, and the tests all construct the identical
+    plan.  Schedules scale with ``horizon`` (the run's tick budget)."""
+    if name == "churn":
+        return churn_plan(
+            n,
+            n_churn=max(8, n // 100),
+            n_permanent=max(2, n // 400),
+            first=max(4, horizon // 32),
+            stagger=max(4, horizon // 32),
+            waves=4,
+            down_ticks=max(16, horizon // 4),
+            seed=seed,
+        )
+    if name == "flap":
+        return _merge_plans(
+            flap_plan(
+                n,
+                n_flap=max(4, n // 100),
+                period=max(12, horizon // 10),
+                down=max(3, horizon // 40),
+                start=max(4, horizon // 32),
+                seed=seed,
+            ),
+            # background loss keeps the indirect-probe machinery busy
+            FaultPlan(drop_rate=jnp.float32(0.02)),
+        )
+    if name == "asym":
+        # a small permanent crash cohort rides along so the scenario also
+        # measures time-to-detect THROUGH the one-way partition window
+        return _merge_plans(
+            asym_partition_plan(
+                n,
+                minority=0.3,
+                split_at=max(4, horizon // 32),
+                heal_at=horizon // 2,
+            ),
+            churn_plan(
+                n,
+                n_churn=max(2, n // 1000),
+                n_permanent=max(2, n // 1000),
+                first=2,
+                stagger=1,
+                waves=1,
+                seed=seed,
+            ),
+        )
+    if name == "smoke":
+        # tiny churn + flap + loss — every time-varying leg in one plan
+        # (the make chaos-smoke / profile-mesh chaos program)
+        return _merge_plans(
+            churn_plan(
+                n,
+                n_churn=max(4, n // 64),
+                n_permanent=2,
+                first=4,
+                stagger=4,
+                waves=2,
+                down_ticks=max(12, horizon // 4),
+                seed=seed,
+            ),
+            flap_plan(
+                n, n_flap=max(2, n // 64), period=12, down=3, start=6, seed=seed + 1
+            ),
+            FaultPlan(drop_rate=jnp.float32(0.02)),
+        )
+    raise ValueError(f"unknown chaos scenario {name!r}")
+
+
+# -- host-side timeline introspection ----------------------------------------
+
+
+def up_at_host(plan: FaultPlan, tick: int, n: int) -> np.ndarray:
+    """Host-numpy mirror of the liveness legs of :func:`faults_at` (the
+    scorer's ground truth for expected-alive counts)."""
+    up = np.ones(n, bool)
+    if plan.base_up is not None:
+        up &= np.asarray(plan.base_up)
+    if plan.crash_tick is not None:
+        down = tick >= np.asarray(plan.crash_tick)
+        if plan.restart_tick is not None:
+            down &= tick < np.asarray(plan.restart_tick)
+        up &= ~down
+    if plan.flap_period is not None:
+        period = np.maximum(np.asarray(plan.flap_period), 1)
+        phase = (
+            np.asarray(plan.flap_phase) if plan.flap_phase is not None else 0
+        )
+        pos = np.mod(tick + phase, period)
+        up &= ~((np.asarray(plan.flap_period) > 0) & (pos < np.asarray(plan.flap_down)))
+    return up
+
+
+def plan_events(plan: FaultPlan) -> list[dict]:
+    """The plan's discrete event timeline, host-side: one record per
+    distinct crash/restart tick (with the cohort size), the partition
+    split/heal ticks, and a summary record for the flapping population.
+    Sorted by tick; flap summaries (continuous, not discrete) sort by
+    their first down tick."""
+    events: list[dict] = []
+    if plan.crash_tick is not None:
+        crash = np.asarray(plan.crash_tick)
+        for t in np.unique(crash[crash != NO_TICK]):
+            events.append(
+                {"kind": "crash", "tick": int(t), "nodes": int((crash == t).sum())}
+            )
+    if plan.restart_tick is not None:
+        restart = np.asarray(plan.restart_tick)
+        for t in np.unique(restart[restart != NO_TICK]):
+            events.append(
+                {"kind": "restart", "tick": int(t), "nodes": int((restart == t).sum())}
+            )
+    if plan.group is not None:
+        split = int(np.asarray(plan.part_from)) if plan.part_from is not None else 0
+        events.append({"kind": "partition", "tick": split,
+                       "nodes": int((np.asarray(plan.group) > 0).sum()),
+                       "directed": plan.reach is not None})
+        if plan.part_until is not None:
+            events.append({"kind": "heal", "tick": int(np.asarray(plan.part_until))})
+    if plan.flap_period is not None:
+        period = np.asarray(plan.flap_period)
+        flappers = period > 0
+        if flappers.any():
+            phase = np.asarray(plan.flap_phase) if plan.flap_phase is not None else np.zeros_like(period)
+            first_down = np.where(
+                flappers, np.mod(-phase, np.maximum(period, 1)), np.int64(NO_TICK)
+            )
+            events.append({
+                "kind": "flap",
+                "tick": int(first_down[flappers].min()),
+                "nodes": int(flappers.sum()),
+                "period": int(period[flappers].max()),
+                "down": int(np.asarray(plan.flap_down)[flappers].max()),
+            })
+    events.sort(key=lambda e: e["tick"])
+    return events
+
+
+# -- the convergence scorer ---------------------------------------------------
+
+
+def _first_crossing(ticks, series, after: int, level: float):
+    """First journal tick >= ``after`` whose series value reaches
+    ``level`` — None if it never does (block-granular, like the journal)."""
+    for t, v in zip(ticks, series):
+        if t >= after and v >= level:
+            return int(t)
+    return None
+
+
+def score_blocks(
+    blocks: list[dict],
+    plan: FaultPlan,
+    *,
+    n: int,
+    scenario: str = "",
+) -> dict:
+    """Reduce a lifecycle run journal (the ``kind == "block"`` records of
+    ``sim/telemetry.py``, in order) plus the plan's event timeline into a
+    scenario verdict record.
+
+    Metrics (all in ticks, at the journal's block granularity —
+    ``block_granularity_ticks`` is recorded so a consumer can't mistake
+    a quantized number for an exact one):
+
+    * ``time_to_detect`` — per crash event, first journal tick at which
+      the converged base had absorbed the entire current down set
+      (``detect_frac`` == 1), minus the crash tick; null if never.
+    * ``rumor_half_life`` — per crash event, ticks to ``detect_frac``
+      0.5: the epidemic's half-coverage time (the dissemination analog
+      of a half-life; SWIM's infection model is exponential, so this is
+      the meaningful single-number rate).
+    * ``false_positive_suspects`` — refutations that placed, MINUS the
+      plan's restarted-node count: a refutation is a LIVE node
+      reincarnating over a detraction about itself (a true crash never
+      refutes), but a RESTARTED node re-joins through the same
+      mechanism — its one reincarnation was a true accusation outliving
+      its subject, so the plan-known restart count is subtracted.  A
+      flapper's post-flap refutations stay counted: flap-induced
+      suspicion churn is exactly the false-positive load Lifeguard
+      targets.  Raw total in ``refutations``.
+    * ``rejoin_convergence_ticks`` — after the LAST restart event, ticks
+      until the base census carries at least the expected end-state
+      alive count with no rumors left in flight; null when the plan has
+      no restarts or the run never got there.
+    """
+    blocks = [b for b in blocks if b.get("kind", "block") == "block"]
+    events = plan_events(plan)
+    ticks = [int(b["tick"]) for b in blocks]
+    detect = [float(b.get("detect_frac", 0.0)) for b in blocks]
+    granularity = max((int(b.get("ticks", 0)) for b in blocks), default=0)
+    total_ticks = ticks[-1] if ticks else 0
+
+    crashes = [e for e in events if e["kind"] == "crash"]
+    ttd, half = [], []
+    for e in crashes:
+        t_full = _first_crossing(ticks, detect, e["tick"], 1.0)
+        t_half = _first_crossing(ticks, detect, e["tick"], 0.5)
+        ttd.append([e["tick"], None if t_full is None else t_full - e["tick"]])
+        half.append([e["tick"], None if t_half is None else t_half - e["tick"]])
+
+    def _median(pairs):
+        vals = sorted(v for _, v in pairs if v is not None)
+        return vals[len(vals) // 2] if vals else None
+
+    restarts = [e for e in events if e["kind"] == "restart"]
+    restarted_nodes = sum(e["nodes"] for e in restarts)
+    refutations = int(sum(b.get("refuted", 0) for b in blocks))
+    rejoin = None
+    if restarts and blocks:
+        last_restart = max(e["tick"] for e in restarts)
+        expected_alive = int(up_at_host(plan, total_ticks, n).sum())
+        for b in blocks:
+            if (
+                int(b["tick"]) >= last_restart
+                and int(b.get("census_alive", -1)) >= expected_alive
+                and int(b.get("rumors_active", 1)) == 0
+            ):
+                rejoin = int(b["tick"]) - last_restart
+                break
+
+    return {
+        "kind": "score",
+        "scenario": scenario,
+        "n": n,
+        "ticks": total_ticks,
+        "blocks": len(blocks),
+        "block_granularity_ticks": granularity,
+        "events": events,
+        "time_to_detect": ttd,
+        "time_to_detect_median": _median(ttd),
+        "rumor_half_life": half,
+        "rumor_half_life_median": _median(half),
+        "refutations": refutations,
+        "false_positive_suspects": max(0, refutations - restarted_nodes),
+        "suspects_declared": int(sum(b.get("decl_suspect", 0) for b in blocks)),
+        "faulty_declared": int(sum(b.get("decl_faulty", 0) for b in blocks)),
+        "heal_attempts": int(sum(b.get("heal_attempts", 0) for b in blocks)),
+        "final_detect_frac": detect[-1] if detect else None,
+        "rejoin_convergence_ticks": rejoin,
+    }
+
+
+# -- stats bridge -------------------------------------------------------------
+
+CHAOS_STAT_PREFIX = "ringpop.sim.chaos"
+
+# score field -> (statsd method, key suffix) under the chaos namespace;
+# documented with the rest of the sim-plane keys in OBSERVABILITY.md
+CHAOS_STAT_KEYS = {
+    "time_to_detect_median": ("gauge", "time-to-detect"),
+    "rumor_half_life_median": ("gauge", "rumor.half-life"),
+    "false_positive_suspects": ("gauge", "false-positive.suspects"),
+    "rejoin_convergence_ticks": ("gauge", "rejoin.convergence"),
+    "final_detect_frac": ("gauge", "detection.fraction"),
+}
+
+
+def emit_score_stats(reporter, score: dict, prefix: str = CHAOS_STAT_PREFIX) -> None:
+    """Feed a scenario verdict into a host-plane ``StatsReporter`` under
+    ``ringpop.sim.chaos.*`` (null metrics — e.g. a plan with no restarts
+    has no rejoin — are skipped, not zeroed)."""
+    for field, (kind, suffix) in CHAOS_STAT_KEYS.items():
+        value = score.get(field)
+        if value is None:
+            continue
+        assert kind == "gauge"
+        reporter.gauge(f"{prefix}.{suffix}", float(value))

@@ -120,29 +120,101 @@ class DeltaParams:
 
 @dataclass(frozen=True)
 class DeltaFaults:
+    """The per-tick fault model both O(N·K) engines evaluate.
+
+    Every field is a pytree LEAF (the registration below carries no
+    aux_data), so sweeping any of them — including ``drop_rate``, which
+    used to ride static and forced a full recompile per distinct rate —
+    reuses one compilation.  ``None`` legs are static structure: a
+    fault-free ``DeltaFaults()`` traces to exactly the fault-free program.
+
+    * ``up`` — process liveness.
+    * ``group``/``reach`` — partition groups; without ``reach`` the
+      partition is symmetric (same group ⇔ connected).  ``reach[G, G]``
+      makes it DIRECTED: the (a → b) exchange is delivered iff
+      ``reach[group[a], group[b]]`` (the request direction names the RPC;
+      its response rides the same verdict).  Group -1 is always
+      unpartitioned, reach or not.
+    * ``drop_rate`` — scalar per-leg loss probability (traced).
+    * ``drop_node`` — float32[N] per-node loss: a leg survives with
+      probability ``(1-drop_rate)·(1-drop_node[a])·(1-drop_node[b])``
+      (independent loss processes compose by survival product).  This is
+      also how the chaos plane expresses slow-node probe-timeout
+      inflation: an ack that tends to arrive after the timeout is a lost
+      leg with that probability (``sim/chaos.py``).
+    """
+
     up: Optional[jax.Array] = None  # bool[N]
     group: Optional[jax.Array] = None  # int32[N], -1 = unpartitioned
-    drop_rate: float = 0.0
+    drop_rate: Optional[jax.Array] = None  # float32[] (traced; None = no loss)
+    drop_node: Optional[jax.Array] = None  # float32[N] per-node loss
+    reach: Optional[jax.Array] = None  # bool[G, G] directed group reachability
 
 
 jax.tree_util.register_pytree_node(
     DeltaFaults,
-    lambda f: ((f.up, f.group), f.drop_rate),
-    lambda aux, children: DeltaFaults(up=children[0], group=children[1], drop_rate=aux),
+    lambda f: ((f.up, f.group, f.drop_rate, f.drop_node, f.reach), None),
+    lambda aux, c: DeltaFaults(
+        up=c[0], group=c[1], drop_rate=c[2], drop_node=c[3], reach=c[4]
+    ),
 )
 
 
+def resolve_faults(faults, tick):
+    """The one seam that lets every engine/query accept EITHER a static
+    ``DeltaFaults`` or a time-varying ``chaos.FaultPlan``: a plan carries
+    an ``at_tick`` method (duck-typed to avoid a sim/chaos import cycle)
+    and is evaluated shard-locally at the given tick; a plain fault model
+    passes through untouched, so the static path traces to exactly the
+    program it always did."""
+    at = getattr(faults, "at_tick", None)
+    return faults if at is None else at(tick)
+
+
 def pair_connected(faults: DeltaFaults, a, b):
-    """Static (loss-free) connectivity between node index arrays ``a`` and
-    ``b`` under the fault model: both processes up and not separated by a
-    partition group."""
+    """Static (loss-free) connectivity for the (a → b) exchange between
+    node index arrays ``a`` and ``b`` under the fault model: both
+    processes up and the partition (symmetric groups, or the directed
+    ``reach`` matrix when present) lets a's group send to b's."""
     ok = jnp.ones(a.shape, dtype=bool)
     if faults.up is not None:
         ok &= faults.up[a] & faults.up[b]
     if faults.group is not None:
         g = faults.group
-        ok &= (g[a] < 0) | (g[b] < 0) | (g[a] == g[b])
+        ga, gb = g[a], g[b]
+        # getattr: fullview's own Faults class (symmetric-only oracle
+        # engine) routes through here too and carries no reach field
+        reach = getattr(faults, "reach", None)
+        if reach is not None:
+            # directed: group -1 stays universally reachable; in-range
+            # groups consult the tiny replicated [G, G] matrix
+            r = reach[jnp.maximum(ga, 0), jnp.maximum(gb, 0)]
+            ok &= (ga < 0) | (gb < 0) | r
+        else:
+            ok &= (ga < 0) | (gb < 0) | (ga == gb)
     return ok
+
+
+def has_drop(faults: DeltaFaults) -> bool:
+    """Static (trace-time) check: does this fault model lose messages at
+    all?  The gate every drop-coin draw sits behind — None legs compile
+    out entirely, keeping the loss-free trace the one HEAD had."""
+    return faults.drop_rate is not None or faults.drop_node is not None
+
+
+def leg_survives(faults: DeltaFaults, u, a, b):
+    """bool mask: the (a → b) message leg survives packet loss, given
+    uniform draws ``u`` (shaped like ``a``/``b``).  With only the scalar
+    ``drop_rate`` this is the exact historical comparison ``u >=
+    drop_rate`` (bit-compatible with the frozen goldens); per-node rates
+    compose as independent survival products."""
+    if faults.drop_node is None:
+        return u >= faults.drop_rate
+    dn = faults.drop_node
+    keep = (1.0 - dn[a]) * (1.0 - dn[b])
+    if faults.drop_rate is not None:
+        keep = keep * (1.0 - jnp.float32(faults.drop_rate))
+    return u < keep
 
 
 def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray] = None) -> DeltaState:
@@ -176,7 +248,13 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
     vocabulary as the lifecycle engine (``analysis/phases.PHASES``), so
     the collective census can attribute this engine's sharded traffic
     too; scopes are metadata-only and change no values (jaxlint RPA105
-    requires them)."""
+    requires them).
+
+    ``faults`` may be a static ``DeltaFaults`` or a time-varying
+    ``chaos.FaultPlan`` — a plan is evaluated shard-locally at
+    ``state.tick`` (``resolve_faults``); a constant plan traces to the
+    exact static program."""
+    faults = resolve_faults(faults, state.tick)
     with jax.named_scope("tick-prologue"):
         n, k = params.n, params.k
         max_p = jnp.int8(clamped_max_p(params))
@@ -219,17 +297,14 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
             targets = jnp.where(targets >= i_all, targets + 1, targets)
 
         up = faults.up if faults.up is not None else jnp.ones(n, dtype=bool)
-        conn = up & up[targets]
-        if faults.group is not None:
-            g = faults.group
-            conn &= (g < 0) | (g[targets] < 0) | (g == g[targets])
-        if faults.drop_rate > 0:
+        conn = pair_connected(faults, i_all, targets)
+        if has_drop(faults):
             drop_u = (
                 _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
                 if use_counter
                 else jax.random.uniform(k_drop, (n,))
             )
-            conn &= drop_u >= faults.drop_rate
+            conn &= leg_survives(faults, drop_u, i_all, targets)
 
     with jax.named_scope("rumor-exchange"):
         if shift_mode:
@@ -327,6 +402,7 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
 def converged_fraction(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Array:
     """Fraction of (live node, rumor) pairs delivered (popcount over the
     packed plane; tail bits are structurally zero so they never count)."""
+    faults = resolve_faults(faults, state.tick)
     k = state.pcount.shape[1]
     n = state.learned.shape[0]
     # float32-accumulated: a uint32 popcount sum wraps at n*k >= 2^32 bits
@@ -348,6 +424,7 @@ def converged(state: DeltaState, faults: DeltaFaults = DeltaFaults()) -> jax.Arr
     """bool scalar, on-device: have all rumors reached every live node?
     (Dead rows are vacuously done — a fused masked reduce, no dynamic
     shapes, so it can sit inside a jitted loop.)"""
+    faults = resolve_faults(faults, state.tick)
     k = state.pcount.shape[1]
     plane = (
         state.learned

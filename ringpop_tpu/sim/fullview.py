@@ -416,8 +416,40 @@ def step(
     return state._replace(tick=state.tick + 1, key=key)
 
 
+def as_fullview_faults(faults) -> Faults:
+    """Coerce a shared-harness ``delta.DeltaFaults`` (whose ``drop_rate``
+    is a traced-leaf Optional since the chaos plane) into this engine's
+    own ``Faults``, where the rate stays STATIC aux data — the oracle
+    engine keeps its retrace-per-rate design.  Host-side only: the rate
+    must be a concrete (hashable) number here, not a traced array."""
+    if isinstance(faults, Faults):
+        return faults
+    if hasattr(faults, "at_tick"):
+        raise TypeError(
+            "the fullview oracle takes a static fault model, not a "
+            "time-varying chaos.FaultPlan — evaluate it yourself at a "
+            "fixed tick (chaos.faults_at(plan, t)) if that is what you "
+            "mean"
+        )
+    if getattr(faults, "reach", None) is not None or getattr(faults, "drop_node", None) is not None:
+        # refusing beats silently simulating a DIFFERENT fault model: the
+        # oracle's connectivity is symmetric-group + scalar loss only
+        raise ValueError(
+            "fullview cannot express directed reach / per-node drop — "
+            "those legs exist only in the delta/lifecycle engines"
+        )
+    rate = getattr(faults, "drop_rate", None)
+    return Faults(
+        up=faults.up,
+        group=faults.group,
+        drop_rate=0.0 if rate is None else rate,
+    )
+
+
 class FullViewSim:
-    """Convenience wrapper: init + jitted multi-tick runs."""
+    """Convenience wrapper: init + jitted multi-tick runs.  Accepts this
+    engine's ``Faults`` or a shared-harness ``delta.DeltaFaults``
+    (coerced via :func:`as_fullview_faults`)."""
 
     def __init__(self, n: int, seed: int = 0, converged: bool = True, **kw):
         self.params = FullViewParams(n=n, **kw)
@@ -427,7 +459,7 @@ class FullViewSim:
         )
 
     def tick(self, faults: Faults = Faults(), targets=None, peers=None) -> FullViewState:
-        self.state = self._step(self.state, faults, targets, peers)
+        self.state = self._step(self.state, as_fullview_faults(faults), targets, peers)
         return self.state
 
     def run(self, ticks: int, faults: Faults = Faults()) -> FullViewState:

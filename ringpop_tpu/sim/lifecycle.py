@@ -67,7 +67,10 @@ import numpy as np
 from ringpop_tpu.sim.delta import (
     DeltaFaults,
     clamped_max_p,
+    has_drop as _has_drop,
+    leg_survives as _leg_survives,
     pair_connected as _pair_connected,
+    resolve_faults as _resolve_faults,
     resolve_max_p,
     until_loop,
 )
@@ -401,7 +404,13 @@ def step(
     telemetry-free one.  The ``jax.named_scope`` sections name the
     protocol phase in profiler traces and HLO metadata, which is what
     lets ``scripts/profile_mesh.py`` attribute each censused collective
-    to a phase; scopes are metadata-only and change no values."""
+    to a phase; scopes are metadata-only and change no values.
+
+    ``faults`` may be a static ``DeltaFaults`` or a time-varying
+    ``chaos.FaultPlan`` — a plan is evaluated shard-locally at
+    ``state.tick`` (``delta.resolve_faults``, under the ``fault-plan``
+    scope); a constant plan traces to the exact static program."""
+    faults = _resolve_faults(faults, state.tick)
     with jax.named_scope("tick-prologue"):
         n, k = params.n, params.k
         m = min(params.alloc_per_tick, params.k, params.n)
@@ -484,13 +493,13 @@ def step(
 
     with jax.named_scope("rumor-exchange"):
         conn = _pair_connected(faults, i_all, targets)
-        if faults.drop_rate > 0:
+        if _has_drop(faults):
             drop_u = (
                 _prng.draw_uniform(cseed, ctick, _prng.D_DROP, i_all)
                 if use_counter
                 else jax.random.uniform(k_drop, (n,))
             )
-            conn &= drop_u >= faults.drop_rate
+            conn &= _leg_survives(faults, drop_u, i_all, targets)
         delivered = conn & wants
 
         # -- piggyback exchange: request leg + response leg ---------------------
@@ -788,7 +797,7 @@ def step(
             peer_choices = _prng.draw_randint(
                 cseed, ctick, _prng.D_PEER + pcols, i_all[:, None], 0, n
             )
-            if faults.drop_rate > 0:
+            if _has_drop(faults):
                 pd_req_u = _prng.draw_uniform(
                     cseed, ctick, _prng.D_PEER_DROP_REQ + pcols, i_all[:, None]
                 )
@@ -800,7 +809,7 @@ def step(
             peer_choices = jax.random.randint(
                 k_peers, (n, params.ping_req_size), 0, n, dtype=jnp.int32
             )
-            if faults.drop_rate > 0:
+            if _has_drop(faults):
                 pd_req_u = jax.random.uniform(k_pd1, peer_choices.shape)
                 pd_ack_u = jax.random.uniform(k_pd2, peer_choices.shape)
 
@@ -836,15 +845,18 @@ def step(
             & (peer_choices != i_bcast)
             & (peer_choices != targets[:, None])
         )
+        targets_b = jnp.broadcast_to(targets[:, None], peer_choices.shape)
         peer_reaches = (
             peer_ok
-            & _pair_connected(faults, peer_choices, jnp.broadcast_to(targets[:, None], peer_choices.shape))
+            & _pair_connected(faults, peer_choices, targets_b)
             & up[targets][:, None]
         )
         # each indirect leg is its own RPC and suffers packet loss too
-        if faults.drop_rate > 0:
-            peer_ok &= pd_req_u >= faults.drop_rate
-            peer_reaches &= peer_ok & (pd_ack_u >= faults.drop_rate)
+        if _has_drop(faults):
+            peer_ok &= _leg_survives(faults, pd_req_u, i_bcast, peer_choices)
+            peer_reaches &= peer_ok & _leg_survives(
+                faults, pd_ack_u, peer_choices, targets_b
+            )
         reached = peer_reaches.any(axis=1)
         inconclusive = (~peer_ok).all(axis=1)
         declare = probing & ~reached & ~inconclusive
@@ -1125,6 +1137,7 @@ def detection_fraction(
     per-observer first-learned-wins semantics from [N]-column ops (a 1M x
     128 x 1000 query goes from ~500 GB of intermediates to ~2k column
     reductions)."""
+    faults = _resolve_faults(faults, state.tick)
     if state.learned.shape[0] * state.r_subject.shape[0] * len(subjects) > 2**28:
         return _detection_fraction_large(state, subjects, faults, min_status)
     subjects = jnp.asarray(subjects, jnp.int32)
@@ -1222,6 +1235,7 @@ def detection_complete(
     iteration (see :func:`_walk_subject_slots`).  Purely a layout hint;
     values are bit-identical with or without it.
     """
+    faults = _resolve_faults(faults, state.tick)
     with jax.named_scope("detect-walk"):
         n, _ = state.learned.shape
         subjects = jnp.asarray(subjects, jnp.int32)
@@ -1396,6 +1410,7 @@ def checksums_converged(
     The reference's convergence criterion for protocol tests
     (``swim/test_utils.go:164-199`` ticks until no changes remain and all
     checksums agree)."""
+    faults = _resolve_faults(faults, state.tick)
     cs = view_checksums(state, faults)
     up = faults.up if faults.up is not None else jnp.ones(cs.shape[0], bool)
     first_live = jnp.argmax(up)

@@ -60,7 +60,9 @@ def _faults_axes(faults: DeltaFaults):
     def ax(x):
         return 0 if x is not None and getattr(x, "ndim", 1) == 2 else None
 
-    axes = DeltaFaults(up=ax(faults.up), group=ax(faults.group), drop_rate=faults.drop_rate)
+    # scalar legs (drop_rate) and per-node legs without a replica axis
+    # broadcast (axis None); only 2-D up/group masks map over replicas
+    axes = DeltaFaults(up=ax(faults.up), group=ax(faults.group))
     return None if (axes.up is None and axes.group is None) else axes
 
 

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.sim.delta import DeltaFaults, converged_fraction
+from ringpop_tpu.sim.delta import DeltaFaults, converged_fraction, resolve_faults
 from ringpop_tpu.sim.packbits import mix32, n_words
 from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
 
@@ -182,8 +182,15 @@ def _census(state, faults: DeltaFaults):
     if faults.up is not None:
         down = ~faults.up
         detected = down & (~present | (status >= FAULTY))
-        out["detect_frac"] = detected.sum(dtype=jnp.float32) / jnp.maximum(
-            down.sum(dtype=jnp.float32), 1.0
+        down_total = down.sum(dtype=jnp.float32)
+        # empty down set reports the vacuous 1.0, matching the up-is-None
+        # branch — a time-varying FaultPlan reaches this state routinely
+        # (every crashed node restarted), and 0/1 = 0.0 would read as
+        # "nothing detected" for a fully recovered cluster
+        out["detect_frac"] = jnp.where(
+            down_total > 0,
+            detected.sum(dtype=jnp.float32) / jnp.maximum(down_total, 1.0),
+            jnp.float32(1.0),
         )
     else:
         out["detect_frac"] = jnp.float32(1.0)
@@ -199,7 +206,10 @@ def fetch(
     device scalars (one ``jax.device_get`` fetches the whole block).
     This is where the cross-shard psums happen: one reduction per counter
     per fetched block, none per tick.  Jit-safe; ``LifecycleSim`` wraps
-    it in a cached jit."""
+    it in a cached jit.  A time-varying ``chaos.FaultPlan`` is resolved
+    at the state's tick, so the census/detect_frac gauges describe the
+    fault model in force at fetch time."""
+    faults = resolve_faults(faults, state.tick)
     f32 = jnp.float32
     record = {
         "ticks": tel.ticks,
@@ -337,6 +347,11 @@ class TelemetryJournal:
 
     def block(self, record: dict, **extra) -> None:
         self._write({"kind": "block", **_to_host({**record, **extra})})
+
+    def score(self, record: dict) -> None:
+        """Append a chaos-scenario verdict (``chaos.score_blocks``) —
+        the record that makes a journal a SCORED journal."""
+        self._write({**_to_host(record), "kind": "score"})
 
     def _write(self, obj: dict) -> None:
         self._f.write(json.dumps(obj, sort_keys=True) + "\n")

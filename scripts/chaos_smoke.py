@@ -1,0 +1,131 @@
+"""chaos-smoke — the CI gate for the chaos plane (sim/chaos.py).
+
+Runs the canonical tiny churn+flap+loss scenario (``chaos.scenario_plan
+("smoke")``) through the lifecycle engine and asserts:
+
+1. **telemetry-on/off bit-identity under a time-varying plan**: the
+   telemetry-carrying run ends digest-equal (and leaf-by-leaf bit-equal)
+   to a telemetry-off run of the same plan — the r7 transparency
+   property extended to the chaos plane;
+2. **scorer output shape**: ``chaos.score_blocks`` over the run's journal
+   produces the full verdict schema (events, per-event time-to-detect /
+   half-life, false-positive count, re-join convergence) with sane
+   values for this scenario (crash events exist, the permanent victims
+   were detected, the flappers produced refutations);
+3. **the scored journal round-trips**: the JSONL stream carries header +
+   blocks + one ``kind: "score"`` record that parses back equal.
+
+Exit 0 on success, 1 with a diagnosis on any failure.  Wall cost is a
+few seconds (n=256) — wired into `make test` next to telemetry-smoke.
+
+Usage:
+    python scripts/chaos_smoke.py [--out /tmp/chaos_smoke.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="journal path (default: temp file)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.sim import chaos, lifecycle, telemetry
+
+    path = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="chaossmoke_"), "chaos_smoke.jsonl"
+    )
+    n, k, seed, horizon, block = 256, 64, 0, 128, 16
+    plan = chaos.scenario_plan("smoke", n, seed=seed, horizon=horizon)
+    failures: list[str] = []
+
+    def run(sink):
+        sim = lifecycle.LifecycleSim(
+            n=n, k=k, seed=seed, suspect_ticks=8, rng="counter", telemetry=sink
+        )
+        for _ in range(horizon // block):
+            sim.run(block, plan)
+        return sim.state
+
+    with telemetry.TelemetryJournal(path) as journal:
+        journal.header("lifecycle", "chaos-smoke", {"n": n, "k": k, "seed": seed})
+        sink = telemetry.TelemetrySink(journal=journal)
+        s_on = run(sink)
+        score = chaos.score_blocks(sink.records, plan, n=n, scenario="chaos-smoke")
+        journal.score(score)
+    s_off = run(None)
+
+    # 1: bit-identity under the time-varying plan
+    d_on, d_off = int(telemetry.tree_digest(s_on)), int(telemetry.tree_digest(s_off))
+    if d_on != d_off:
+        failures.append(
+            f"digest mismatch under FaultPlan: telemetry-on {d_on:#010x} vs off {d_off:#010x}"
+        )
+    for name, a, b in zip(s_on._fields, jax.tree.leaves(s_on), jax.tree.leaves(s_off)):
+        if not bool((np.asarray(a) == np.asarray(b)).all()):
+            failures.append(f"state leaf {name} diverged between telemetry on/off")
+
+    # 2: scorer shape + scenario sanity
+    want = {
+        "kind", "scenario", "n", "ticks", "blocks", "block_granularity_ticks",
+        "events", "time_to_detect", "time_to_detect_median", "rumor_half_life",
+        "rumor_half_life_median", "refutations", "false_positive_suspects",
+        "suspects_declared",
+        "faulty_declared", "heal_attempts", "final_detect_frac",
+        "rejoin_convergence_ticks",
+    }
+    missing = want - set(score)
+    if missing:
+        failures.append(f"score record missing fields: {sorted(missing)}")
+    kinds = {e["kind"] for e in score.get("events", ())}
+    if not {"crash", "restart", "flap"} <= kinds:
+        failures.append(f"smoke plan events incomplete: {sorted(kinds)}")
+    if not score.get("time_to_detect"):
+        failures.append("no time-to-detect entries for the crash events")
+    if score.get("suspects_declared", 0) <= 0:
+        failures.append("scenario declared no suspects — the plan never bit")
+
+    # 3: the scored journal round-trips
+    try:
+        records = telemetry.read_journal(path)
+    except Exception as e:  # noqa: BLE001 — the diagnosis IS the product
+        records = []
+        failures.append(f"journal unparseable: {type(e).__name__}: {e}")
+    scores = [r for r in records if r.get("kind") == "score"]
+    blocks = [r for r in records if r.get("kind") == "block"]
+    if len(scores) != 1:
+        failures.append(f"expected exactly one score record, found {len(scores)}")
+    elif scores[0].get("false_positive_suspects") != score["false_positive_suspects"]:
+        failures.append("journaled score differs from the computed one")
+    if sum(b.get("ticks", 0) for b in blocks) != horizon:
+        failures.append("journal blocks do not cover the run")
+
+    if failures:
+        print("chaos-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(
+        f"chaos-smoke: OK — {len(blocks)} blocks + 1 score journaled at {path}; "
+        f"ttd_median={score['time_to_detect_median']} "
+        f"fp_suspects={score['false_positive_suspects']} "
+        f"rejoin={score['rejoin_convergence_ticks']}; "
+        f"telemetry-on digest-equal to off ({d_on:#010x})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,14 @@ def main() -> None:
         "partitioner-inferred all-gathers",
     )
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="drive the profiled step with the canonical churn+flap+loss "
+        "FaultPlan (chaos.scenario_plan('smoke')) instead of the static "
+        "fault mask — fault-timeline evaluation is elementwise, so the "
+        "census must land within the SAME committed budget (the chaos "
+        "plane's zero-added-collectives ratchet)",
+    )
+    ap.add_argument(
         "--phase-budget", action="store_true",
         help="with --compare: also ratchet the per-phase table for "
         f"{PHASE_BUDGET_PHASES} (fails on per-phase regressions that an "
@@ -193,6 +201,7 @@ def _run(args, dump: str) -> int:
         "mesh": "4x2 (node x rumor), virtual CPU devices",
         "rng": args.rng,
         "exchange_lowering": args.exchange,
+        "chaos": bool(args.chaos),
     }
     engine_kw = dict(rng=args.rng)
     if args.exchange == "shardmap":
@@ -204,6 +213,18 @@ def _run(args, dump: str) -> int:
     up = np.ones(n, bool)
     up[:: max(n // 1000, 1)] = False
     faults = DeltaFaults(up=jnp.asarray(up))
+    if args.chaos:
+        # the chaos-enabled step: same static crash overlay PLUS the
+        # canonical churn+flap+loss timeline.  faults_at is elementwise
+        # in the node lane, so the census must fit the SAME budget the
+        # static program is ratcheted against — deliberately compared
+        # against the unchanged committed capture.
+        from ringpop_tpu.sim import chaos
+
+        faults = chaos._merge_plans(
+            chaos.scenario_plan("smoke", n, seed=0, horizon=64),
+            chaos.FaultPlan(base_up=jnp.asarray(up)),
+        )
     state = jax.tree.map(
         jax.device_put, lifecycle.init_state(params, seed=0),
         lifecycle.state_shardings(mesh, k=k),

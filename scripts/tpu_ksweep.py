@@ -14,6 +14,10 @@ every section so a mid-run tunnel death still leaves partial evidence):
    synced reps.  This single-sources the "ms/tick at 1M" number that
    round 3's artifacts disagreed about (0.57 s/64 ticks vs a 142 ms/tick
    trace reading — see PERF.md round-4 reconciliation).
+1d. **chaos_tick** — the churn+flap-enabled tick (``sim/chaos.py``
+   FaultPlan evaluated inside the jitted step) vs the plain tick, at the
+   same config; sharded over the visible chips when >1 (the number that
+   certifies the chaos plane's claimed ~zero overhead on real ICI).
 2. Headline detection at the official config (k=256, 1000 victims),
    fresh state, wall + ticks; cross-checked against the cost model.
 3. Convergence (view-checksum agreement + quiescence) continuing from
@@ -282,6 +286,76 @@ def main() -> None:
                 "error"
             ] = f"{type(e).__name__}: {e}"[:300]
         flush()
+
+    # -- 1d: chaos_tick — the churn+flap-enabled tick vs the plain tick ----
+    # (sim/chaos.py FaultPlan evaluated inside the jitted step).  The CPU
+    # census says fault-timeline evaluation adds zero collectives and the
+    # elementwise legs are noise against the packed-plane passes; this
+    # section is what lets certify_cost_model judge that claim on real
+    # hardware.  Sharded over every visible chip when the window exposes
+    # >1 device (mirroring 1b), dense otherwise — both labeled.
+    try:
+        import functools as _ft
+
+        from ringpop_tpu.sim import chaos
+
+        k = 256
+        plan = chaos.scenario_plan("smoke", n, seed=0, horizon=4 * block)
+        base_p = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10, rng="counter")
+        sharded = len(jax.devices()) > 1 and out["platform"] != "cpu"
+        sec = {"n": n, "k": k, "block_ticks": block, "sharded": sharded}
+        out["chaos_tick"] = sec
+        if sharded:
+            from jax.sharding import Mesh
+
+            from ringpop_tpu.parallel.mesh import with_exchange_mesh
+
+            n_dev = len(jax.devices())
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            base_p = with_exchange_mesh(base_p, mesh)
+            sec["n_devices"] = n_dev
+            sec["mesh"] = f"{n_dev // rumor}x{rumor} (node x rumor)"
+
+            def mk_state():
+                return jax.tree.map(
+                    jax.device_put,
+                    lifecycle.init_state(base_p, seed=0),
+                    lifecycle.state_shardings(mesh, k=k),
+                )
+        else:
+            def mk_state():
+                return lifecycle.init_state(base_p, seed=0)
+
+        blk_fn = jax.jit(
+            _ft.partial(lifecycle._run_block, base_p), static_argnames="ticks"
+        )
+        for label, f in (("plain", faults), ("chaos", plan)):
+            sstate = mk_state()
+            sstate = blk_fn(sstate, f, ticks=block)
+            jax.block_until_ready(sstate.learned)  # compile + warm
+            per_rep = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sstate = blk_fn(sstate, f, ticks=block)
+                jax.block_until_ready(sstate.learned)
+                per_rep.append(time.perf_counter() - t0)
+            sec[f"{label}_ms_per_tick_median"] = round(
+                sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+            )
+            flush()
+        if sec.get("plain_ms_per_tick_median"):
+            sec["overhead_pct"] = round(
+                (sec["chaos_ms_per_tick_median"] / sec["plain_ms_per_tick_median"] - 1)
+                * 100.0,
+                1,
+            )
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        out.setdefault("chaos_tick", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
 
     # -- 2+3: headline detection then convergence at the official config ----
     try:

@@ -1,0 +1,13 @@
+"""RPA103 clean (chaos-plane shape): ``faults_at`` stays a pure
+elementwise function of device arrays and the traced tick scalar — the
+real implementation's shape (``sim/chaos.py``)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def faults_at(crash_tick, restart_tick, tick):
+    t = jnp.asarray(tick, jnp.int32)
+    down = (t >= crash_tick) & (t < restart_tick)
+    return ~down

@@ -1,0 +1,15 @@
+"""RPA103 trip (chaos-plane shape): a ``faults_at`` that concretizes the
+traced tick — ``int(tick)`` and a host-numpy coercion of the crash
+schedule — turning the device-resident timeline into a per-tick
+device→host round-trip (or a trace-time error).  The chaos plane's one
+banned implementation shape."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def faults_at(crash_tick, tick):
+    t = int(tick)  # concretizes the traced tick
+    down = np.asarray(crash_tick) <= t  # host-materializes the schedule
+    return down

@@ -10,9 +10,27 @@ import numpy as np
 from ringpop_tpu.sim.delta import DeltaFaults
 
 
-def make_faults(n, down=(), group=None, drop=0.0):
+def make_faults(n, down=(), group=None, drop=0.0, reach=None, drop_node=None):
+    """Build a DeltaFaults for tests/captures.  ``drop`` of 0/0.0 maps to
+    the static ``None`` fast path so the loss-free goldens keep tracing
+    the exact no-drop program; any truthy rate rides as a traced leaf.
+    ``reach`` is the directed [G, G] group-reachability matrix; in
+    ``drop_node`` (per-node loss, float[N]) a dict maps node -> rate."""
     up = np.ones(n, bool)
     for i in down:
         up[i] = False
     g = None if group is None else jnp.asarray(np.asarray(group, np.int32))
-    return DeltaFaults(up=jnp.asarray(up), group=g, drop_rate=drop)
+    r = None if reach is None else jnp.asarray(np.asarray(reach, bool))
+    if isinstance(drop_node, dict):
+        dn_np = np.zeros(n, np.float32)
+        for i, rate in drop_node.items():
+            dn_np[i] = rate
+        drop_node = dn_np
+    dn = None if drop_node is None else jnp.asarray(np.asarray(drop_node, np.float32))
+    return DeltaFaults(
+        up=jnp.asarray(up),
+        group=g,
+        drop_rate=(None if not drop else drop),
+        drop_node=dn,
+        reach=r,
+    )

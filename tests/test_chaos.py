@@ -1,0 +1,428 @@
+"""Chaos plane (ISSUE 5): FaultPlan timeline semantics, constant-plan
+equivalence (state AND telemetry bit-identical to the static program),
+the extended DeltaFaults (traced drop_rate leaf, per-node drop, directed
+reach), pair_connected units, mid-scenario snapshot/resume, and the
+convergence scorer."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import chaos, delta, lifecycle, telemetry
+from ringpop_tpu.sim.delta import (
+    DeltaFaults,
+    has_drop,
+    leg_survives,
+    pair_connected,
+)
+
+from tests.sim_faults import make_faults
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        bool((np.asarray(x) == np.asarray(y)).all())
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# -- DeltaFaults: the re-registered pytree -----------------------------------
+
+
+def test_drop_rate_is_a_traced_leaf_not_a_recompile_key():
+    """Two fault models differing only in drop rate flatten to the SAME
+    treedef (the jit cache key) with the rate as a leaf — a drop-rate
+    sweep reuses one compilation.  The satellite fix: drop_rate used to
+    ride in aux_data, recompiling per distinct rate."""
+    a = DeltaFaults(up=jnp.ones(8, bool), drop_rate=0.05)
+    b = DeltaFaults(up=jnp.ones(8, bool), drop_rate=0.25)
+    assert jax.tree.structure(a) == jax.tree.structure(b)
+    assert jax.tree.leaves(a)[-1] == 0.05 and jax.tree.leaves(b)[-1] == 0.25
+    # the None fast path is static structure: a loss-free model has no
+    # drop leaf at all, so its trace stays the drop-free program
+    c = DeltaFaults(up=jnp.ones(8, bool))
+    assert jax.tree.structure(c) != jax.tree.structure(a)
+    assert not has_drop(c) and has_drop(a)
+
+
+def test_drop_rate_sweep_single_compilation():
+    params = delta.DeltaParams(n=64, k=8, rng="counter")
+    state = delta.init_state(params, seed=0)
+    stepper = jax.jit(functools.partial(delta.step, params))
+    up = jnp.ones(64, bool)
+    for rate in (0.05, 0.1, 0.9):
+        stepper(state, DeltaFaults(up=up, drop_rate=rate))
+    assert stepper._cache_size() == 1
+
+
+def test_make_faults_zero_drop_maps_to_static_none():
+    f = make_faults(16)
+    assert f.drop_rate is None and f.drop_node is None and f.reach is None
+    f2 = make_faults(16, drop=0.1, reach=[[True, False], [True, True]],
+                     drop_node={3: 0.5})
+    assert float(f2.drop_rate) == 0.1
+    assert f2.reach.shape == (2, 2) and float(f2.drop_node[3]) == 0.5
+
+
+# -- pair_connected / leg_survives units (satellite) --------------------------
+
+
+def test_pair_connected_both_none_fast_path():
+    f = DeltaFaults()
+    a = jnp.asarray([0, 1, 2], jnp.int32)
+    b = jnp.asarray([2, 0, 1], jnp.int32)
+    assert bool(pair_connected(f, a, b).all())
+
+
+def test_pair_connected_up_and_symmetric_group():
+    f = make_faults(6, down=[5], group=[0, 0, 1, 1, -1, 0])
+    a = jnp.asarray([0, 0, 0, 4, 0], jnp.int32)
+    b = jnp.asarray([1, 2, 4, 2, 5], jnp.int32)
+    got = np.asarray(pair_connected(f, a, b))
+    # same group; cross group; -1 reaches anyone; -1 reached; down peer
+    assert got.tolist() == [True, False, True, True, False]
+
+
+def test_pair_connected_asymmetric_reach():
+    """Directed reach: group 1 → 0 delivers while 0 → 1 is blocked; group
+    -1 stays universally connected in both directions."""
+    f = make_faults(6, group=[0, 0, 1, 1, -1, -1],
+                    reach=[[True, False], [True, True]])
+    a = jnp.asarray([0, 2, 0, 4, 2, 0], jnp.int32)
+    b = jnp.asarray([2, 0, 1, 2, 4, 4], jnp.int32)
+    got = np.asarray(pair_connected(f, a, b))
+    # 0->1 blocked; 1->0 open; within-0 open; -1->1 open; 1->-1 open; 0->-1
+    assert got.tolist() == [False, True, True, True, True, True]
+
+
+def test_leg_survives_per_node_drop_composes_as_survival_product():
+    dn = jnp.asarray([0.0, 0.5, 1.0, 0.0], jnp.float32)
+    f = DeltaFaults(drop_node=dn)
+    a = jnp.asarray([0, 0, 1, 2], jnp.int32)
+    b = jnp.asarray([3, 1, 1, 0], jnp.int32)
+    # keep = (1-dn[a])*(1-dn[b]); scalar rate absent
+    u = jnp.asarray([0.49, 0.49, 0.24, 0.0], jnp.float32)
+    got = np.asarray(leg_survives(f, u, a, b))
+    assert got.tolist() == [True, True, True, False]
+    # with the scalar rate folded in, keep shrinks by (1-rate):
+    # keeps become [0.5, 0.25, 0.125, 0.0]
+    f2 = DeltaFaults(drop_rate=jnp.float32(0.5), drop_node=dn)
+    u2 = jnp.asarray([0.51, 0.24, 0.13, 0.9], jnp.float32)
+    assert np.asarray(leg_survives(f2, u2, a, b)).tolist() == [False, True, False, False]
+
+
+def test_leg_survives_scalar_only_is_headline_comparison():
+    """The scalar-only path must be the exact historical ``u >= rate``
+    comparison (bit-compat with the frozen loss goldens)."""
+    f = DeltaFaults(drop_rate=0.3)
+    u = jnp.asarray([0.29999, 0.3, 0.31], jnp.float32)
+    assert np.asarray(leg_survives(f, u, 0, 1)).tolist() == [False, True, True]
+
+
+def test_asym_reach_in_the_delta_engine():
+    """Engine-level reach semantics: an exchange needs its ORDERED pair
+    connected (the request direction names the RPC; rumors then ride
+    both legs), so ONE open direction between two groups keeps rumors
+    flowing both ways, while a reach matrix blocking both directions
+    isolates exactly like the symmetric group model."""
+    n, k = 64, 8
+    group = np.zeros(n, np.int32)
+    group[n // 2:] = 1
+    params = delta.DeltaParams(n=n, k=k, rng="counter")
+    sources = np.full(k, n - 1, np.int64)  # all rumors start on side 1
+    from ringpop_tpu.sim.packbits import unpack_bits
+
+    for reach, side0_learns in (
+        ([[True, False], [True, True]], True),    # only 1→0 open: leaks
+        ([[True, False], [False, True]], False),  # both blocked: isolated
+    ):
+        f = make_faults(n, group=group, reach=reach)
+        state = delta.init_state(params, seed=3, sources=sources)
+        stepper = jax.jit(functools.partial(delta.step, params))
+        for _ in range(48):
+            state = stepper(state, f)
+        learned = np.asarray(unpack_bits(state.learned, k))
+        assert learned[n // 2:].all()  # side 1 always saturates
+        assert learned[: n // 2].any() == side0_learns, reach
+
+
+def test_fullview_oracle_refuses_legs_it_cannot_express():
+    """The O(N²) oracle keeps its static symmetric fault model: a
+    directed-reach / per-node-drop DeltaFaults (or a whole FaultPlan)
+    must raise instead of silently simulating a DIFFERENT model."""
+    from ringpop_tpu.sim import fullview
+
+    sim = fullview.FullViewSim(8, seed=0)
+    with pytest.raises(ValueError, match="directed reach"):
+        sim.tick(make_faults(8, group=[0] * 8, reach=[[True]]))
+    with pytest.raises(ValueError, match="per-node drop"):
+        sim.tick(make_faults(8, drop_node=np.zeros(8, np.float32)))
+    with pytest.raises(TypeError, match="FaultPlan"):
+        sim.tick(chaos.FaultPlan(base_up=jnp.ones(8, bool)))
+    # the plain shared-harness model still coerces fine
+    sim.tick(make_faults(8, down=[2], drop=0.1))
+
+
+# -- FaultPlan timeline semantics --------------------------------------------
+
+
+def test_faults_at_crash_restart_window():
+    crash = jnp.asarray([chaos.NO_TICK, 5, 5, 9], jnp.int32)
+    restart = jnp.asarray([chaos.NO_TICK, 8, chaos.NO_TICK, 12], jnp.int32)
+    plan = chaos.FaultPlan(crash_tick=crash, restart_tick=restart)
+    for t, want in ((0, [1, 1, 1, 1]), (5, [1, 0, 0, 1]),
+                    (8, [1, 1, 0, 1]), (10, [1, 1, 0, 0]), (12, [1, 1, 0, 1])):
+        up = np.asarray(chaos.faults_at(plan, t).up)
+        assert up.tolist() == [bool(x) for x in want], t
+        assert np.array_equal(chaos.up_at_host(plan, t, 4), up)
+
+
+def test_faults_at_flap_schedule():
+    plan = chaos.FaultPlan(
+        flap_period=jnp.asarray([0, 6], jnp.int32),
+        flap_phase=jnp.asarray([0, 2], jnp.int32),
+        flap_down=jnp.asarray([0, 2], jnp.int32),
+    )
+    got = [np.asarray(chaos.faults_at(plan, t).up).tolist() for t in range(8)]
+    # node 1: down iff (t+2) % 6 < 2 → down at t in {4, 5} then {10, 11}...
+    want_up1 = [(t + 2) % 6 >= 2 for t in range(8)]
+    assert [g[0] for g in got] == [True] * 8  # period 0 never flaps
+    assert [g[1] for g in got] == want_up1
+    for t in range(8):
+        assert np.array_equal(chaos.up_at_host(plan, t, 2), np.asarray(got[t]))
+
+
+def test_faults_at_partition_window_heals():
+    group = jnp.asarray([0, 0, 1, 1], jnp.int32)
+    plan = chaos.FaultPlan(group=group, part_from=jnp.int32(4), part_until=jnp.int32(8))
+    assert np.asarray(chaos.faults_at(plan, 3).group).tolist() == [-1, -1, -1, -1]
+    assert np.asarray(chaos.faults_at(plan, 4).group).tolist() == [0, 0, 1, 1]
+    assert np.asarray(chaos.faults_at(plan, 8).group).tolist() == [-1, -1, -1, -1]
+
+
+def test_flap_period_without_down_raises():
+    plan = chaos.FaultPlan(flap_period=jnp.asarray([4], jnp.int32))
+    with pytest.raises(ValueError, match="flap_down"):
+        chaos.faults_at(plan, 0)
+
+
+def test_merge_plans_rejects_duplicate_legs():
+    a = chaos.FaultPlan(drop_rate=jnp.float32(0.1))
+    with pytest.raises(ValueError, match="more than one plan"):
+        chaos._merge_plans(a, a)
+
+
+# -- constant-plan equivalence (the goldens-untouched acceptance bar) --------
+
+
+@pytest.mark.parametrize("engine", ["delta", "lifecycle"])
+def test_constant_plan_traces_to_the_exact_static_program(engine):
+    """A FaultPlan encoding a static DeltaFaults produces the IDENTICAL
+    jaxpr — not just equal values — on both engines; running both for
+    several ticks (with telemetry on the lifecycle side) stays bit-equal
+    leaf for leaf."""
+    n, k = 96, 16
+    faults = make_faults(n, down=[3, 7], group=[i % 2 for i in range(n)],
+                         drop=0.05)
+    plan = chaos.constant_plan(faults)
+    if engine == "delta":
+        params = delta.DeltaParams(n=n, k=k, rng="counter")
+        step, state = delta.step, delta.init_state(params, seed=1)
+        ja = jax.make_jaxpr(lambda s, f: step(params, s, f))(state, faults)
+        jb = jax.make_jaxpr(lambda s, p: step(params, s, p))(state, plan)
+        assert str(ja) == str(jb)
+        stepper = jax.jit(functools.partial(step, params))
+        a = b = state
+        for _ in range(12):
+            a, b = stepper(a, faults), stepper(b, plan)
+        assert _leaves_equal(a, b)
+    else:
+        params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=5, rng="counter")
+        state = lifecycle.init_state(params, seed=1)
+        ja = jax.make_jaxpr(lambda s, f: lifecycle.step(params, s, f))(state, faults)
+        jb = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, plan)
+        assert str(ja) == str(jb)
+        stepper = jax.jit(functools.partial(lifecycle.step, params))
+        a, b = state, state
+        ta, tb = telemetry.zeros(params), telemetry.zeros(params)
+        for _ in range(12):
+            a, ta = stepper(a, faults, telemetry=ta)
+            b, tb = stepper(b, plan, telemetry=tb)
+        assert _leaves_equal(a, b)
+        assert _leaves_equal(ta, tb)
+
+
+def test_plan_flows_through_run_until_detected_driver():
+    """A churn plan rides the jitted run-until machinery unchanged: the
+    permanently-crashed cohort is detected, and the driver's answer
+    equals a per-tick host loop's."""
+    n, k = 128, 32
+    crash = np.full(n, chaos.NO_TICK, np.int32)
+    victims = [5, 50, 90]
+    for v in victims:
+        crash[v] = 3
+    plan = chaos.FaultPlan(crash_tick=jnp.asarray(crash))
+    sim = lifecycle.LifecycleSim(n=n, k=k, seed=2, suspect_ticks=6, rng="counter")
+    ticks, ok = sim.run_until_detected(victims, plan, max_ticks=512)
+    assert ok and ticks > 0
+    # the resolved-faults queries agree with an explicit static model
+    static = DeltaFaults(up=jnp.ones(n, bool).at[jnp.asarray(victims)].set(False))
+    assert bool(lifecycle.detection_complete(sim.state, jnp.asarray(victims), plan))
+    assert bool(lifecycle.detection_complete(sim.state, jnp.asarray(victims), static))
+
+
+def test_restart_rejoins_and_converges():
+    """Crash → detect → restart → refute-by-reincarnation → the base
+    census carries the node ALIVE again and the cluster quiesces (the
+    re-join path the scorer's rejoin_convergence_ticks measures)."""
+    n, k = 96, 32
+    crash = np.full(n, chaos.NO_TICK, np.int32)
+    restart = np.full(n, chaos.NO_TICK, np.int32)
+    crash[7], restart[7] = 4, 40
+    plan = chaos.FaultPlan(crash_tick=jnp.asarray(crash), restart_tick=jnp.asarray(restart))
+    sim = lifecycle.LifecycleSim(n=n, k=k, seed=3, suspect_ticks=5, rng="counter")
+    sim.run(40, plan)
+    # down and detected by the restart tick
+    assert int(np.asarray(sim.state.base_status)[7]) >= lifecycle.FAULTY
+    # step past the restart so the refutation actually happens (the
+    # run_until driver tests quiescence on ENTRY — a detected-and-folded
+    # cluster at the restart tick is already converged without it)
+    sim.run(8, plan)
+    ticks, ok = sim.run_until_converged(plan, max_ticks=1024)
+    assert ok
+    assert bool(np.asarray(sim.state.base_present)[7])
+    assert int(np.asarray(sim.state.base_status)[7]) == lifecycle.ALIVE
+    assert int(np.asarray(sim.state.self_inc)[7]) > 0  # reincarnated
+
+
+# -- snapshot mid-scenario (satellite) ----------------------------------------
+
+
+def test_snapshot_restore_mid_churn_window_resumes_bit_identically():
+    """sim/snapshot.py round-trip at a tick INSIDE a churn window: the
+    resumed run must continue the exact trajectory of the uninterrupted
+    one — the plan's timeline is a pure function of the carried tick, so
+    restore needs no extra bookkeeping."""
+    from ringpop_tpu.sim.snapshot import load_state, save_state
+
+    import os
+    import tempfile
+
+    n, k = 64, 16
+    plan = chaos.scenario_plan("smoke", n, seed=5, horizon=64)
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=5, rng="counter")
+    stepper = jax.jit(functools.partial(lifecycle.step, params))
+    state = lifecycle.init_state(params, seed=5)
+    for _ in range(10):  # tick 10 is inside the smoke plan's churn window
+        state = stepper(state, plan)
+    down_now = ~chaos.up_at_host(plan, 10, n)
+    assert down_now.any(), "tick 10 must sit inside a churn window"
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "mid_churn.npz")
+        save_state(path, state, params=params)
+        resumed = lifecycle.init_state(params, seed=99)  # junk, fully replaced
+        resumed = load_state(path, lifecycle.LifecycleState, params=params)
+    assert _leaves_equal(resumed, state)
+    cont = state
+    for _ in range(20):  # crosses restart boundaries of the window
+        cont = stepper(cont, plan)
+        resumed = stepper(resumed, plan)
+    assert _leaves_equal(resumed, cont)
+
+
+# -- scorer -------------------------------------------------------------------
+
+
+def test_plan_events_timeline():
+    plan = chaos.scenario_plan("smoke", 128, seed=0, horizon=96)
+    events = chaos.plan_events(plan)
+    kinds = [e["kind"] for e in events]
+    assert "crash" in kinds and "restart" in kinds and "flap" in kinds
+    ticks = [e["tick"] for e in events]
+    assert ticks == sorted(ticks)
+    crash_nodes = sum(e["nodes"] for e in events if e["kind"] == "crash")
+    restart_nodes = sum(e["nodes"] for e in events if e["kind"] == "restart")
+    assert crash_nodes > restart_nodes  # the permanent cohort never restarts
+
+
+def test_score_blocks_on_synthetic_journal():
+    """Scorer arithmetic pinned on a hand-built journal: crash at tick 4,
+    half-coverage by tick 32, full coverage by tick 48, restart at 20
+    with census recovery + quiescence at 64."""
+    n = 100
+    crash = np.full(n, chaos.NO_TICK, np.int32)
+    restart = np.full(n, chaos.NO_TICK, np.int32)
+    crash[1], crash[2] = 4, 4
+    restart[2] = 20
+    plan = chaos.FaultPlan(crash_tick=jnp.asarray(crash), restart_tick=jnp.asarray(restart))
+
+    def block(tick, frac, alive, rumors, refuted=0):
+        return {"kind": "block", "tick": tick, "ticks": 16, "detect_frac": frac,
+                "census_alive": alive, "rumors_active": rumors,
+                "refuted": refuted, "decl_suspect": 2, "decl_faulty": 1,
+                "heal_attempts": 0}
+
+    blocks = [
+        block(16, 0.0, 98, 3),
+        block(32, 0.5, 98, 3, refuted=1),
+        block(48, 1.0, 98, 2),
+        block(64, 1.0, 99, 0),
+    ]
+    score = chaos.score_blocks(blocks, plan, n=n, scenario="synthetic")
+    assert score["time_to_detect"] == [[4, 44]]
+    assert score["rumor_half_life"] == [[4, 28]]
+    assert score["time_to_detect_median"] == 44
+    # node 2's one refutation is its re-join reincarnation, not a false
+    # accusation — the plan-known restart count is subtracted
+    assert score["refutations"] == 1
+    assert score["false_positive_suspects"] == 0
+    # expected alive at horizon: 99 (node 1 stays down); recovery lands
+    # at the tick-64 block, 44 ticks after the restart at 20
+    assert score["rejoin_convergence_ticks"] == 44
+    assert score["block_granularity_ticks"] == 16
+    assert score["final_detect_frac"] == 1.0
+
+
+def test_emit_score_stats_skips_null_metrics():
+    from ringpop_tpu.options import InMemoryStats
+
+    stats = InMemoryStats()
+    chaos.emit_score_stats(stats, {
+        "time_to_detect_median": 44,
+        "rumor_half_life_median": None,
+        "false_positive_suspects": 3,
+        "rejoin_convergence_ticks": None,
+        "final_detect_frac": 1.0,
+    })
+    assert stats.gauges["ringpop.sim.chaos.time-to-detect"] == 44.0
+    assert stats.gauges["ringpop.sim.chaos.false-positive.suspects"] == 3.0
+    assert "ringpop.sim.chaos.rumor.half-life" not in stats.gauges
+
+
+def test_telemetry_census_tracks_the_plan_tick():
+    """telemetry.fetch resolves a FaultPlan at the state's tick: the
+    detect_frac denominator is the down set in force AT FETCH, not at
+    plan construction."""
+    n = 64
+    crash = np.full(n, chaos.NO_TICK, np.int32)
+    crash[3] = 8
+    plan = chaos.FaultPlan(crash_tick=jnp.asarray(crash))
+    sim = lifecycle.LifecycleSim(n=n, k=16, seed=0, suspect_ticks=4,
+                                 rng="counter", telemetry=True)
+    for _ in range(4):
+        sim.tick(plan)
+    rec_before = sim.fetch_telemetry(plan)
+    # empty down set (nobody crashed yet): the vacuous 1.0, same as the
+    # no-fault-model branch
+    assert rec_before["detect_frac"] == pytest.approx(1.0)
+    assert rec_before["census_alive"] == n
+    sim.run_until_detected([3], plan, max_ticks=512)
+    rec_after = sim.fetch_telemetry(plan)
+    assert rec_after["detect_frac"] == pytest.approx(1.0)  # node 3 absorbed
+    assert rec_after["census_faulty"] >= 1

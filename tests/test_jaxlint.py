@@ -11,7 +11,7 @@ declare ``JAXLINT_TRACE_RULE`` + ``build()`` and run through
 uses, so `make lint` pointed at a trip case provably exits non-zero.
 
 The repo-at-HEAD tests are the real gate: plane 1 over the default sweep
-and plane 2 over the five public entry points (dense + 8-way virtual
+and plane 2 over the seven public entry points (dense + 8-way virtual
 mesh) must be clean modulo the justified waivers in
 ``analysis/waivers.toml`` — tier-1 fails the moment an engine edit
 reintroduces a threefry bypass, a forbidden-phase collective, or a
@@ -75,6 +75,17 @@ def test_ast_rule_trips_on_fixture(rule):
 def test_ast_rule_clean_fixture_is_clean(rule):
     found = _lint_fixture(astlint.RULES[rule], "clean.py")
     assert not found, [f.render() for f in found]
+
+
+def test_chaos_host_sync_fixture_pair():
+    """The chaos-plane alias directory (astlint.FIXTURE_SLUG_ALIASES):
+    a host-synced ``faults_at`` — int(tick) / np coercion of the
+    schedule inside jit — must trip RPA103, and the pure elementwise
+    shape (the real sim/chaos.py implementation) must be clean."""
+    found = _lint_fixture("chaos-host-sync", "trip.py")
+    assert any(f.rule == "RPA103" for f in found), [f.render() for f in found]
+    assert {f.scope for f in found} == {"faults_at"}
+    assert not _lint_fixture("chaos-host-sync", "clean.py")
 
 
 def test_host_sync_call_graph_closure():
@@ -169,7 +180,8 @@ def test_repo_plane1_clean_at_head():
 
 
 def test_repo_plane2_jaxpr_clean_at_head():
-    """The five entry points, dense + sharded: no f64, no callbacks,
+    """The seven entry points (incl. the chaos-enabled steps), dense +
+    sharded: no f64, no callbacks,
     confinement holds, donation aliases, sharded == unsharded modulo
     sharding ops — the acceptance bar of the jaxpr plane."""
     found = trace_checks.run_trace_checks()

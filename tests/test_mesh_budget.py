@@ -339,6 +339,103 @@ def test_sharded_telemetry_run_matches_unsharded(sharded_setup):
         assert np.asarray(ref_rec[key]) == np.asarray(sh_rec[key]), key
 
 
+def test_chaos_plan_adds_zero_per_tick_collectives(sharded_setup, tmp_path, monkeypatch):
+    """The chaos-plane acceptance bar (ISSUE 5): driving the sharded step
+    with a time-varying churn+flap+loss FaultPlan (the same liveness
+    overlay as the static model) compiles to EXACTLY the static
+    program's executed collective set — fault-timeline evaluation is
+    elementwise in the node lane, so the partitioner keeps it
+    shard-local.  Census equality, like the telemetry bar above."""
+    from ringpop_tpu.sim import chaos
+
+    mesh, params, _, state, faults, up = sharded_setup
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    plain = _census_of(blk.lower(state, faults, ticks=1).compile().as_text(), tmp_path)
+    plan = chaos._merge_plans(
+        chaos.scenario_plan("smoke", params.n, seed=0, horizon=64),
+        chaos.FaultPlan(base_up=jnp.asarray(up)),
+    )
+    with_plan = _census_of(
+        blk.lower(state, plan, ticks=1).compile().as_text(), tmp_path
+    )
+    n_plain, b_plain = _executed(plain)
+    n_chaos, b_chaos = _executed(with_plan)
+    assert n_plain > 0, "census parsed no collectives — parser/format drift?"
+    assert (n_chaos, b_chaos) == (n_plain, b_plain), (
+        f"chaos-enabled step compiles to {n_chaos} collectives / {b_chaos} B "
+        f"vs {n_plain} / {b_plain} static — fault evaluation stopped being "
+        "shard-local (run scripts/profile_mesh.py --chaos to attribute it)"
+    )
+
+
+def test_full_chaos_plan_forbidden_phases_stay_empty(sharded_setup, tmp_path, monkeypatch):
+    """With EVERY chaos leg active — churn, flap, a directed partition
+    window (reach) and per-node drop — the compiled sharded step keeps
+    the forbidden phases empty: no collective in fault-plan (timeline
+    evaluation) or peer-choice (the counter draws).  The reach/drop_node
+    gathers themselves land in their consuming phases and are budgeted
+    there; this test pins the phases that must stay at ZERO."""
+    from ringpop_tpu.sim import chaos
+
+    mesh, params, _, state, faults, up = sharded_setup
+    monkeypatch.setattr(lifecycle, "_SPARSE_TOPK_MIN_N", 0)
+    pm = _profile_mesh_module()
+    n = params.n
+    group = np.zeros(n, np.int32)
+    group[: n // 3] = 1
+    dn = np.zeros(n, np.float32)
+    dn[::64] = 0.2
+    plan = chaos._merge_plans(
+        chaos.scenario_plan("smoke", n, seed=0, horizon=64),
+        chaos.FaultPlan(
+            base_up=jnp.asarray(up),
+            group=jnp.asarray(group),
+            part_from=jnp.asarray(np.int32(0)),
+            part_until=jnp.asarray(np.int32(48)),
+            reach=jnp.asarray(np.asarray([[True, False], [True, True]])),
+            drop_node=jnp.asarray(dn),
+        ),
+    )
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    census = _census_of(blk.lower(state, plan, ticks=1).compile().as_text(), tmp_path)
+    rows = [r for _, r in pm.executed_rows(census)]
+    assert rows, "census parsed no collectives — parser/format drift?"
+    bad = [r for r in rows if r.get("phase") in ("fault-plan", "peer-choice")]
+    assert not bad, (
+        f"forbidden phases carry collectives under the full chaos plan: {bad}"
+    )
+
+
+def test_sharded_chaos_run_matches_unsharded(sharded_setup):
+    """Execute (not just compile) the chaos-enabled block over the mesh:
+    a time-varying churn+flap+loss plan must land bit-equal to the
+    unsharded run — the r8 partition-invariance bar extended to the
+    chaos plane (the simbench chaos scenarios certify the same property
+    per scenario via their sharded-twin subprocess)."""
+    from ringpop_tpu.sim import chaos
+
+    mesh, params, plain_params, sstate, faults, up = sharded_setup
+    plan = chaos._merge_plans(
+        chaos.scenario_plan("smoke", params.n, seed=0, horizon=64),
+        chaos.FaultPlan(base_up=jnp.asarray(up)),
+    )
+    sm_blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    ref_blk = jax.jit(
+        functools.partial(lifecycle._run_block, plain_params), static_argnames="ticks"
+    )
+    ref = ref_blk(lifecycle.init_state(plain_params, seed=0), plan, ticks=6)
+    sh = sm_blk(sstate, plan, ticks=6)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(sh)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+
+
 def test_detect_census_sees_unhinted_walk_collectives(sharded_setup, tmp_path):
     """Self-check that the budget numbers are not vacuous: the UNhinted
     detect program (no learned_sharding) must show MORE walk-body
