@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke aot-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -35,6 +35,13 @@ telemetry-smoke:
 chaos-smoke:
 	$(PY) scripts/chaos_smoke.py
 
+# AOT warm-start gate (util/aot.py): serialize the sharded (pipelined)
+# tick block, reload it through the front door in a fresh subprocess —
+# must report cache_hit with compile_s < 2 s and a bit-identical block
+# digest vs the in-process compile.
+aot-smoke:
+	$(PY) scripts/aot_smoke.py
+
 # compile the sharded programs at CI scale (8k, hierarchical select forced
 # on, the sharded-caller defaults rng=counter + shard-local exchange) and
 # diff the collective census against the committed budget capture — non-zero
@@ -48,9 +55,14 @@ chaos-smoke:
 # Re-baseline (after an INTENDED budget change, with PERF.md updated):
 #   $(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
 #     --force-sparse --out captures/mesh_profile_small_budget.json
+# --overlap (r11): the pipelined exchange's compiled schedule must show
+# response-leg crossing sends issued off PARTIAL request-leg receives,
+# interleaved with the merge (exit 5 if the fused leg loop regressed to
+# a strictly sequential dependency graph).
 profile-mesh:
 	$(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
-	  --force-sparse --chaos --compare captures/mesh_profile_small_budget.json \
+	  --force-sparse --chaos --overlap \
+	  --compare captures/mesh_profile_small_budget.json \
 	  --phase-budget --out /tmp/mesh_profile_small.json
 
 # skip the scale spot-checks

@@ -1,0 +1,193 @@
+"""Compiled-schedule overlap analysis for the pipelined exchange (r11).
+
+The r8 exchange ran its two roll legs as two sequential shard_map
+regions: every response-leg ppermute was data-dependent on the FULL
+request-leg stitch, so the compiled schedule had to finish the merge
+before the first crossing send of leg 2 could issue.  The r11 fused
+region (``parallel/shift.shard_roll_pipelined``) issues each leg-2 send
+off only the two leg-1 pieces its window needs — the dependency graph
+leaves the scheduler free to overlap crossing sends with merge compute.
+
+This module makes that claim CHECKABLE from the optimized HLO text
+(``scripts/profile_mesh.py --overlap``): it parses instruction-level
+def-use inside every computation, finds the exchange-phase
+collective-permutes, and asks two questions:
+
+* **dependent sends** — does any collective-permute transitively depend
+  on another permute's result THROUGH at least one non-trivial compute
+  op?  That is the signature of the fused leg loop: leg-2's send
+  operand is built (stitch + merge elementwise) from leg-1 receives
+  inside one region.  The sequential program can never show it — its
+  legs live in separate conditionals, and cross-computation inputs are
+  opaque parameters.
+* **interleaving** — inside such a region, does merge compute that
+  consumes permute results sit BETWEEN permutes in the schedule order
+  (i.e. the crossing sends no longer strictly precede the merge)?
+
+The analysis is deliberately topology-free: it never needs to know
+which send belongs to which leg — only the dependency shape that
+permits overlap.  On backends with async collectives the
+``collective-permute-start`` is the send issue point; the plain
+``collective-permute`` spelling (XLA:CPU) is handled identically.
+"""
+
+from __future__ import annotations
+
+import re
+
+# ops that neither compute nor move data meaningfully: a permute→permute
+# path through only these is forwarding, not merge work
+_TRIVIAL_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "copy", "copy-start", "copy-done", "after-all", "iota",
+}
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(?:[^=]*?\s)?([\w\-]+)\(")
+_NAME_RE = re.compile(r"%?([\w.\-]+)")
+
+
+def _op_of(line: str) -> str | None:
+    m = _DEF_RE.match(line)
+    return m.group(2) if m else None
+
+
+def parse_computations(hlo_path: str) -> dict:
+    """{computation: [instr...]} with per-instruction
+    ``{name, op, operands, pos, phase}`` — operands resolved against the
+    names already defined in the same computation (HLO is in SSA order;
+    cross-computation references enter as parameters and carry no dep
+    info, which is exactly the blindness the dependent-send test
+    exploits)."""
+    from ringpop_tpu.analysis.hlo_census import _phase_of
+
+    comps: dict = {}
+    cur = None
+    defined: dict = {}
+    for line in open(hlo_path):
+        stripped = line.rstrip()
+        if stripped.endswith("{") and not line.lstrip().startswith("ROOT"):
+            cur = stripped.split()[0].lstrip("%")
+            comps[cur] = []
+            defined = {}
+            continue
+        if cur is None:
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        m = _DEF_RE.match(line)
+        if not m:
+            continue
+        name, op = m.group(1), m.group(2)
+        rhs = line.split("=", 1)[1]
+        # strip metadata/attrs so operand-name scanning doesn't pick up
+        # computation references (to_apply=..., branch_computations=...)
+        rhs = re.split(r",\s*(?:metadata|backend_config|sharding)=", rhs)[0]
+        rhs = re.sub(r"\w+=\{[^}]*\}", " ", rhs)
+        rhs = re.sub(r"(?:to_apply|calls|body|condition|true_computation|"
+                     r"false_computation)=%?[\w.\-]+", " ", rhs)
+        operands = [
+            t for t in _NAME_RE.findall(rhs)
+            if t in defined and t != name
+        ]
+        instr = {
+            "name": name,
+            "op": op,
+            "operands": operands,
+            "pos": len(comps[cur]),
+            "phase": _phase_of(line),
+        }
+        comps[cur].append(instr)
+        defined[name] = instr
+    return comps
+
+
+def _is_permute(op: str) -> bool:
+    return op in ("collective-permute", "collective-permute-start")
+
+
+def analyze(hlo_path: str, phases=("rumor-exchange", "shard-roll")) -> dict:
+    """Per-region overlap report over every computation holding >= 2
+    exchange-phase collective-permutes.  See module docstring for the
+    two properties reported."""
+    comps = parse_computations(hlo_path)
+    regions = []
+    for cname, instrs in comps.items():
+        perms = [i for i in instrs
+                 if _is_permute(i["op"]) and i["phase"] in phases]
+        if len(perms) < 2:
+            continue
+        by_name = {i["name"]: i for i in instrs}
+        # forward DP in SSA order: pd = depends (transitively) on a
+        # permute (or is one); pvc = some permute→here path crosses a
+        # non-trivial compute op strictly between
+        pd: dict = {}
+        pvc: dict = {}
+        for i in instrs:
+            d = _is_permute(i["op"])
+            v = False
+            for o in i["operands"]:
+                oi = by_name[o]
+                d = d or pd.get(o, False)
+                via = pvc.get(o, False) or (
+                    pd.get(o, False)
+                    and oi["op"] not in _TRIVIAL_OPS
+                    and not _is_permute(oi["op"])
+                )
+                v = v or via
+            pd[i["name"]], pvc[i["name"]] = d, v
+        dependent_sends = [
+            p["name"] for p in perms
+            if any(
+                pvc.get(o, False)
+                or (pd.get(o, False)
+                    and by_name[o]["op"] not in _TRIVIAL_OPS
+                    and not _is_permute(by_name[o]["op"]))
+                for o in p["operands"]
+            )
+        ]
+        # schedule view: merge ops = non-trivial compute consuming permute
+        # results; interleaved iff one sits before the last crossing send
+        perm_pos = [p["pos"] for p in perms]
+        merge_pos = [
+            i["pos"] for i in instrs
+            if not _is_permute(i["op"]) and i["op"] not in _TRIVIAL_OPS
+            and any(pd.get(o, False) for o in i["operands"])
+        ]
+        interleaved = bool(merge_pos) and min(merge_pos) < max(perm_pos)
+        regions.append({
+            "computation": cname,
+            "sends": len(perms),
+            "send_positions": perm_pos,
+            "merge_positions": merge_pos[:16],
+            "dependent_sends": dependent_sends,
+            "interleaved": interleaved,
+        })
+    overlapped = [r for r in regions if r["dependent_sends"] and r["interleaved"]]
+    return {
+        "regions": regions,
+        "overlap": bool(overlapped),
+        "overlapped_regions": [r["computation"] for r in overlapped],
+    }
+
+
+def print_report(report: dict) -> None:
+    regs = report["regions"]
+    print(f"\n== exchange overlap report ({len(regs)} "
+          f"permute-bearing region(s)) ==")
+    for r in regs:
+        dep = len(r["dependent_sends"])
+        print(f"  {r['computation'][:56]:56s} sends={r['sends']:2d} "
+              f"dependent={dep} interleaved={r['interleaved']}")
+        if dep:
+            first_merge = min(r["merge_positions"]) if r["merge_positions"] else None
+            print(f"    send schedule positions {r['send_positions']}, "
+                  f"first permute-consuming merge op at {first_merge} — "
+                  "crossing sends do NOT strictly precede the merge")
+    if report["overlap"]:
+        print("  verdict: PIPELINED — response-leg sends issue off partial "
+              "request-leg receives while the merge computes "
+              f"({', '.join(report['overlapped_regions'][:4])})")
+    else:
+        print("  verdict: SEQUENTIAL — every crossing send strictly precedes "
+              "the merge that consumes its leg (no overlap window)")

@@ -2,11 +2,12 @@
 entry points.
 
 Plane 1 reads source; this plane reads the TRACED PROGRAM — the artifact
-the r6–r8 invariants are actually facts about.  Seven entry points
+the r6–r8 invariants are actually facts about.  Nine entry points
 (lifecycle step, delta step, the chaos-enabled variants of both — the
 same engines driven by a time-varying ``chaos.FaultPlan`` with every
 scenario leg populated — detect walk, shard_roll exchange, telemetry
-fetch) are traced dense AND under the 8-way virtual mesh (4×2
+fetch, and the r11 sequential-exchange variants of both steps, sharded
+only) are traced dense AND under the 8-way virtual mesh (4×2
 node × rumor — the ``profile_mesh`` topology), then checked:
 
 * **RPJ201 f64-in-trace** — no 64-bit aval anywhere (the engines are
@@ -423,10 +424,12 @@ def _chaos_plan(n):
 
 
 def build_entrypoints(mesh=None) -> dict:
-    """{name: ClosedJaxpr} for the seven public jitted entry points, traced
+    """{name: ClosedJaxpr} for the nine public jitted entry points, traced
     dense (``mesh=None``) or with the shard-local exchange lowering
-    (``mesh`` = the 4×2 virtual mesh).  rng="counter" — the sharded-caller
-    default whose zero-collective peer choice the confinement rules pin."""
+    (``mesh`` = the 4×2 virtual mesh; the shard_roll region and the
+    sequential-exchange step variants exist sharded only).
+    rng="counter" — the sharded-caller default whose zero-collective
+    peer choice the confinement rules pin."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -485,6 +488,21 @@ def build_entrypoints(mesh=None) -> dict:
                 (x,), sh, mesh, "node", (P("node", None),)
             )
         )(plane, jnp.int32(3))
+        # the sequential-leg sharded step (exchange_pipelined=False): the
+        # r8 lowering the tpu_ksweep pipelined_exchange A/B still runs —
+        # traced so RPJ201/202/203 cover it, and so run_trace_checks can
+        # pin the pipelined step skeleton-equal to it modulo the excised
+        # exchange region (the r11 RPJ205 extension)
+        import dataclasses as _dc
+
+        sparams = _dc.replace(lparams, exchange_pipelined=False)
+        out["lifecycle_step_seq_exchange"] = jax.make_jaxpr(
+            lambda s, f: lifecycle.step(sparams, s, f)
+        )(lstate, lfaults)
+        sdparams = _dc.replace(dparams, exchange_pipelined=False)
+        out["delta_step_seq_exchange"] = jax.make_jaxpr(
+            lambda s, f: delta.step(sdparams, s, f)
+        )(dstate, lfaults)
     return out
 
 
@@ -508,6 +526,17 @@ def run_trace_checks() -> list[Finding]:
         "delta_step_chaos",
     ):
         findings += check_structural_equivalence(name, dense[name], sharded[name])
+    # r11: the pipelined sharded step must be skeleton-equal to the
+    # sequential-leg sharded step modulo the excised exchange region —
+    # the fused leg loop may only ever differ INSIDE the shard-roll
+    # scope, everything else is a scheduling bug leaking out
+    for a, b in (
+        ("lifecycle_step", "lifecycle_step_seq_exchange"),
+        ("delta_step", "delta_step_seq_exchange"),
+    ):
+        findings += check_structural_equivalence(
+            f"{a}[pipelined-vs-sequential]", sharded[a], sharded[b]
+        )
     findings += _donation_checks()
     return findings
 

@@ -435,22 +435,29 @@ try:
     s1m = jax.tree.map(jax.device_put, lifecycle.init_state(p1m, seed=seed),
                        lifecycle.state_shardings(mesh, k=p1m.k))
     blk1m = jax.jit(functools.partial(lifecycle._run_block, p1m), static_argnames="ticks")
-    # split compile from execute (VERDICT r4 item 2): the first call pays
-    # XLA compile of the sharded 1M program UNLESS the persistent cache
-    # (configure_compile_cache above) already holds it — round-4's single
-    # wall_s swung 9.08 s -> 362.98 s purely on cache state.  The second
-    # call on the same inputs is execute-only, the reproducible number.
+    # AOT warm-start front door (util/aot.py): a cache hit deserializes
+    # the exported executable — no retrace, no relowering, sub-second XLA
+    # load — and compile_s/cache_hit below are MEASURED facts, not the
+    # first_s - execute_s guess of r4-r10 (which swung 9.08 s -> 362.98 s
+    # purely on invisible persistent-cache state).
+    from ringpop_tpu.util import aot
+    call1m, aot_info = aot.load_or_compile(
+        blk1m, s1m, f1m, tag="step1m", static_kw=dict(ticks=1),
+        statics=(repr(p1m),))
     t0 = time.perf_counter()
-    o1m = blk1m(s1m, f1m, ticks=1)
+    o1m = call1m(s1m, f1m)
     jax.block_until_ready(o1m.learned)
     first_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    o1m2 = blk1m(s1m, f1m, ticks=1)
+    o1m2 = call1m(s1m, f1m)
     jax.block_until_ready(o1m2.learned)
     execute_s = time.perf_counter() - t0
     step1m = dict(ok=True, first_call_s=round(first_s, 2),
-                  compile_s=round(max(first_s - execute_s, 0.0), 2),
+                  compile_s=aot_info["compile_s"],
                   execute_s=round(execute_s, 2),
+                  cache_hit=aot_info["cache_hit"],
+                  aot_error=aot_info["error"],
+                  cache_dir=aot_info.get("cache_dir"),
                   tick=int(o1m.tick))
 except Exception as e:
     step1m = dict(ok=False, error=(type(e).__name__ + ": " + str(e))[:300])

@@ -82,16 +82,33 @@ def shard_delta_state(state: DeltaState, mesh: Mesh) -> DeltaState:
     return jax.tree.map(jax.device_put, state, sh)
 
 
-def with_exchange_mesh(params, mesh: Mesh):
+def with_exchange_mesh(params, mesh: Mesh, h: Optional[int] = None,
+                       pipelined: Optional[bool] = None):
     """Return ``params`` with ``exchange_mesh`` bound to ``mesh`` (works for
     DeltaParams and LifecycleParams alike) — the shift exchange then lowers
     its roll legs as shard-local crossing-block ppermutes
-    (``parallel/shift.shard_roll``) instead of GSPMD's plane all-gathers.
+    (``parallel/shift``) instead of GSPMD's plane all-gathers.
     Bit-identical values; a no-op when the caller already bound a mesh, or
-    when the mesh has no >1-way node axis to exchange over."""
-    if params.exchange_mesh is not None or mesh.shape.get("node", 1) <= 1:
+    when the mesh has no >1-way node axis to exchange over.
+
+    ``h`` overrides the sub-block factor (``exchange_h``, H+1 sends per
+    rolled leaf per leg); ``pipelined`` selects the r11 fused leg loop vs
+    the sequential r8 legs (``exchange_pipelined``) — both bit- and
+    census-identical across settings, see parallel/shift.py.  Explicit
+    overrides are applied even when the caller already bound a mesh
+    (only the mesh itself is never rebound), so an A/B built from
+    already-meshed params cannot silently compare a program against
+    itself."""
+    extra = {}
+    if h is not None:
+        extra["exchange_h"] = h
+    if pipelined is not None:
+        extra["exchange_pipelined"] = pipelined
+    if params.exchange_mesh is not None:
+        return dataclasses.replace(params, **extra) if extra else params
+    if mesh.shape.get("node", 1) <= 1:
         return params
-    return dataclasses.replace(params, exchange_mesh=mesh)
+    return dataclasses.replace(params, exchange_mesh=mesh, **extra)
 
 
 def sharded_delta_step(params: DeltaParams, mesh: Mesh):

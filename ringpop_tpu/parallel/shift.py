@@ -28,14 +28,38 @@ in ``s``.  ``H+1`` sends per leg is the floor for this decomposition:
 the window spans ``H+1`` sub-blocks on up to two source shards, and a
 ppermute has one destination per source.  Raising ``H`` shaves padding
 (→ 1 local block as ``H → ∞``) but multiplies switch branches and
-per-send latency; ``H=2`` already clears the r8 byte budget.
+per-send latency; ``H=2`` already clears the r8 byte budget.  Since r11
+``H`` is a caller parameter (``exchange_h`` on the engine params), with
+the historical fallback to 1 when it does not divide the shard block.
 
 Bit-identity: the region is pure data movement (permute + concat +
 slice), so the result equals ``jnp.roll(x, s, axis=0)`` — and therefore
 the engines' materialized-index-gather formulation — exactly;
 ``tests/test_mesh_budget.py`` pins it against the gather path over every
 shift class and the paired sharded trajectory runs certify it end to
-end.
+end.  Shifts outside ``[0, n)`` (negative included) follow the mod-n
+contract of ``jnp.roll``: the traced shift is reduced mod n on entry,
+pinned by ``tests/test_shift_pipeline.py``.
+
+Pipelining (r11, :func:`shard_roll_pipelined`): the engines' exchange is
+TWO rolls per tick — the request leg carries the sent plane forward by
+``s``, then a merge (OR into the learned plane + ride-gate mask) builds
+the response plane, which rolls back by ``n - s``.  As two sequential
+``shard_roll`` calls, every response-leg ppermute waits on the *full*
+request-leg stitch.  But the response plane's sub-block ``d`` needs only
+the two request-leg pieces that stitch into ``d`` — so the fused region
+runs a leg loop with a double-buffered carry: leg 1's ``H+1`` sends are
+issued up front, each leg-2 send is issued as soon as the two pieces of
+its window arrive (while the other ``H-1`` stitches and the full-plane
+merge still compute), and the final stitches consume both buffers at the
+end.  The data-dependency graph this emits is what lets XLA's
+async-collective scheduler overlap the crossing sends with the merge —
+``scripts/profile_mesh.py --overlap`` verifies the compiled schedule.
+Collective count and bytes are IDENTICAL to the sequential legs (same
+``H+1`` sends per rolled leaf per leg, same piece shapes; one switch
+over ``2·H·S`` branches instead of two over ``H·S`` — the leg-2 quotient
+is a static function of (leg-1 quotient, remainder==0) because the two
+shifts sum to n).
 """
 
 from __future__ import annotations
@@ -50,58 +74,106 @@ except ImportError:  # pragma: no cover - older jax
 
 from jax.sharding import Mesh, PartitionSpec as P
 
+DEFAULT_H = 2
 
-def shard_roll(leaves: tuple, shift, mesh: Mesh, axis: str, specs: tuple) -> tuple:
-    """``jnp.roll(x, shift, axis=0)`` for every array in ``leaves``, as the
-    crossing-block ppermute exchange described in the module docstring.
 
-    ``leaves``: arrays whose axis 0 is the full node axis (one shared n,
-    ``n % S == 0`` — the state-sharding divisibility rule).  ``shift``: a
-    traced int32 scalar in ``[0, n)``.  ``specs``: one ``PartitionSpec``
-    per leaf describing its sharding over ``mesh`` (axis 0 must be
-    ``axis``); they become the region's in/out specs, so the call neither
-    reshards its inputs nor leaves resharding work behind.
-
-    Requires ``mesh.shape[axis] > 1`` (with one node shard there is
-    nothing to exchange — callers keep the local gather path).
-    """
+def _layout(leaves: tuple, mesh: Mesh, axis: str, h: int):
+    """Shared validation + (n, nb, h_eff, sub) resolution.  ``h`` falls
+    back to 1 when it does not divide the shard block (the historical
+    odd-block behavior, now for any caller-chosen factor)."""
     s_shards = mesh.shape[axis]
     if s_shards <= 1:
         raise ValueError("shard_roll needs >1 node shard; use the gather path")
     n = leaves[0].shape[0]
     if n % s_shards:
         raise ValueError(f"n={n} not divisible by {s_shards} node shards")
+    if h < 1:
+        raise ValueError(f"sub-block factor h={h} must be >= 1")
     nb = n // s_shards
-    h = 2 if nb % 2 == 0 else 1  # sub-blocks per shard (module docstring)
-    sub = nb // h
+    h_eff = h if nb % h == 0 else 1
+    return n, nb, h_eff, nb // h_eff
+
+
+def _window_plan(hqi: int, h: int, s_shards: int) -> list:
+    """Static send plan for one quotient class: window part p (of H+1)
+    for destination d is global sub-block H·d - m with m = hqi + 1 - p:
+    it lives on the shard m/H (ceil) ring-steps back, at local sub-index
+    (-m) mod H."""
+    plan = []
+    for p in range(h + 1):
+        m = hqi + 1 - p
+        ring = -(-m // h) % s_shards  # ceil(m/H) mod S
+        plan.append((ring, (-m) % h))
+    return plan
+
+
+def _issue(plan: list, subs, axis: str, s_shards: int) -> list:
+    """Issue one leg's window: per plan entry, the source sub-block of
+    every leaf, ppermuted when it crosses shards (ring offset 0 = already
+    local, no send).  Returns ``recv[p][leaf]`` — the in-flight buffer
+    the stitch (and, pipelined, the next leg) consumes."""
+    recv = []
+    for ring, si in plan:
+        pieces = []
+        for sx in subs:
+            piece = sx[si]
+            if ring:
+                perm = [(j, (j + ring) % s_shards) for j in range(s_shards)]
+                piece = jax.lax.ppermute(piece, axis, perm)
+            pieces.append(piece)
+        recv.append(pieces)
+    return recv
+
+
+def _stitch_sub(recv: list, leaf: int, d: int, rh, sub: int):
+    """Destination sub-block ``d`` of one rolled leaf: window pieces d and
+    d+1 at offset ``sub - rh`` (rh == 0 ⇒ piece d+1 whole) — the per-sub-
+    block form of the sequential concat+slice, value-identical."""
+    two = jnp.concatenate([recv[d][leaf], recv[d + 1][leaf]], axis=0)
+    return jax.lax.dynamic_slice_in_dim(two, sub - rh, sub, axis=0)
+
+
+def shard_roll(
+    leaves: tuple, shift, mesh: Mesh, axis: str, specs: tuple, h: int = DEFAULT_H
+) -> tuple:
+    """``jnp.roll(x, shift, axis=0)`` for every array in ``leaves``, as the
+    crossing-block ppermute exchange described in the module docstring.
+
+    ``leaves``: arrays whose axis 0 is the full node axis (one shared n,
+    ``n % S == 0`` — the state-sharding divisibility rule).  ``shift``: a
+    traced int32 scalar, reduced mod n on entry (the ``jnp.roll``
+    contract — shifts >= n and negative shifts are legal).  ``specs``:
+    one ``PartitionSpec`` per leaf describing its sharding over ``mesh``
+    (axis 0 must be ``axis``); they become the region's in/out specs, so
+    the call neither reshards its inputs nor leaves resharding work
+    behind.  ``h``: sub-blocks per shard (the H of the decomposition;
+    falls back to 1 when it does not divide the shard block).
+
+    Requires ``mesh.shape[axis] > 1`` (with one node shard there is
+    nothing to exchange — callers keep the local gather path).
+    """
+    s_shards = mesh.shape[axis]
+    n, nb, h, sub = _layout(leaves, mesh, axis, h)
 
     def body(shift, *locs):
+        shift = jnp.mod(shift, n)
         hq = shift // sub
         rh = shift - hq * sub
 
         def branch(hqi: int):
-            # window part p (of H+1) for destination d is global sub-block
-            # H·d - m with m = hqi + 1 - p: it lives on the shard m/H
-            # (ceil) ring-steps back, at local sub-index (-m) mod H
-            plan = []
-            for p in range(h + 1):
-                m = hqi + 1 - p
-                ring = -(-m // h) % s_shards  # ceil(m/H) mod S
-                plan.append((ring, (-m) % h))
+            plan = _window_plan(hqi, h, s_shards)
 
             def run(rh, *xs):
+                subs = [x.reshape((h, sub) + x.shape[1:]) for x in xs]
+                recv = _issue(plan, subs, axis, s_shards)
                 outs = []
-                for x in xs:
-                    subs = x.reshape((h, sub) + x.shape[1:])
-                    parts = []
-                    for ring, si in plan:
-                        piece = subs[si]
-                        if ring:  # ring offset 0 = already local, no send
-                            perm = [(j, (j + ring) % s_shards) for j in range(s_shards)]
-                            piece = jax.lax.ppermute(piece, axis, perm)
-                        parts.append(piece)
-                    cat = jnp.concatenate(parts, axis=0)
-                    outs.append(jax.lax.dynamic_slice_in_dim(cat, sub - rh, nb, axis=0))
+                for li in range(len(xs)):
+                    outs.append(
+                        jnp.concatenate(
+                            [_stitch_sub(recv, li, d, rh, sub) for d in range(h)],
+                            axis=0,
+                        )
+                    )
                 return tuple(outs)
 
             return run
@@ -115,3 +187,122 @@ def shard_roll(leaves: tuple, shift, mesh: Mesh, axis: str, specs: tuple) -> tup
         except TypeError:  # pragma: no cover - older jax spells it check_rep
             fn = _shard_map(body, check_rep=False, **kw)
         return fn(jnp.asarray(shift, jnp.int32), *leaves)
+
+
+def shard_roll_pipelined(
+    leg1: tuple,
+    shift,
+    mesh: Mesh,
+    axis: str,
+    specs1: tuple,
+    carry: tuple,
+    carry_specs: tuple,
+    leg2_of,
+    spec2,
+    h: int = DEFAULT_H,
+) -> tuple:
+    """Both exchange legs of one tick in ONE shard_map region, pipelined.
+
+    Leg 1 rolls every leaf of ``leg1`` forward by ``shift`` (mod n); the
+    response plane — ``leg2_of(*leg1_rolled_sub_blocks, *carry_sub_blocks)``,
+    which must be ELEMENTWISE along axis 0 (each output row a function of
+    the same rows of its inputs; this is what lets piece extraction
+    commute with it) — rolls back by ``n - shift``.  Returns
+    ``(*leg1_rolled, leg2_rolled)``, bit-identical to::
+
+        outs = shard_roll(leg1, shift, ...)
+        plane = leg2_of(*outs, *carry)
+        (back,) = shard_roll((plane,), n - shift, ...)
+
+    but with the leg loop double-buffered: leg 1's H+1 sends are all
+    issued first; each leg-2 send is issued as soon as the TWO leg-1
+    pieces its window sub-block stitches from have arrived — before the
+    other H-1 sub-blocks' stitches (leg 1's merge) consume their windows.
+    The emitted dependency graph leaves XLA's scheduler free to overlap
+    the leg-2 crossing sends with the merge compute (``profile_mesh
+    --overlap`` checks the compiled schedule does); collective count and
+    bytes are identical to the sequential pair by construction.
+
+    One static switch covers both legs: with ``s = hq1·sub + rh1``, the
+    back-roll ``n - s`` has quotient ``(H·S - hq1 - (0 if rh1 == 0 else
+    1)) mod H·S`` and remainder ``(sub - rh1) mod sub`` — so the branch
+    index is ``2·hq1 + (rh1 == 0)`` and each branch bakes both legs'
+    static send plans.
+    """
+    s_shards = mesh.shape[axis]
+    n, nb, h, sub = _layout(leg1, mesh, axis, h)
+    hs = h * s_shards
+    n1 = len(leg1)
+
+    def body(shift, *locs):
+        shift = jnp.mod(shift, n)
+        hq1 = shift // sub
+        rh1 = shift - hq1 * sub
+        back = jnp.mod(n - shift, n)
+        rh2 = back - (back // sub) * sub
+
+        def branch(hq1i: int, zero_r: bool):
+            plan1 = _window_plan(hq1i, h, s_shards)
+            hq2i = (hs - hq1i - (0 if zero_r else 1)) % hs
+            plan2 = _window_plan(hq2i, h, s_shards)
+
+            def run(rh1, rh2, *xs):
+                xs1, xc = xs[:n1], xs[n1:]
+                subs1 = [x.reshape((h, sub) + x.shape[1:]) for x in xs1]
+                subsc = [x.reshape((h, sub) + x.shape[1:]) for x in xc]
+                # leg 1: issue every crossing send up front — the first
+                # buffer of the double-buffered leg loop
+                recv1 = _issue(plan1, subs1, axis, s_shards)
+                # leg 2: per send, stitch ONLY the window sub-block it
+                # needs (two leg-1 pieces), build the response sub-block
+                # elementwise, and issue — the remaining leg-1 stitches
+                # and the full-plane merge compute while it flies
+                resp_subs: dict = {}
+
+                def resp_sub(d: int):
+                    if d not in resp_subs:
+                        ins = [_stitch_sub(recv1, li, d, rh1, sub) for li in range(n1)]
+                        resp_subs[d] = leg2_of(*ins, *(c[d] for c in subsc))
+                    return resp_subs[d]
+
+                recv2 = []
+                for ring, si in plan2:
+                    piece = resp_sub(si)
+                    if ring:
+                        perm = [(j, (j + ring) % s_shards) for j in range(s_shards)]
+                        piece = jax.lax.ppermute(piece, axis, perm)
+                    recv2.append([piece])
+                # final stitches consume both buffers
+                outs = []
+                for li in range(n1):
+                    outs.append(
+                        jnp.concatenate(
+                            [_stitch_sub(recv1, li, d, rh1, sub) for d in range(h)],
+                            axis=0,
+                        )
+                    )
+                outs.append(
+                    jnp.concatenate(
+                        [_stitch_sub(recv2, 0, d, rh2, sub) for d in range(h)],
+                        axis=0,
+                    )
+                )
+                return tuple(outs)
+
+            return run
+
+        idx = hq1 * 2 + (rh1 == 0).astype(jnp.int32)
+        branches = [branch(i // 2, bool(i % 2)) for i in range(2 * hs)]
+        return jax.lax.switch(idx, branches, rh1, rh2, *locs)
+
+    with jax.named_scope("shard-roll"):
+        kw = {
+            "mesh": mesh,
+            "in_specs": (P(),) + tuple(specs1) + tuple(carry_specs),
+            "out_specs": tuple(specs1) + (spec2,),
+        }
+        try:
+            fn = _shard_map(body, check_vma=False, **kw)
+        except TypeError:  # pragma: no cover - older jax spells it check_rep
+            fn = _shard_map(body, check_rep=False, **kw)
+        return fn(jnp.asarray(shift, jnp.int32), *leg1, *carry)

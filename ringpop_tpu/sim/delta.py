@@ -113,6 +113,12 @@ class DeltaParams:
     # (parallel/shift.shard_roll) instead of GSPMD's plane all-gathers.
     # Bit-identical; ``sharded_delta_step`` injects the run's mesh.
     exchange_mesh: Optional["jax.sharding.Mesh"] = None
+    # sub-block factor H (H+1 sends per rolled leaf per leg) and the r11
+    # pipelined-vs-sequential leg lowering — see LifecycleParams for the
+    # full story; both only read when exchange_mesh is set, and both
+    # bit-identical + census-identical across settings.
+    exchange_h: int = 2
+    exchange_pipelined: bool = True
 
     def resolved_max_p(self) -> int:
         return resolve_max_p(self.n, self.p_factor, self.max_p)
@@ -315,29 +321,53 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
             # permutation makes delivery a row gather (receipt uniqueness is
             # structural: node j is pinged only by j-s).
             sent_w = riding_w & cmask
-            if use_sm:
-                # sharded callers: both roll legs as explicit shard-local
-                # crossing-block ppermutes (parallel/shift.shard_roll) instead
-                # of GSPMD's plane-sized all-gathers; bit-identical data motion
+            if use_sm and params.exchange_pipelined:
+                # sharded callers, r11 default: both legs in one fused
+                # pipelined region — response-leg ppermutes issued as soon
+                # as their two request-leg window pieces arrive, while the
+                # request merge computes (parallel/shift.shard_roll_pipelined;
+                # bit-identical and census-identical to the sequential legs)
                 from jax.sharding import PartitionSpec as _P
 
-                from ringpop_tpu.parallel.shift import shard_roll
+                from ringpop_tpu.parallel.shift import shard_roll_pipelined
 
                 wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
-                inbound_w, got_pinged = shard_roll(
-                    (sent_w, conn), s, emesh, "node", (wspec, _P("node"))
+                inbound_w, got_pinged, resp_src = shard_roll_pipelined(
+                    (sent_w, conn), s, emesh, "node", (wspec, _P("node")),
+                    carry=(state.learned, ride_ok_w), carry_specs=(wspec, wspec),
+                    leg2_of=lambda inb, gp, lrn, rd: (lrn | inb) & rd,
+                    spec2=wspec, h=params.exchange_h,
                 )
+                learned1_w = state.learned | inbound_w
             else:
-                idx_fwd = jnp.mod(i_all - s, n)
-                inbound_w = sent_w[idx_fwd]
-                got_pinged = conn[idx_fwd]
-            learned1_w = state.learned | inbound_w
-            # response leg: the target's riding rumors come back to the pinger
-            answerable_w = learned1_w & ride_ok_w
-            if use_sm:
-                (resp_src,) = shard_roll((answerable_w,), n - s, emesh, "node", (wspec,))
-            else:
-                resp_src = answerable_w[jnp.mod(i_all + s, n)]
+                if use_sm:
+                    # sequential r8 legs (kept for the pipelined_exchange
+                    # A/B): both rolls as explicit shard-local crossing-block
+                    # ppermutes instead of GSPMD's plane-sized all-gathers;
+                    # bit-identical data motion
+                    from jax.sharding import PartitionSpec as _P
+
+                    from ringpop_tpu.parallel.shift import shard_roll
+
+                    wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
+                    inbound_w, got_pinged = shard_roll(
+                        (sent_w, conn), s, emesh, "node", (wspec, _P("node")),
+                        h=params.exchange_h,
+                    )
+                else:
+                    idx_fwd = jnp.mod(i_all - s, n)
+                    inbound_w = sent_w[idx_fwd]
+                    got_pinged = conn[idx_fwd]
+                learned1_w = state.learned | inbound_w
+                # response leg: the target's riding rumors come back to the pinger
+                answerable_w = learned1_w & ride_ok_w
+                if use_sm:
+                    (resp_src,) = shard_roll(
+                        (answerable_w,), n - s, emesh, "node", (wspec,),
+                        h=params.exchange_h,
+                    )
+                else:
+                    resp_src = answerable_w[jnp.mod(i_all + s, n)]
             resp_w = resp_src & cmask
             learned2_w = learned1_w | resp_w
         else:

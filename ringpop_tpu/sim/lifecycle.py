@@ -172,6 +172,17 @@ class LifecycleParams:
     # the gather path by construction; None (default) keeps the
     # single-device lowering.
     exchange_mesh: Optional["jax.sharding.Mesh"] = None
+    # sub-block factor H of the crossing-block decomposition (H+1 sends
+    # per rolled leaf per leg; see parallel/shift.py — falls back to 1
+    # when it does not divide the shard block).  Only read when
+    # exchange_mesh is set.
+    exchange_h: int = 2
+    # True (default): both roll legs fused in one pipelined region
+    # (shard_roll_pipelined) — response-leg sends issued while the
+    # request-leg merge computes.  False: the sequential r8 legs (two
+    # shard_roll calls), kept for the tpu_ksweep pipelined_exchange A/B.
+    # Bit-identical and collective-census-identical either way.
+    exchange_pipelined: bool = True
 
     def resolved_max_p(self) -> int:
         return resolve_max_p(self.n, self.p_factor, self.max_p)
@@ -511,41 +522,75 @@ def step(
             dmask = row_mask(delivered)
             riding_w = state.learned & ride_ok_w & active_w[None, :]
             sent_w = riding_w & dmask
-            if use_sm:
-                # sharded callers: the two roll legs as explicit shard-local
-                # crossing-block ppermutes (parallel/shift.shard_roll, H+1
-                # sub-block sends per leg) — per-leg cross-chip bytes drop
-                # from the plane-sized all-gather GSPMD emits for a
-                # traced-index gather to ~1.5 local blocks per chip.
-                # Bit-identical: the region is pure data movement.
+            if use_sm and params.exchange_pipelined:
+                # sharded callers, r11 default: BOTH roll legs in one fused
+                # shard-local region (parallel/shift.shard_roll_pipelined)
+                # — the response leg's crossing ppermutes are issued as
+                # soon as the two request-leg pieces of their window
+                # arrive, before the request merge consumes the other
+                # sub-blocks, so XLA's scheduler can overlap them with the
+                # merge compute.  The response plane is built inside the
+                # region as (learned | inbound) & ride per sub-block; the
+                # [K]-axis active mask commutes with the node roll, so it
+                # applies after the region — bit-identical values, and
+                # collective-count/byte-identical to the sequential legs.
                 from jax.sharding import PartitionSpec as _P
 
-                from ringpop_tpu.parallel.shift import shard_roll
+                from ringpop_tpu.parallel.shift import shard_roll_pipelined
 
                 wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
                 vspec = _P("node")
-                inbound_w, got_pinged = shard_roll(
-                    (sent_w, delivered), shift, emesh, "node", (wspec, vspec)
+                inbound_w, got_pinged, resp_raw = shard_roll_pipelined(
+                    (sent_w, delivered), shift, emesh, "node", (wspec, vspec),
+                    carry=(state.learned, ride_ok_w), carry_specs=(wspec, wspec),
+                    leg2_of=lambda inb, gp, lrn, rd: (lrn | inb) & rd,
+                    spec2=wspec, h=params.exchange_h,
                 )
+                learned1_w = state.learned | inbound_w
+                resp_w = resp_raw & active_w[None, :] & dmask
+                learned2_w = learned1_w | resp_w
             else:
-                # rolls as explicit row gathers with precomputed index vectors:
-                # jnp.roll with a traced shift lowers to a slice-select chain that
-                # XLA re-derives PER CONSUMING ELEMENT when fused downstream
-                # (measured as the dominant cost of the tick); a gather through a
-                # materialized [N] index vector is one address lookup per element
-                # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
-                idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
-                inbound_w = sent_w[idx_fwd]
-                got_pinged = delivered[idx_fwd]
-            learned1_w = state.learned | inbound_w
-            answerable_w = learned1_w & ride_ok_w & active_w[None, :]
-            if use_sm:
-                (resp_src,) = shard_roll((answerable_w,), n - shift, emesh, "node", (wspec,))
-            else:
-                idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
-                resp_src = answerable_w[idx_back]
-            resp_w = resp_src & dmask
-            learned2_w = learned1_w | resp_w
+                if use_sm:
+                    # sequential r8 legs (kept for the tpu_ksweep
+                    # pipelined_exchange A/B): the two roll legs as explicit
+                    # shard-local crossing-block ppermutes
+                    # (parallel/shift.shard_roll, H+1 sub-block sends per
+                    # leg) — per-leg cross-chip bytes drop from the
+                    # plane-sized all-gather GSPMD emits for a traced-index
+                    # gather to ~1.5 local blocks per chip.  Bit-identical:
+                    # the region is pure data movement.
+                    from jax.sharding import PartitionSpec as _P
+
+                    from ringpop_tpu.parallel.shift import shard_roll
+
+                    wspec = _P("node", "rumor" if "rumor" in emesh.shape else None)
+                    vspec = _P("node")
+                    inbound_w, got_pinged = shard_roll(
+                        (sent_w, delivered), shift, emesh, "node",
+                        (wspec, vspec), h=params.exchange_h,
+                    )
+                else:
+                    # rolls as explicit row gathers with precomputed index vectors:
+                    # jnp.roll with a traced shift lowers to a slice-select chain that
+                    # XLA re-derives PER CONSUMING ELEMENT when fused downstream
+                    # (measured as the dominant cost of the tick); a gather through a
+                    # materialized [N] index vector is one address lookup per element
+                    # and fuses cheaply.  Same values: out[i] = in[(i - s) mod n].
+                    idx_fwd = jnp.mod(i_all - shift, n)  # roll by +shift
+                    inbound_w = sent_w[idx_fwd]
+                    got_pinged = delivered[idx_fwd]
+                learned1_w = state.learned | inbound_w
+                answerable_w = learned1_w & ride_ok_w & active_w[None, :]
+                if use_sm:
+                    (resp_src,) = shard_roll(
+                        (answerable_w,), n - shift, emesh, "node", (wspec,),
+                        h=params.exchange_h,
+                    )
+                else:
+                    idx_back = jnp.mod(i_all + shift, n)  # roll by -shift
+                    resp_src = answerable_w[idx_back]
+                resp_w = resp_src & dmask
+                learned2_w = learned1_w | resp_w
         else:
             ride_ok_b = state.pcount < maxp
             riding_b = learned0_b & active[None, :] & ride_ok_b
@@ -1530,7 +1575,8 @@ class LifecycleSim:
     block and journals the wrapped sum + live-agreement bit (pricey at
     1M; meant for the small-config smoke)."""
 
-    def __init__(self, n: int, seed: int = 0, telemetry=None, journal_views: bool = False, **kw):
+    def __init__(self, n: int, seed: int = 0, telemetry=None, journal_views: bool = False,
+                 aot: Optional[str] = None, **kw):
         from ringpop_tpu.sim import telemetry as _tm
 
         self.params = LifecycleParams(n=n, **kw)
@@ -1539,6 +1585,14 @@ class LifecycleSim:
         self._block = jax.jit(
             functools.partial(_run_block, self.params), static_argnames="ticks"
         )
+        # AOT warm-start (util/aot.py): with a tag, every distinct block
+        # program this instance dispatches goes through the load-or-compile
+        # front door — serialized on first compile, reloaded warm by the
+        # next process.  aot_info collects one front-door record per
+        # program (keyed like _aot_calls) for callers that journal them.
+        self._aot_tag = aot
+        self._aot_calls: dict = {}
+        self.aot_info: dict = {}
         self.telemetry = None
         self.telemetry_sink = None
         self.journal_views = journal_views
@@ -1557,12 +1611,48 @@ class LifecycleSim:
             )
         return self.state
 
+    def _block_call(self, state, faults, ticks: int, telemetry=None):
+        """Dispatch one tick block — through the AOT front door when the
+        instance carries a tag.  Memoized per (ticks, faults structure
+        AND leaf avals, telemetry on/off): the front door binds one
+        concrete program, so a faults pytree differing in structure OR
+        in a leaf shape/dtype gets its own keyed program instead of a
+        mis-fed executable (the plain jit path would have recompiled
+        transparently; this memo must be at least as discriminating)."""
+        dyn_kw = {} if telemetry is None else {"telemetry": telemetry}
+        if self._aot_tag is None:
+            return self._block(state, faults, ticks=ticks, **dyn_kw)
+        from ringpop_tpu.util import aot as _aot
+
+        fdesc = str(jax.tree.structure(faults)) + "|".join(
+            _aot._leaf_descriptor(x) for x in jax.tree.leaves(faults)
+        )
+        memo = (ticks, fdesc, telemetry is not None)
+        if memo not in self._aot_calls:
+            # tag is the artifact's human-readable prefix; a short hash of
+            # the faults descriptor keeps aot_info records from distinct
+            # programs at the same block size from overwriting each other
+            import hashlib as _hl
+
+            tag = (
+                f"{self._aot_tag}-blk{ticks}"
+                + ("-tm" if telemetry is not None else "")
+                + f"-f{_hl.sha256(fdesc.encode()).hexdigest()[:6]}"
+            )
+            call, info = _aot.load_or_compile(
+                self._block, state, faults, dyn_kw=dyn_kw or None,
+                tag=tag, static_kw={"ticks": ticks}, statics=(repr(self.params),),
+            )
+            self._aot_calls[memo] = call
+            self.aot_info[tag] = info
+        return self._aot_calls[memo](state, faults, **dyn_kw)
+
     def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()) -> LifecycleState:
         if self.telemetry is None:
-            self.state = self._block(self.state, faults, ticks=ticks)
+            self.state = self._block_call(self.state, faults, ticks)
         else:
-            self.state, self.telemetry = self._block(
-                self.state, faults, ticks=ticks, telemetry=self.telemetry
+            self.state, self.telemetry = self._block_call(
+                self.state, faults, ticks, telemetry=self.telemetry
             )
             self._flush(faults)
         return self.state

@@ -334,6 +334,14 @@ class TelemetryJournal:
         self._f = open(path, "a" if append else "w", buffering=1)
 
     def header(self, engine: str, scenario: str = "", params: Optional[dict] = None) -> None:
+        # compile_cache: the accel plane's persistent-cache outcome
+        # (cache_dir + the configure error when it could not be enabled)
+        # — a journal states its cache world explicitly instead of
+        # readers inferring it from first_s - execute_s deltas.  Callers
+        # with an AOT front-door result add its cache_hit via params
+        # (e.g. simbench step1m).
+        from ringpop_tpu.util.accel import cache_status
+
         self._write(
             {
                 "kind": "header",
@@ -342,6 +350,7 @@ class TelemetryJournal:
                 "params": params or {},
                 "toolchain": toolchain_fingerprint(),
                 "mesh_budget": mesh_budget_fingerprint(),
+                "compile_cache": cache_status(),
             }
         )
 

@@ -12,11 +12,26 @@ way; this is TPU-runtime plumbing the rebuild owns.
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import sys
 import time
 from typing import Optional, Sequence
+
+_log = logging.getLogger("ringpop_tpu.accel")
+
+# outcome of the LAST configure_compile_cache call in this process —
+# {"cache_dir": str|None, "error": str|None}.  The simbench journal
+# header embeds this (OBSERVABILITY.md) so a run record states whether
+# the persistent cache was live and, if not, WHY — instead of readers
+# inferring cache state from first_s - execute_s timing deltas.
+_CACHE_STATUS: dict = {"cache_dir": None, "error": "configure_compile_cache not called"}
+
+
+def cache_status() -> dict:
+    """The last :func:`configure_compile_cache` outcome (copy)."""
+    return dict(_CACHE_STATUS)
 
 
 def probe_accelerator(timeouts_s: Sequence[float] = (90.0, 240.0)) -> dict:
@@ -272,8 +287,10 @@ def configure_compile_cache(base: Optional[str] = None) -> Optional[str]:
     ``$RINGPOP_TPU_COMPILE_CACHE`` or ``<repo root>/.jax_cache`` — so
     bench.py, the test conftest, the driver entries, the watcher's ksweep
     and the simbench children cannot drift.  Returns the directory used,
-    or None when this jax version has no cache flags (the caller runs
-    uncached)."""
+    or None when the cache could not be configured — an unwritable cache
+    dir or missing cache flags no longer no-op SILENTLY: the reason is
+    logged and recorded in :func:`cache_status` (the simbench journal
+    header surfaces it)."""
     import jax
 
     if base is None:
@@ -283,6 +300,14 @@ def configure_compile_cache(base: Optional[str] = None) -> Optional[str]:
         )
     try:
         path = compile_cache_dir(base)
+        # fail HERE, with a diagnosis, if the dir cannot actually take
+        # writes (read-only volume, perms): jax's own writer failures are
+        # async and easy to miss — this probe is what turns "silently
+        # cold every run" into one logged line + a journal-header field
+        probe = os.path.join(path, f".writable.{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("probe")
+        os.remove(probe)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -296,6 +321,13 @@ def configure_compile_cache(base: Optional[str] = None) -> Optional[str]:
             _cc.reset_cache()
         except Exception:  # pragma: no cover - private API moved
             pass
+        _CACHE_STATUS.update(cache_dir=path, error=None)
         return path
-    except Exception:
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        _CACHE_STATUS.update(cache_dir=None, error=reason)
+        _log.warning(
+            "persistent compile cache disabled (base %s): %s — every run "
+            "in this process compiles cold", base, reason,
+        )
         return None

@@ -1,0 +1,301 @@
+"""AOT warm-start executables: serialize compiled sim programs, reload
+them in a fresh process, start warm.
+
+Cold-start compile of the sharded 1M lifecycle program costs tens of
+seconds (SIMBENCH_r05 ``step1m.compile_s`` 26.7 s) and the existing
+``.jax_cache`` persistent compilation cache is best-effort: its key is
+jax-internal (module text + compile options), a miss is silent, and
+nothing in a bench record says whether a number was produced warm or
+cold.  This module is the explicit plane on top:
+
+* every program is keyed by OUR deterministic signature — tag + static
+  config repr + per-leaf aval/sharding descriptors + the r8 toolchain
+  fingerprint (``tests/golden_tools.fp8`` over
+  ``telemetry.toolchain_fingerprint``) + a fingerprint of the
+  ``ringpop_tpu`` package source (an engine edit must never serve the
+  pre-edit executable as a hit) — and stored as a
+  ``jax.export``-serialized artifact under the platform-fingerprinted
+  cache dir (``util/accel.compile_cache_dir`` — the same segmentation
+  that keeps cross-container XLA:CPU kernels unreachable);
+* :func:`load_or_compile` is the one front door: a hit deserializes the
+  artifact and compiles its StableHLO (skipping the python trace +
+  jaxpr→StableHLO lowering entirely; the persistent cache — seeded with
+  exactly this module by the miss path — makes the XLA step a
+  sub-second executable load); a miss exports, compiles, and saves;
+* the returned info dict carries an explicit ``cache_hit`` + measured
+  ``compile_s`` — bench records stop inferring cache state from
+  ``first_s - execute_s`` timing deltas.
+
+Both paths execute the SAME exported program (the miss path compiles
+its own export rather than the original jit), so hit-vs-miss is
+bit-identical by construction; ``scripts/aot_smoke.py`` certifies the
+cross-process reload against an in-process compile per CI run.
+
+The front door must never break a bench: any export/serialize failure
+falls back to the plain jitted callable, with the reason in
+``info["error"]`` and ``cache_hit=False``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("ringpop_tpu.aot")
+
+_REGISTERED = False
+
+
+def _register_serializations() -> None:
+    """Register the sim plane's pytree containers with jax.export so
+    Exported in/out trees round-trip (NamedTuple states + the registered
+    fault pytrees).  Idempotent per process; individual registrations are
+    best-effort because the corresponding module may be absent in a
+    stripped deployment."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    _REGISTERED = True
+    try:
+        from jax import export
+    except ImportError:  # older jax: load_or_compile degrades to plain jit
+        return
+
+    def _named(cls, name):
+        try:
+            export.register_namedtuple_serialization(cls, serialized_name=name)
+        except Exception:  # pragma: no cover - double registration / API drift
+            pass
+
+    try:
+        from ringpop_tpu.sim.delta import DeltaState
+        from ringpop_tpu.sim.lifecycle import LifecycleState
+
+        _named(LifecycleState, "ringpop_tpu.sim.lifecycle.LifecycleState")
+        _named(DeltaState, "ringpop_tpu.sim.delta.DeltaState")
+    except Exception:  # pragma: no cover
+        pass
+    try:
+        from ringpop_tpu.sim.telemetry import TelemetryState
+
+        _named(TelemetryState, "ringpop_tpu.sim.telemetry.TelemetryState")
+    except Exception:  # pragma: no cover
+        pass
+
+
+def toolchain_fp8() -> str:
+    """8-hex digest of the r8 toolchain fingerprint (jax/jaxlib/numpy/
+    python versions) — the same id the fingerprint-keyed goldens use."""
+    import numpy as np  # noqa: F401 - fingerprint import guard
+
+    from ringpop_tpu.sim.telemetry import toolchain_fingerprint
+
+    fp = toolchain_fingerprint()
+    return hashlib.sha256(json.dumps(fp, sort_keys=True).encode()).hexdigest()[:8]
+
+
+_SOURCE_FP8: Optional[str] = None
+
+
+def source_fp8() -> str:
+    """8-hex digest of the ``ringpop_tpu`` package SOURCE — every .py
+    file's content, path-keyed.  Folded into the artifact key so an
+    engine edit on an unchanged toolchain cannot silently reload the
+    pre-edit executable as a "hit": the traced program's code is part of
+    the program's identity, exactly like the toolchain is.  Memoized per
+    process (sources don't change under a running bench)."""
+    global _SOURCE_FP8
+    if _SOURCE_FP8 is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        h = hashlib.sha256()
+        for root, dirs, files in sorted(os.walk(pkg)):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(root, f)
+                h.update(os.path.relpath(path, pkg).encode())
+                try:
+                    with open(path, "rb") as fh:
+                        h.update(fh.read())
+                except OSError:  # pragma: no cover - racing edit/remove
+                    h.update(b"?")
+        _SOURCE_FP8 = h.hexdigest()[:8]
+    return _SOURCE_FP8
+
+
+def default_cache_dir(create: bool = True) -> str:
+    """``<compile-cache fingerprint dir>/aot`` — AOT artifacts live next
+    to the persistent compilation cache entries they seed, under the same
+    platform/CPU-feature fingerprinting (``accel.compile_cache_dir``), so
+    a cross-container artifact is unreachable instead of trusted.
+    Override base via $RINGPOP_TPU_AOT_CACHE."""
+    from ringpop_tpu.util.accel import compile_cache_dir
+
+    base = os.environ.get("RINGPOP_TPU_AOT_CACHE") or os.environ.get(
+        "RINGPOP_TPU_COMPILE_CACHE"
+    ) or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    path = os.path.join(compile_cache_dir(base, create=create), "aot")
+    if create:
+        os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _leaf_descriptor(leaf) -> str:
+    """Stable signature bit for one argument leaf: aval shape/dtype plus
+    the device-mesh placement (axis names + shape + spec) when sharded —
+    the same program on a different mesh is a different executable."""
+    import jax
+
+    aval = jax.api_util.shaped_abstractify(leaf)
+    desc = f"{aval.dtype}{list(aval.shape)}"
+    sh = getattr(leaf, "sharding", None)
+    if sh is not None:
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None):
+            desc += f"@{dict(mesh.shape)}:{getattr(sh, 'spec', '')}"
+    return desc
+
+
+def signature_key(tag: str, statics, leaves) -> str:
+    """16-hex deterministic key: tag + static config reprs + leaf
+    descriptors + toolchain fingerprint + package-source fingerprint
+    (a source edit must never serve the pre-edit executable)."""
+    bits = [tag, toolchain_fp8(), source_fp8()]
+    bits += [repr(s) for s in statics]
+    bits += [_leaf_descriptor(x) for x in leaves]
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:16]
+
+
+def load_or_compile(
+    fn: Callable,
+    *args,
+    tag: str,
+    static_kw: Optional[dict] = None,
+    dyn_kw: Optional[dict] = None,
+    statics: tuple = (),
+    cache_dir: Optional[str] = None,
+    save: bool = True,
+) -> tuple:
+    """The load-or-compile front door.  Returns ``(call, info)``.
+
+    ``fn`` is called as ``fn(*args, **dyn_kw, **static_kw)``; ``args`` and
+    ``dyn_kw`` are traced pytrees (their leaves + ``statics`` +
+    ``static_kw`` + the toolchain fingerprint form the artifact key),
+    ``static_kw`` is closed over (compile-time constants like
+    ``ticks=``).  ``call(*args2, **dyn_kw2)`` then executes the program
+    on any same-structure inputs.
+
+    ``info``: ``cache_hit`` (an artifact existed and loaded),
+    ``compile_s`` (deserialize+XLA time on a hit; export+compile on a
+    miss), ``key``/``path``/``cache_dir``, ``saved``, and ``error`` when
+    the export plane failed and the plain jit path was used instead.
+    """
+    import jax
+
+    _register_serializations()
+    # the hit path's XLA step is only a sub-second executable LOAD when
+    # the persistent compilation cache is live (the miss path seeds it
+    # with exactly the exported module a later hit compiles) — entry
+    # points configure it themselves, but the front door must not depend
+    # on that ordering
+    if not jax.config.jax_compilation_cache_dir:
+        from ringpop_tpu.util.accel import configure_compile_cache
+
+        configure_compile_cache()
+    static_kw = static_kw or {}
+    dyn_kw = dyn_kw or {}
+    leaves, in_tree = jax.tree.flatten((args, dyn_kw))
+    info: dict = {
+        "tag": tag,
+        "cache_hit": False,
+        "compile_s": None,
+        "saved": False,
+        "error": None,
+    }
+
+    def plain(*a, **dk):
+        return fn(*a, **dk, **static_kw)
+
+    try:
+        key = signature_key(
+            tag, tuple(statics) + (repr(sorted(static_kw.items())),), leaves
+        )
+        cdir = cache_dir or default_cache_dir()
+        path = os.path.join(cdir, f"{tag}-{key}.jexp")
+        info.update(key=key, path=path, cache_dir=cdir)
+    except Exception as e:  # pragma: no cover - fingerprint/backendless envs
+        info["error"] = f"keying failed: {type(e).__name__}: {e}"
+        log.warning("aot %s: %s — running uncached", tag, info["error"])
+        return plain, info
+
+    def flat_fn(*flat_leaves):
+        a, dk = jax.tree.unflatten(in_tree, flat_leaves)
+        return plain(*a, **dk)
+
+    try:
+        from jax import export
+    except ImportError as e:  # older jax: no export plane
+        info["error"] = f"jax.export unavailable: {e}"
+        log.warning("aot %s: %s — running uncached", tag, info["error"])
+        return plain, info
+
+    compiled = None
+    if os.path.exists(path):
+        try:
+            t0 = time.perf_counter()
+            with open(path, "rb") as f:
+                exported = export.deserialize(bytearray(f.read()))
+            compiled = jax.jit(exported.call).lower(*leaves).compile()
+            info["compile_s"] = round(time.perf_counter() - t0, 3)
+            info["cache_hit"] = True
+        except Exception as e:
+            compiled = None
+            info["error"] = f"load failed: {type(e).__name__}: {e}"
+            log.warning(
+                "aot %s: artifact %s unusable (%s) — recompiling",
+                tag, path, info["error"],
+            )
+    if compiled is None:
+        try:
+            t0 = time.perf_counter()
+            exported = export.export(jax.jit(flat_fn))(*leaves)
+            blob = exported.serialize()
+            compiled = jax.jit(exported.call).lower(*leaves).compile()
+            info["compile_s"] = round(time.perf_counter() - t0, 3)
+            if save:
+                try:
+                    tmp = path + f".tmp{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(bytes(blob))
+                    os.replace(tmp, path)
+                    info["saved"] = True
+                except OSError as e:
+                    info["error"] = f"save failed: {type(e).__name__}: {e}"
+                    log.warning("aot %s: %s (artifact not persisted)", tag, info["error"])
+        except Exception as e:
+            info["error"] = f"export failed: {type(e).__name__}: {e}"
+            log.warning(
+                "aot %s: %s — falling back to the plain jit path", tag, info["error"]
+            )
+            return plain, info
+
+    expect_desc = [_leaf_descriptor(x) for x in leaves]
+
+    def call(*a, **dk):
+        flat, tree2 = jax.tree.flatten((a, dk))
+        if tree2 != in_tree or [_leaf_descriptor(x) for x in flat] != expect_desc:
+            # structure OR leaf aval drifted from the keyed program (a
+            # different faults pytree, a different n) — the fixed
+            # executable cannot serve it; trace fresh like plain jit would
+            return plain(*a, **dk)
+        return compiled(*flat)
+
+    return call, info

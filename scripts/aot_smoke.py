@@ -1,0 +1,182 @@
+"""aot-smoke — the CI gate for the AOT warm-start plane (util/aot.py).
+
+Proves, per run, the property the r11 bench integrations rely on:
+
+1. **serialize**: this process routes a sharded lifecycle tick block
+   through ``aot.load_or_compile`` against a FRESH cache dir (a miss by
+   construction), runs one block, and digests the result;
+2. **reload warm in a fresh process**: a subprocess loads the SAME
+   program through the front door — it must report ``cache_hit=True``
+   with ``compile_s`` under the 2 s warm-start bar — runs the same
+   block, and prints its digest;
+3. **bit-identity**: the child's digest must equal the parent's
+   in-process one (a reloaded executable computes exactly what the
+   compile it came from computed), and the front-door output must be
+   bit-equal to the plain jitted path, leaf for leaf.
+
+The pipelined exchange (the r11 default sharded lowering) is what gets
+serialized, so this gate also re-certifies that the pipelined program
+survives an export round-trip.  Exit 0 on success, 1 with a diagnosis.
+
+Usage:
+    python scripts/aot_smoke.py [--cache DIR] [--warm-bar SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N, K, SEED, TICKS = 2048, 64, 0, 8
+
+
+def _run_block(cache_dir: str) -> dict:
+    """Route the sharded block through the front door; return the
+    front-door info + state digest + leaf-equality vs the plain jit."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    jax.config.update("jax_platforms", "cpu")
+    from ringpop_tpu.sim import lifecycle, telemetry
+    from ringpop_tpu.sim.delta import DeltaFaults
+    from ringpop_tpu.util import aot
+
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("node", "rumor"))
+    params = lifecycle.LifecycleParams(
+        n=N, k=K, suspect_ticks=10, rng="counter", exchange_mesh=mesh
+    )
+    up = np.ones(N, bool)
+    up[::64] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    state = jax.tree.map(
+        jax.device_put,
+        lifecycle.init_state(params, seed=SEED),
+        lifecycle.state_shardings(mesh, k=K),
+    )
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    call, info = aot.load_or_compile(
+        blk, state, faults, tag="aot-smoke", static_kw={"ticks": TICKS},
+        statics=(repr(params),), cache_dir=cache_dir,
+    )
+    out = call(state, faults)
+    jax.block_until_ready(out.learned)
+    ref = blk(state, faults, ticks=TICKS)
+    leaf_equal = all(
+        bool((np.asarray(a) == np.asarray(b)).all())
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out))
+    )
+    return {
+        "cache_hit": info["cache_hit"],
+        "compile_s": info["compile_s"],
+        "saved": info["saved"],
+        "error": info["error"],
+        "digest": int(telemetry.tree_digest(out)),
+        "leaf_equal_vs_jit": leaf_equal,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cache", default=None,
+                    help="AOT cache dir (default: a fresh temp dir, so the "
+                    "first pass is a miss by construction)")
+    ap.add_argument("--warm-bar", type=float, default=2.0,
+                    help="max seconds for the fresh-process warm load")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        print("AOTSMOKE " + json.dumps(_run_block(args.cache)), flush=True)
+        return 0
+
+    own_cache = args.cache is None
+    cache = args.cache or tempfile.mkdtemp(prefix="aotsmoke_")
+    try:
+        return _smoke(cache, args)
+    finally:
+        if own_cache:  # don't leak one artifact dir per `make test` run
+            import shutil
+
+            shutil.rmtree(cache, ignore_errors=True)
+
+
+def _smoke(cache: str, args) -> int:
+    failures: list[str] = []
+
+    first = _run_block(cache)
+    if first["error"]:
+        failures.append(f"front door errored on the serialize pass: {first['error']}")
+    if not first["cache_hit"] and not first["saved"] and not first["error"]:
+        failures.append("miss pass saved no artifact and reported no error")
+    if not first["leaf_equal_vs_jit"]:
+        failures.append("front-door output diverged from the plain jitted block")
+
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "--cache", cache],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    child = None
+    for ln in reversed(r.stdout.strip().splitlines()):
+        if ln.startswith("AOTSMOKE "):
+            child = json.loads(ln[len("AOTSMOKE "):])
+            break
+    if child is None:
+        failures.append(
+            f"fresh-process reload produced no result (rc={r.returncode}): "
+            + (r.stderr or "")[-300:]
+        )
+    else:
+        if child["error"]:
+            failures.append(f"fresh-process front door errored: {child['error']}")
+        if not child["cache_hit"]:
+            failures.append("fresh process MISSED the cache — the artifact key "
+                            "is unstable across processes")
+        elif child["compile_s"] is None or child["compile_s"] > args.warm_bar:
+            failures.append(
+                f"warm reload took {child['compile_s']} s (bar {args.warm_bar} s) "
+                "— the serialized-executable path stopped being warm"
+            )
+        if child["digest"] != first["digest"]:
+            failures.append(
+                f"reloaded executable diverged: digest {child['digest']:#010x} "
+                f"vs in-process {first['digest']:#010x}"
+            )
+        if not child["leaf_equal_vs_jit"]:
+            failures.append("reloaded output diverged from a fresh in-process compile")
+
+    if failures:
+        print("aot-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(
+        f"aot-smoke: OK — serialized at {cache} "
+        f"(miss compile {first['compile_s']} s), fresh process reloaded warm "
+        f"in {child['compile_s']} s (< {args.warm_bar} s) with bit-identical "
+        f"block digest {child['digest']:#010x}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,14 @@ story"):
   says the shard_map legs move ~2.6× fewer exchange bytes, so on real
   ICI they must be no slower (and should be faster); slower REFUTES the
   lowering, as does any bit-inequality.
+- (r11) the pipelined-exchange A/B: ``pipelined_exchange`` — the fused
+  leg loop (``shard_roll_pipelined``, response-leg sends issued while
+  the request merge computes) vs the sequential r8 legs.  The census
+  says both move IDENTICAL collective counts/bytes, so the model
+  predicts the pipelined side is no slower — any win is overlap the
+  schedule now hides.  Slower beyond noise REFUTES the pipelining (the
+  fused switch costs more than the overlap buys), as does any
+  bit-inequality.
 
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
@@ -177,6 +185,24 @@ def main() -> int:
         )
     elif "error" in se:
         verdicts.append(("sharded exchange legs", None, se["error"]))
+    # the r11 pipelined-exchange A/B: census-identical traffic, so the
+    # pipelined legs must be bit-equal and no slower than sequential —
+    # faster is the overlap window actually cashing out on real ICI
+    pe = cap.get("pipelined_exchange") or {}
+    if pe.get("pipelined_ms_per_tick_median") is not None and pe.get(
+        "sequential_ms_per_tick_median"
+    ) is not None:
+        p_ms, s_ms = pe["pipelined_ms_per_tick_median"], pe["sequential_ms_per_tick_median"]
+        ok = bool(pe.get("bit_equal")) and p_ms <= s_ms * 1.05
+        verdicts.append(
+            (f"pipelined exchange legs ({pe.get('n_devices')} chips, k={pe.get('k')})",
+             ok,
+             f"pipelined {p_ms} vs sequential {s_ms} ms/tick "
+             f"(overlap win {round(s_ms - p_ms, 3)} ms/tick), "
+             f"bit_equal={pe.get('bit_equal')}")
+        )
+    elif "error" in pe:
+        verdicts.append(("pipelined exchange legs", None, pe["error"]))
     prof = next(
         ((p, budget) for p, budget in
          ((os.path.join(REPO, "captures", f), b) for f, b in BUDGET_CAPTURES)

@@ -43,6 +43,13 @@ def main() -> int:
     import numpy as np
 
     from ringpop_tpu.sim import chaos, lifecycle, telemetry
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    # before the journal opens: the header's compile_cache field snapshots
+    # accel.cache_status(), which only reflects reality once the cache is
+    # actually configured (aot.load_or_compile would otherwise configure
+    # it mid-run, after the header was already written)
+    configure_compile_cache()
 
     path = args.out or os.path.join(
         tempfile.mkdtemp(prefix="chaossmoke_"), "chaos_smoke.jsonl"
@@ -51,12 +58,22 @@ def main() -> int:
     plan = chaos.scenario_plan("smoke", n, seed=seed, horizon=horizon)
     failures: list[str] = []
 
+    aot_infos = {}
+
     def run(sink):
+        # aot="chaos-smoke": the block program goes through the AOT
+        # warm-start front door (util/aot.py) — first-ever run serializes
+        # the executable, every later chaos-smoke (same toolchain) starts
+        # warm; values are bit-identical either way (the on/off digest
+        # pairing below runs THROUGH this path, so it re-certifies that
+        # each CI run)
         sim = lifecycle.LifecycleSim(
-            n=n, k=k, seed=seed, suspect_ticks=8, rng="counter", telemetry=sink
+            n=n, k=k, seed=seed, suspect_ticks=8, rng="counter", telemetry=sink,
+            aot="chaos-smoke",
         )
         for _ in range(horizon // block):
             sim.run(block, plan)
+        aot_infos.update(sim.aot_info)
         return sim.state
 
     with telemetry.TelemetryJournal(path) as journal:
@@ -117,12 +134,17 @@ def main() -> int:
         for f in failures:
             print("  -", f)
         return 1
+    aot_line = "; ".join(
+        f"{t}: {'warm' if i['cache_hit'] else 'cold'} compile {i['compile_s']}s"
+        + (f" ({i['error']})" if i["error"] else "")
+        for t, i in sorted(aot_infos.items())
+    )
     print(
         f"chaos-smoke: OK — {len(blocks)} blocks + 1 score journaled at {path}; "
         f"ttd_median={score['time_to_detect_median']} "
         f"fp_suspects={score['false_positive_suspects']} "
         f"rejoin={score['rejoin_convergence_ticks']}; "
-        f"telemetry-on digest-equal to off ({d_on:#010x})"
+        f"telemetry-on digest-equal to off ({d_on:#010x}); aot {aot_line}"
     )
     return 0
 

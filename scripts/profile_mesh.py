@@ -108,10 +108,21 @@ def main() -> None:
         "'threefry' reproduces the r6/r7 program",
     )
     ap.add_argument(
-        "--exchange", choices=("shardmap", "gspmd"), default="shardmap",
-        help="shift-exchange roll-leg lowering: 'shardmap' = the shard-local "
-        "crossing-block ppermutes (default), 'gspmd' = the r6/r7 "
+        "--exchange", choices=("shardmap", "shardmap-seq", "gspmd"),
+        default="shardmap",
+        help="shift-exchange roll-leg lowering: 'shardmap' = the r11 fused "
+        "pipelined crossing-block ppermutes (default), 'shardmap-seq' = the "
+        "sequential r8 legs (two shard_roll regions), 'gspmd' = the r6/r7 "
         "partitioner-inferred all-gathers",
+    )
+    ap.add_argument(
+        "--overlap", action="store_true",
+        help="analyze the compiled step's exchange schedule "
+        "(analysis/overlap.py): report whether response-leg crossing sends "
+        "depend only on partial request-leg receives and interleave with "
+        "the merge.  With the default pipelined exchange, NO overlap is a "
+        "failure (exit 5) — the fused leg loop stopped emitting an "
+        "overlappable dependency graph",
     )
     ap.add_argument(
         "--chaos", action="store_true",
@@ -204,8 +215,9 @@ def _run(args, dump: str) -> int:
         "chaos": bool(args.chaos),
     }
     engine_kw = dict(rng=args.rng)
-    if args.exchange == "shardmap":
+    if args.exchange in ("shardmap", "shardmap-seq"):
         engine_kw["exchange_mesh"] = mesh
+        engine_kw["exchange_pipelined"] = args.exchange == "shardmap"
 
     # -- 1) one-tick step at headline scale --------------------------------
     n, k = args.step_n, args.step_k
@@ -252,6 +264,22 @@ def _run(args, dump: str) -> int:
             for c, rows in census["computations"].items()
         },
     }
+
+    # -- 1b) exchange overlap schedule (r11, --overlap): analyzed on the
+    # step module BEFORE section 2 clears the dump dir
+    overlap_rc = 0
+    if args.overlap:
+        from ringpop_tpu.analysis import overlap as _overlap
+
+        rep = _overlap.analyze(mod)
+        report["overlap"] = rep
+        _overlap.print_report(rep)
+        if args.exchange == "shardmap" and not rep["overlap"]:
+            print("profile_mesh: --overlap: the PIPELINED exchange compiled "
+                  "to a strictly sequential schedule — shard_roll_pipelined "
+                  "stopped issuing leg-2 sends off partial receives",
+                  file=sys.stderr)
+            overlap_rc = 5
 
     # -- 2) the sharded detect program (serialization question) ------------
     for f in glob.glob(os.path.join(dump, "*")):
@@ -331,9 +359,10 @@ def _run(args, dump: str) -> int:
     print(json.dumps({"profile_mesh": {k2: report[k2]["by_kind"]
                                        for k2 in ("step", "detect")}}))
     if args.compare:
-        return _compare(report, args.compare, args.tolerance,
-                        phase_budget=args.phase_budget)
-    return 0
+        rc = _compare(report, args.compare, args.tolerance,
+                      phase_budget=args.phase_budget)
+        return rc or overlap_rc
+    return overlap_rc
 
 
 def _compare(report: dict, base_path: str, tol: float,

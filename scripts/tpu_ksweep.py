@@ -287,6 +287,82 @@ def main() -> None:
             ] = f"{type(e).__name__}: {e}"[:300]
         flush()
 
+        # -- 1c2: the r11 pipelined-exchange A/B — the fused leg loop
+        # (shard_roll_pipelined: response-leg ppermutes issued while the
+        # request-leg merge computes) vs the sequential r8 legs, same
+        # counter RNG and H both sides so ONLY the leg scheduling differs.
+        # The census says the two move IDENTICAL collective counts/bytes,
+        # so any delta here is pure overlap: the pipelined side should be
+        # no slower, and faster by up to the crossing-send latency the
+        # schedule now hides.  certify_cost_model judges the pair (and
+        # the bit_equal flag) alongside the r8 exchange A/B.
+        try:
+            import functools as _ft
+
+            from jax.sharding import Mesh
+
+            from ringpop_tpu.parallel.mesh import with_exchange_mesh
+
+            n_dev = len(jax.devices())
+            # ALL devices on the node axis: the exchange legs live on the
+            # node axis, and with_exchange_mesh no-ops on a <=1-way node
+            # axis — a (1, 2) mesh would silently time the SAME gather
+            # program on both sides and certify nothing
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev, 1),
+                ("node", "rumor"),
+            )
+            k = 256
+            base_p = lifecycle.LifecycleParams(
+                n=n, k=k, suspect_ticks=10, rng="counter"
+            )
+            if with_exchange_mesh(base_p, mesh).exchange_mesh is None:
+                raise RuntimeError(
+                    "exchange-mesh binding no-opped (node axis <= 1) — "
+                    "the A/B would time the same program twice"
+                )
+            sec = {"n": n, "k": k, "n_devices": n_dev,
+                   "node_shards": n_dev, "block_ticks": block}
+            out["pipelined_exchange"] = sec
+            finals = {}
+            for label, p in (
+                ("sequential", with_exchange_mesh(base_p, mesh, pipelined=False)),
+                ("pipelined", with_exchange_mesh(base_p, mesh, pipelined=True)),
+            ):
+                sstate = jax.tree.map(
+                    jax.device_put,
+                    lifecycle.init_state(p, seed=0),
+                    lifecycle.state_shardings(mesh, k=k),
+                )
+                blk_fn = jax.jit(
+                    _ft.partial(lifecycle._run_block, p), static_argnames="ticks"
+                )
+                sstate = blk_fn(sstate, faults, ticks=block)
+                jax.block_until_ready(sstate.learned)  # compile + warm
+                per_rep = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    sstate = blk_fn(sstate, faults, ticks=block)
+                    jax.block_until_ready(sstate.learned)
+                    per_rep.append(time.perf_counter() - t0)
+                finals[label] = sstate
+                sec[f"{label}_ms_per_tick_median"] = round(
+                    sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+                )
+                flush()
+            sec["bit_equal"] = all(
+                bool((np.asarray(a) == np.asarray(b)).all())
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(finals["sequential"]),
+                    jax.tree_util.tree_leaves(finals["pipelined"]),
+                )
+            )
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            out.setdefault("pipelined_exchange", {})[
+                "error"
+            ] = f"{type(e).__name__}: {e}"[:300]
+        flush()
+
     # -- 1d: chaos_tick — the churn+flap-enabled tick vs the plain tick ----
     # (sim/chaos.py FaultPlan evaluated inside the jitted step).  The CPU
     # census says fault-timeline evaluation adds zero collectives and the

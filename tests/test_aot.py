@@ -1,0 +1,157 @@
+"""util/aot.py — the AOT warm-start front door's contracts.
+
+The cross-process reload + warm-bar + digest certificate lives in
+``scripts/aot_smoke.py`` (``make aot-smoke``); this suite pins the
+in-process semantics: miss→save→hit flow, bit-identity against the
+plain jit path, key sensitivity to shape/config, graceful degradation
+on an unwritable cache dir and on argument-structure drift.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import lifecycle
+from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.util import aot
+
+
+@pytest.fixture()
+def small_block():
+    params = lifecycle.LifecycleParams(n=256, k=32, suspect_ticks=5, rng="counter")
+    state = lifecycle.init_state(params, seed=0)
+    up = np.ones(params.n, bool)
+    up[::16] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    blk = jax.jit(
+        functools.partial(lifecycle._run_block, params), static_argnames="ticks"
+    )
+    return params, state, faults, blk
+
+
+def test_miss_then_hit_bit_identical(small_block, tmp_path):
+    params, state, faults, blk = small_block
+    kw = dict(tag="t-roundtrip", static_kw={"ticks": 3},
+              statics=(repr(params),), cache_dir=str(tmp_path))
+    call, info = aot.load_or_compile(blk, state, faults, **kw)
+    assert not info["cache_hit"] and info["saved"] and info["error"] is None
+    assert os.path.exists(info["path"])
+    out = call(state, faults)
+    ref = blk(state, faults, ticks=3)
+    assert type(out) is type(ref)  # pytree structure survives the export
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+    call2, info2 = aot.load_or_compile(blk, state, faults, **kw)
+    assert info2["cache_hit"] and info2["error"] is None
+    out2 = call2(state, faults)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out2)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+
+
+def test_key_sensitive_to_shape_statics_and_ticks(small_block, tmp_path):
+    params, state, faults, blk = small_block
+    _, a = aot.load_or_compile(
+        blk, state, faults, tag="t-key", static_kw={"ticks": 2},
+        statics=(repr(params),), cache_dir=str(tmp_path), save=False)
+    _, b = aot.load_or_compile(
+        blk, state, faults, tag="t-key", static_kw={"ticks": 4},
+        statics=(repr(params),), cache_dir=str(tmp_path), save=False)
+    assert a["key"] != b["key"]  # static kwargs key the program
+    p2 = lifecycle.LifecycleParams(n=512, k=32, suspect_ticks=5, rng="counter")
+    s2 = lifecycle.init_state(p2, seed=0)
+    f2 = DeltaFaults(up=jnp.ones(512, bool))
+    blk2 = jax.jit(
+        functools.partial(lifecycle._run_block, p2), static_argnames="ticks"
+    )
+    _, c = aot.load_or_compile(
+        blk2, s2, f2, tag="t-key", static_kw={"ticks": 2},
+        statics=(repr(p2),), cache_dir=str(tmp_path), save=False)
+    assert c["key"] not in (a["key"], b["key"])  # shapes/config key it too
+
+
+def test_key_sensitive_to_package_source(small_block, tmp_path, monkeypatch):
+    """An engine edit (simulated by swapping the memoized source
+    fingerprint) must invalidate every artifact — a stale pre-edit
+    executable can never be served as a hit."""
+    params, state, faults, blk = small_block
+    kw = dict(tag="t-src", static_kw={"ticks": 2},
+              statics=(repr(params),), cache_dir=str(tmp_path), save=False)
+    _, a = aot.load_or_compile(blk, state, faults, **kw)
+    monkeypatch.setattr(aot, "_SOURCE_FP8", "deadbeef")
+    _, b = aot.load_or_compile(blk, state, faults, **kw)
+    assert a["key"] != b["key"]
+
+
+def test_unwritable_cache_dir_degrades_gracefully(small_block, tmp_path):
+    """A save failure must not break the call — the program still runs,
+    the record says why nothing persisted."""
+    params, state, faults, blk = small_block
+    # a FILE where a directory is expected: unwritable even for root
+    # (chmod-based denial is a no-op under uid 0, which CI runs as)
+    ro = tmp_path / "ro"
+    ro.write_text("not a directory")
+    call, info = aot.load_or_compile(
+        blk, state, faults, tag="t-ro", static_kw={"ticks": 2},
+        statics=(repr(params),), cache_dir=str(ro))
+    assert not info["saved"] and not info["cache_hit"]
+    assert info["error"] and "save failed" in info["error"]
+    out = call(state, faults)
+    ref = blk(state, faults, ticks=2)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+
+
+def test_structure_drift_falls_back_to_plain_path(small_block, tmp_path):
+    """Calling the returned runner with a different faults pytree
+    structure (None legs vs arrays) re-traces instead of mis-feeding the
+    keyed executable."""
+    params, state, faults, blk = small_block
+    call, info = aot.load_or_compile(
+        blk, state, faults, tag="t-drift", static_kw={"ticks": 2},
+        statics=(repr(params),), cache_dir=str(tmp_path))
+    drifted = DeltaFaults()  # all-None: different leaf structure
+    out = call(state, drifted)
+    ref = blk(state, drifted, ticks=2)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+
+
+def test_corrupt_artifact_recompiles(small_block, tmp_path):
+    params, state, faults, blk = small_block
+    kw = dict(tag="t-corrupt", static_kw={"ticks": 2},
+              statics=(repr(params),), cache_dir=str(tmp_path))
+    _, info = aot.load_or_compile(blk, state, faults, **kw)
+    with open(info["path"], "wb") as f:
+        f.write(b"not an exported program")
+    call, info2 = aot.load_or_compile(blk, state, faults, **kw)
+    assert not info2["cache_hit"] and info2["error"] and "load failed" in info2["error"]
+    out = call(state, faults)
+    ref = blk(state, faults, ticks=2)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        assert bool((np.asarray(a) == np.asarray(b)).all())
+
+
+def test_accel_cache_status_records_unwritable_base(tmp_path, monkeypatch):
+    """Satellite: configure_compile_cache on an unwritable base logs +
+    records the reason instead of silently no-opping."""
+    from ringpop_tpu.util import accel
+
+    # a file where the base dir should be: mkdir fails even as root
+    ro = tmp_path / "robase"
+    ro.write_text("not a directory")
+    try:
+        got = accel.configure_compile_cache(str(ro))
+        assert got is None
+        status = accel.cache_status()
+        assert status["cache_dir"] is None
+        assert status["error"]  # the reason is recorded for the journal header
+    finally:
+        # restore the shared test cache for the rest of the suite
+        accel.configure_compile_cache()
+        assert accel.cache_status()["error"] is None

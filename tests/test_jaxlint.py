@@ -11,7 +11,7 @@ declare ``JAXLINT_TRACE_RULE`` + ``build()`` and run through
 uses, so `make lint` pointed at a trip case provably exits non-zero.
 
 The repo-at-HEAD tests are the real gate: plane 1 over the default sweep
-and plane 2 over the seven public entry points (dense + 8-way virtual
+and plane 2 over the nine public entry points (dense + 8-way virtual
 mesh) must be clean modulo the justified waivers in
 ``analysis/waivers.toml`` — tier-1 fails the moment an engine edit
 reintroduces a threefry bypass, a forbidden-phase collective, or a
@@ -180,7 +180,8 @@ def test_repo_plane1_clean_at_head():
 
 
 def test_repo_plane2_jaxpr_clean_at_head():
-    """The seven entry points (incl. the chaos-enabled steps), dense +
+    """The nine entry points (incl. the chaos-enabled and r11 sequential-
+    exchange steps), dense +
     sharded: no f64, no callbacks,
     confinement holds, donation aliases, sharded == unsharded modulo
     sharding ops — the acceptance bar of the jaxpr plane."""

@@ -1,0 +1,207 @@
+"""r11 exchange-pipelining certificates (parallel/shift.py).
+
+Pins, on the virtual 8-device mesh:
+
+* the mod-n shift contract — ``shard_roll`` now accepts any int32 shift
+  (>= n, negative) and matches ``jnp.roll`` exactly (the r8 version was
+  only pinned on [0, n));
+* the sub-block factor H as a parameter: H ∈ {2, 4} sweeps bit-identical
+  to ``jnp.roll``, with the (H+1)-sends-per-rolled-leaf-per-leg census
+  floor visible in the traced program, and the historical fallback to
+  H=1 when H does not divide the shard block;
+* ``shard_roll_pipelined`` — the fused two-leg region — bit-identical to
+  the sequential composition (roll, merge, roll back) over an exhaustive
+  shift sweep, and at engine level: the pipelined lifecycle/delta steps
+  land bit-equal to the sequential-leg steps tick for tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ringpop_tpu.parallel.shift import shard_roll, shard_roll_pipelined
+from ringpop_tpu.sim import delta, lifecycle
+from ringpop_tpu.sim.delta import DeltaFaults
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(4, 2)
+    return Mesh(devs, ("node", "rumor"))
+
+
+def _planes(n=64, w=4):
+    x = jnp.arange(n * w, dtype=jnp.uint32).reshape(n, w)
+    v = jnp.arange(n, dtype=jnp.int32) * 7
+    learned = x ^ jnp.uint32(0xA5A5)
+    ride = (x * jnp.uint32(2654435761)) | jnp.uint32(1)
+    return x, v, learned, ride
+
+
+WSPEC, VSPEC = P("node", "rumor"), P("node")
+
+
+@pytest.mark.parametrize("h", [2, 4])
+def test_shard_roll_mod_n_contract(mesh, h):
+    """Shifts >= n, negative, and multiples of n all follow jnp.roll's
+    mod-n semantics — the contract tests used to leave unpinned."""
+    x, v, _, _ = _planes()
+    n = x.shape[0]
+    roll = jax.jit(
+        lambda x, v, s: shard_roll((x, v), s, mesh, "node", (WSPEC, VSPEC), h=h)
+    )
+    for s in [0, 1, n - 1, n, n + 3, 2 * n, 2 * n + 5, -1, -n, -n - 7, 3 * n + 3]:
+        a, b = roll(x, v, jnp.int32(s))
+        assert bool((a == jnp.roll(x, s, axis=0)).all()), (h, s)
+        assert bool((b == jnp.roll(v, s, axis=0)).all()), (h, s)
+
+
+@pytest.mark.parametrize("h", [2, 4])
+def test_shard_roll_h_sweep_bit_identity(mesh, h):
+    """Every shift class of the H decomposition matches jnp.roll."""
+    x, v, _, _ = _planes()
+    n = x.shape[0]
+    roll = jax.jit(
+        lambda x, v, s: shard_roll((x, v), s, mesh, "node", (WSPEC, VSPEC), h=h)
+    )
+    for s in range(n):
+        a, b = roll(x, v, jnp.int32(s))
+        assert bool((a == jnp.roll(x, s, axis=0)).all()), (h, s)
+        assert bool((b == jnp.roll(v, s, axis=0)).all()), (h, s)
+
+
+def _branch_ppermute_counts(closed) -> list:
+    """Per-switch-branch ppermute counts of a traced program."""
+    from ringpop_tpu.analysis.trace_checks import _sub_jaxprs
+
+    def count(jaxpr):
+        c = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "ppermute":
+                c += 1
+            for sub in _sub_jaxprs(eqn):
+                c += count(sub)
+        return c
+
+    counts = []
+
+    def rec(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "cond":
+                for br in eqn.params["branches"]:
+                    counts.append(count(br.jaxpr))
+            else:
+                for sub in _sub_jaxprs(eqn):
+                    rec(sub)
+
+    rec(closed.jaxpr)
+    return counts
+
+
+@pytest.mark.parametrize("h", [2, 4])
+def test_send_count_is_h_plus_one_per_leg(mesh, h):
+    """The census floor of the decomposition: every switch branch sends
+    at most H+1 ppermutes per rolled leaf, and the worst branch sends
+    exactly H+1 (one per window sub-block; self-sends skipped)."""
+    x, _, _, _ = _planes()
+    closed = jax.make_jaxpr(
+        lambda x, s: shard_roll((x,), s, mesh, "node", (WSPEC,), h=h)
+    )(x, jnp.int32(3))
+    counts = _branch_ppermute_counts(closed)
+    s_shards = mesh.shape["node"]
+    assert len(counts) == h * s_shards  # one branch per quotient class
+    assert max(counts) == h + 1
+    assert all(c <= h + 1 for c in counts)
+
+
+def test_h_fallback_when_not_dividing(mesh):
+    """H that does not divide the shard block falls back to 1 (the
+    historical odd-block behavior) instead of mis-slicing."""
+    x, v, _, _ = _planes(n=40)  # nb = 10, not divisible by 4
+    roll = jax.jit(
+        lambda x, v, s: shard_roll((x, v), s, mesh, "node", (WSPEC, VSPEC), h=4)
+    )
+    for s in [0, 3, 17, 39, 41, -2]:
+        a, b = roll(x, v, jnp.int32(s))
+        assert bool((a == jnp.roll(x, s, axis=0)).all()), s
+    closed = jax.make_jaxpr(
+        lambda x, s: shard_roll((x,), s, mesh, "node", (WSPEC,), h=4)
+    )(x, jnp.int32(3))
+    counts = _branch_ppermute_counts(closed)
+    assert max(counts) == 2  # H=1 ⇒ H+1 = 2 sends per leaf
+
+
+@pytest.mark.parametrize("h", [2, 4])
+def test_pipelined_matches_sequential_composition(mesh, h):
+    """Exhaustive shift sweep: the fused two-leg region's outputs equal
+    the sequential composition roll → elementwise merge → roll back,
+    bit for bit, in every (quotient, remainder==0) branch class."""
+    x, v, learned, ride = _planes()
+    n = x.shape[0]
+
+    def leg2(inb, gp, lrn, rd):
+        return (lrn | inb) & rd
+
+    pipe = jax.jit(
+        lambda x, v, l, r, s: shard_roll_pipelined(
+            (x, v), s, mesh, "node", (WSPEC, VSPEC),
+            carry=(l, r), carry_specs=(WSPEC, WSPEC),
+            leg2_of=leg2, spec2=WSPEC, h=h,
+        )
+    )
+    for s in list(range(n)) + [n, n + 5, -3, 2 * n + 1]:
+        a, b, resp = pipe(x, v, learned, ride, jnp.int32(s))
+        ra = jnp.roll(x, s, axis=0)
+        assert bool((a == ra).all()), (h, s)
+        assert bool((b == jnp.roll(v, s, axis=0)).all()), (h, s)
+        exp = jnp.roll((learned | ra) & ride, -s, axis=0)
+        assert bool((resp == exp).all()), (h, s)
+
+
+@pytest.mark.parametrize("engine", ["lifecycle", "delta"])
+@pytest.mark.parametrize("h", [2, 4])
+def test_engine_pipelined_bit_equal_to_sequential(mesh, engine, h):
+    """Engine level: the pipelined exchange steps land bit-equal to the
+    sequential r8 legs tick for tick (fresh shift class per tick), for
+    both engines and both H settings."""
+    n, k = 4096, 64
+    if engine == "lifecycle":
+        base = lifecycle.LifecycleParams(
+            n=n, k=k, suspect_ticks=10, rng="counter",
+            exchange_mesh=mesh, exchange_h=h,
+        )
+        state = jax.tree.map(
+            jax.device_put,
+            lifecycle.init_state(base, seed=0),
+            lifecycle.state_shardings(mesh, k=k),
+        )
+        step_fn = lifecycle.step
+    else:
+        from ringpop_tpu.parallel.mesh import shard_delta_state
+
+        base = delta.DeltaParams(
+            n=n, k=k, rng="counter", exchange_mesh=mesh, exchange_h=h
+        )
+        state = shard_delta_state(delta.init_state(base, seed=0), mesh)
+        step_fn = delta.step
+    up = np.ones(n, bool)
+    up[::64] = False
+    faults = DeltaFaults(up=jnp.asarray(up))
+    pipe = jax.jit(functools.partial(
+        step_fn, dataclasses.replace(base, exchange_pipelined=True)))
+    seq = jax.jit(functools.partial(
+        step_fn, dataclasses.replace(base, exchange_pipelined=False)))
+    st = state
+    for _ in range(6):
+        a = pipe(st, faults)
+        b = seq(st, faults)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            assert bool((np.asarray(la) == np.asarray(lb)).all())
+        st = a
