@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke aot-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -34,6 +34,14 @@ telemetry-smoke:
 # scored JSONL journal round-trip.
 chaos-smoke:
 	$(PY) scripts/chaos_smoke.py
+
+# batched chaos-fleet gate (sim/scenarios.py, r12): tiny churn x loss
+# grid through the stacked-FaultPlan Monte-Carlo fleet — B=1 member must
+# be bit-identical (state digest + telemetry blocks) to the solo chaos
+# path, the scored per-scenario journal (scenario_id on blocks + scores)
+# must round-trip, and the response surface must match a solo probe.
+mc-smoke:
+	$(PY) scripts/mc_smoke.py
 
 # AOT warm-start gate (util/aot.py): serialize the sharded (pipelined)
 # tick block, reload it through the front door in a fresh subprocess —

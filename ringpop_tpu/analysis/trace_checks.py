@@ -2,13 +2,15 @@
 entry points.
 
 Plane 1 reads source; this plane reads the TRACED PROGRAM — the artifact
-the r6–r8 invariants are actually facts about.  Nine entry points
+the r6–r8 invariants are actually facts about.  Ten entry points
 (lifecycle step, delta step, the chaos-enabled variants of both — the
 same engines driven by a time-varying ``chaos.FaultPlan`` with every
-scenario leg populated — detect walk, shard_roll exchange, telemetry
-fetch, and the r11 sequential-exchange variants of both steps, sharded
-only) are traced dense AND under the 8-way virtual mesh (4×2
-node × rumor — the ``profile_mesh`` topology), then checked:
+scenario leg populated — the r12 BATCHED chaos-MC step (a heterogeneous
+stacked plan vmapped over (plan, state), the Monte-Carlo fleet's
+program), detect walk, shard_roll exchange, telemetry fetch, and the r11
+sequential-exchange variants of both steps, sharded only) are traced
+dense AND under the 8-way virtual mesh (4×2 node × rumor — the
+``profile_mesh`` topology), then checked:
 
 * **RPJ201 f64-in-trace** — no 64-bit aval anywhere (the engines are
   built on uint32 bit-packing and int32 keys; a stray f64/i64 doubles
@@ -423,8 +425,20 @@ def _chaos_plan(n):
     )
 
 
+def _stacked_plan(n):
+    """A heterogeneous STACKED plan (r12, ``chaos.stack_plans``): the
+    every-leg chaos plan plus a churn-only member, so the stacked program
+    carries both populated legs and materialized defaults — the shape the
+    Monte-Carlo fleet actually runs."""
+    from ringpop_tpu.sim import chaos
+
+    return chaos.stack_plans(
+        [_chaos_plan(n), chaos.scenario_plan("churn", n, seed=1, horizon=64)]
+    )
+
+
 def build_entrypoints(mesh=None) -> dict:
-    """{name: ClosedJaxpr} for the nine public jitted entry points, traced
+    """{name: ClosedJaxpr} for the ten public jitted entry points, traced
     dense (``mesh=None``) or with the shard-local exchange lowering
     (``mesh`` = the 4×2 virtual mesh; the shard_roll region and the
     sequential-exchange step variants exist sharded only).
@@ -481,6 +495,23 @@ def build_entrypoints(mesh=None) -> dict:
         lambda s, p: delta.step(dparams, s, p)
     )(dstate, plan)
 
+    # the batched chaos-MC step (r12): B heterogeneous stacked FaultPlans
+    # vmapped over (plan, state) — the Monte-Carlo fleet's program.  Every
+    # invariant must hold UNDER the batching transform: fault-plan phase
+    # zero-collective (RPJ203/RPJ206), no f64/callbacks, and the
+    # sharded/unsharded skeletons equal modulo the excised exchange
+    # region (RPJ205) — vmap must not introduce partition-dependence.
+    from ringpop_tpu.sim import chaos, montecarlo
+
+    stacked = _stacked_plan(_N)
+    axes = chaos.plan_axes(stacked)
+    mc_states = montecarlo.init_replicas(lparams, [1, 2])
+    out["mc_chaos_step"] = jax.make_jaxpr(
+        lambda s, p: jax.vmap(
+            lambda s1, p1: lifecycle.step(lparams, s1, p1), in_axes=(0, axes)
+        )(s, p)
+    )(mc_states, stacked)
+
     if mesh is not None:
         plane = jnp.zeros((_N, lifecycle.n_words(_K)), jnp.uint32)
         out["shard_roll"] = jax.make_jaxpr(
@@ -518,6 +549,14 @@ def run_trace_checks() -> list[Finding]:
             findings += check_no_64bit(tag, closed)
             findings += check_no_callbacks(tag, closed)
             findings += check_collective_confinement(tag, closed)
+    # mc_chaos_step is deliberately NOT in the RPJ205 list: vmap's
+    # batching rules legally materialize/reorder broadcasts around the
+    # exchange region depending on how that region lowers (shard_map vs
+    # gathers), so the batched dense/sharded skeletons differ in ops that
+    # are NOT partition-dependence — the fleet's equivalence is certified
+    # dynamically instead (mc-smoke B=1 identity + the ksweep mc_chaos
+    # bit_equal flag); its confinement/f64/callback/donation checks all
+    # still run.
     for name in (
         "lifecycle_step",
         "delta_step",
@@ -571,6 +610,22 @@ def _donation_checks() -> list[Finding]:
         jblk.lower(dstate, _faults(_N)).as_text(),
         len(jax.tree.leaves(dstate)),
     )
+    # the batched fleet carry (r12): donating the [B, ...] replica batch
+    # into the vmapped tick block must alias every leaf too — a silent
+    # copy on the fleet path multiplies peak memory by B
+    from ringpop_tpu.sim import montecarlo
+
+    mc_states = montecarlo.init_replicas(lparams, [1, 2])
+    mblk = jax.jit(
+        functools.partial(montecarlo._mc_block, lparams),
+        static_argnames="ticks",
+        donate_argnums=(0,),
+    )
+    findings += check_donation(
+        "mc_chaos_block",
+        mblk.lower(mc_states, _stacked_plan(_N), ticks=1).as_text(),
+        len(jax.tree.leaves(mc_states)),
+    )
     return findings
 
 
@@ -610,6 +665,25 @@ def run_hlo_checks() -> list[Finding]:
         lifecycle._SPARSE_TOPK_MIN_N = old_min_n
     findings += check_hlo_confinement("lifecycle_step[hlo,sharded]", text)
     findings += check_hlo_confinement("lifecycle_step_chaos[hlo,sharded]", chaos_text)
+
+    # r12: the BATCHED chaos-MC block compiled over the same mesh (batch
+    # axis replicated, node/rumor sharded as canonical — the fleet ksweep
+    # layout).  This is where a partitioner-introduced collective inside
+    # the vmapped fault-plan phase would surface.
+    from ringpop_tpu.sim import montecarlo
+
+    stacked = _stacked_plan(_HLO_N)
+    mc_states = jax.tree.map(
+        jax.device_put,
+        montecarlo.init_replicas(params, [1, 2]),
+        montecarlo.fleet_state_shardings(mesh, k=_K),
+    )
+    mblk = jax.jit(
+        functools.partial(montecarlo._mc_block, params), static_argnames="ticks"
+    )
+    with _no_compile_cache():
+        fleet_text = mblk.lower(mc_states, stacked, ticks=1).compile().as_text()
+    findings += check_hlo_confinement("mc_chaos_block[hlo,sharded]", fleet_text)
     return findings
 
 

@@ -1095,12 +1095,10 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
     # between consecutive points of the dose-response curve.  The round-4
     # curve was stepwise (36 -> 46 -> 56-63) with one dominating jump
     # (63 -> 96 between doses 103 and 107) that the summary stats hid.
-    curve = [(c, t) for c, t in out["churn_ticks"] if t is not None]
-    cliff_at = cliff_jump = None
-    if len(curve) >= 2:
-        cliff_jump, cliff_at = max(
-            (t2 - t1, c2) for (_, t1), (c2, t2) in zip(curve, curve[1:])
-        )
+    # (finder shared with the mc_chaos surface rows: scenarios.locate_cliff)
+    from ringpop_tpu.sim.scenarios import locate_cliff
+
+    cliff_at, cliff_jump = locate_cliff(out["churn_ticks"])
     # mechanism contrast at the saturating dose (2 replicas each: dose 0 +
     # dose churn_max).  Tripling maxP leaves the saturated latency
     # unchanged while doubling K collapses it — the binding constraint is
@@ -1139,6 +1137,180 @@ def bench_mc_churn(seed: int, full: bool) -> dict:
         "cliff_jump_ticks": cliff_jump,
         "k": 32,
         "cliff_contrast": contrast,
+    }
+
+
+def bench_mc_chaos(seed: int, full: bool) -> dict:
+    """The batched chaos fleet (ISSUE 7 tentpole): the mc_churn cliff
+    mapped as a churn×loss RESPONSE SURFACE instead of one slice, by ONE
+    compiled program over a stacked-FaultPlan grid (``sim/scenarios.py``).
+
+    Three measurements in one scenario:
+
+    1. **The surface** — every (churn dose × loss rate) grid point's
+       first-detection tick at 1-tick resolution, one AOT-warm-started
+       fleet dispatch (``scenarios.detect_surface``, tag ``mc_chaos``;
+       the record carries the front door's measured cache_hit/compile_s,
+       same schema as step1m).  The loss-0 row reuses the committed
+       mc_churn slice's (seed, dose, mask) pairing EXACTLY — same rng
+       sequence, same victims, same params — so its cliff must land
+       where SIMBENCH_r05 put it (dose 107 at full scale); the other
+       rows are the new information.
+    2. **Throughput A/B** — the WHOLE sweep batched (the surface run:
+       one program, one dispatch, its measured AOT compile included in
+       the wall clock) vs the sequential B-runs baseline it replaces
+       (one trace+compile + one dispatch PER grid point —
+       ``scenarios.sequential_detect(fresh_compile=True)``; the
+       warm-cache sequential loop is also recorded for transparency).
+       End-to-end wall clock including compile, reported as
+       replicas·ticks·nodes/s; the sequential pass doubles as a
+       whole-surface tick-for-tick certificate (``ticks_equal``).
+    3. **Scored journal** — the same grid run for a fixed horizon with
+       the r7 telemetry counters accumulated UNDER the batch axis: one
+       device fetch per block for all scenarios, one
+       ``chaos.score_blocks`` verdict per scenario (grid coordinates
+       attached), journaled to --telemetry when given.
+    """
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.sim import scenarios, telemetry
+    from ringpop_tpu.sim.lifecycle import LifecycleParams
+
+    n = 4096 if full else 512
+    b_doses = 32 if full else 8
+    churn_max = n // 32
+    k = 32
+    losses = (0.0, 0.02, 0.05, 0.1)
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=4, replace=False).tolist())
+    doses = scenarios.mc_churn_doses(b_doses, churn_max)
+    # identical params to bench_mc_churn's study (threefry, default
+    # suspicion): the loss-0 row IS that study, re-derived by the fleet
+    params = LifecycleParams(n=n, k=k)
+    plan, meta = scenarios.scenario_grid(
+        n, victims=victims, doses=doses, losses=losses, churn_seed=seed + 777
+    )
+    seeds = scenarios.grid_seeds(meta, seed)
+
+    # -- 1: the churn x loss surface, one batched dispatch -------------------
+    t0 = time.perf_counter()
+    ticks, detected, aot_info = scenarios.detect_surface(
+        params, plan, seeds, victims, max_ticks=4096, check_every=1,
+        aot="mc_chaos",
+    )
+    surface_s = time.perf_counter() - t0
+    tick_vals = [int(t) if d else None for t, d in zip(ticks, detected)]
+    surface = scenarios.response_surface(meta, tick_vals, rows="loss", cols="churn")
+    cliffs = {}
+    for loss, row in zip(surface["rows"], surface["cells"]):
+        at, jump = scenarios.locate_cliff(list(zip(surface["cols"], row)))
+        cliffs[str(loss)] = {"cliff_at": at, "jump_ticks": jump}
+
+    # -- 2: batched vs sequential throughput — THE WHOLE SWEEP ---------------
+    # The batched side IS the surface run above (one AOT-front-door
+    # program; its measured compile_s is part of surface_wall_s).  The
+    # baseline is the workflow the fleet replaces: every grid point its
+    # own run with its own trace+compile (simulated honestly with
+    # jax.clear_caches() per point — each point of the pre-fleet sweep
+    # was its own bench invocation), plus the best-case warm-cache
+    # sequential loop for transparency.  The sequential pass doubles as
+    # a whole-surface certificate: every grid point's first-detection
+    # tick must match the batched program's.
+    t0 = time.perf_counter()
+    seq_t, seq_d = scenarios.sequential_detect(
+        params, plan, seeds, victims, max_ticks=4096, check_every=1,
+        fresh_compile=True,
+    )
+    seq_ab_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm_t, _ = scenarios.sequential_detect(
+        params, plan, seeds, victims, max_ticks=4096, check_every=1,
+        fresh_compile=False,
+    )
+    seq_warm_s = time.perf_counter() - t0
+    batched_ab_s = surface_s
+    b_ab = len(meta)
+    ab_equal = [int(a) for a in ticks] == [int(s) for s in seq_t] and (
+        [int(a) for a in ticks] == [int(w) for w in warm_t]
+    )
+    # work metric: replicas x ticks actually stepped x nodes — the fleet
+    # steps every replica in lockstep to the last-detecting replica's
+    # tick (the full budget if any replica never detected).  The same
+    # numerator prices both sides: each produces the same deliverable
+    # (the B first-detection ticks), so rtn/s is sweep throughput.
+    ticks_run = (
+        max(int(t) for t in ticks) if bool(np.asarray(detected).all()) else 4096
+    )
+    ab_work = int(b_ab * ticks_run * n)
+
+    # -- 3: scored journal over the full grid --------------------------------
+    sink = _telemetry_sink(
+        "mc_chaos", "lifecycle",
+        {"n": n, "k": k, "seed": seed, "grid": {"doses": doses, "losses": list(losses)}},
+    )
+    if sink is None:
+        sink = telemetry.TelemetrySink()
+    horizon = 256
+    try:
+        t0 = time.perf_counter()
+        scores = scenarios.scored_fleet(
+            params, plan, meta, seeds, horizon=horizon, journal_every=16,
+            sink=sink,
+        )
+        scored_s = time.perf_counter() - t0
+    finally:
+        _close_sink(sink)
+    fp_surface = scenarios.response_surface(
+        meta, [s["false_positive_suspects"] for s in scores],
+        rows="loss", cols="churn",
+    )
+    detect_frac_surface = scenarios.response_surface(
+        meta, [s["final_detect_frac"] for s in scores], rows="loss", cols="churn",
+    )
+
+    loss0 = cliffs.get("0.0", {})
+    return {
+        "metric": f"mc_chaos_surface_n{n}_g{len(meta)}",
+        # headline: end-to-end speedup of the batched sub-grid over the
+        # one-compile-one-dispatch-per-point baseline it replaces
+        "value": round(seq_ab_s / batched_ab_s, 2),
+        "unit": "x_speedup_vs_sequential",
+        "n_nodes": n,
+        "k": k,
+        "grid": {"doses": doses, "losses": list(losses), "b_total": len(meta)},
+        "surface_wall_s": round(surface_s, 2),
+        "detected": int(np.asarray(detected).sum()),
+        "surface": surface,
+        "cliff_by_loss": cliffs,
+        # the mc_churn parity anchor: the loss-0 row's cliff (must equal
+        # the committed 1-D slice's churn_cliff_at at full scale)
+        "churn_cliff_at": loss0.get("cliff_at"),
+        "cliff_jump_ticks": loss0.get("jump_ticks"),
+        # AOT front door (same schema as step1m): measured, not inferred
+        "cache_hit": aot_info.get("cache_hit"),
+        "compile_s": aot_info.get("compile_s"),
+        "aot_error": aot_info.get("error"),
+        "cache_dir": aot_info.get("cache_dir"),
+        "throughput": {
+            "b": b_ab,
+            "max_ticks": 4096,
+            "batched_s": round(batched_ab_s, 2),
+            "sequential_s": round(seq_ab_s, 2),
+            "sequential_warm_s": round(seq_warm_s, 2),
+            "speedup": round(seq_ab_s / batched_ab_s, 2),
+            "speedup_vs_warm": round(seq_warm_s / batched_ab_s, 2),
+            "ticks_equal": ab_equal,
+            "batched_rtn_per_s": round(ab_work / batched_ab_s, 0),
+            "sequential_rtn_per_s": round(ab_work / seq_ab_s, 0),
+        },
+        "scored": {
+            "horizon": horizon,
+            "wall_s": round(scored_s, 2),
+            "scores": len(scores),
+            "false_positive_surface": fp_surface,
+            "final_detect_frac_surface": detect_frac_surface,
+        },
     }
 
 
@@ -1300,6 +1472,7 @@ BENCHES = {
     "forward_comparator": bench_forward_comparator,
     "forward_ab": bench_forward_ab,
     "mc_churn": bench_mc_churn,
+    "mc_chaos": bench_mc_chaos,
     "partition_lc": bench_partition_lifecycle,
     "sharded100k": bench_sharded100k,
     "delta16m": bench_delta16m,

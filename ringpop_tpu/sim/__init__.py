@@ -37,7 +37,17 @@ protocol period at once:
   asymmetric partition split/heal windows, per-node loss / slow-node
   timeout inflation) compiled into dense device arrays and evaluated
   shard-locally inside the jitted step, plus the convergence scorer
-  that reduces a telemetry journal into scenario verdicts.
+  that reduces a telemetry journal into scenario verdicts.  A FaultPlan
+  is also a batchable axis (``stack_plans``): B different scenarios as
+  one ``[B, ...]`` plan pytree, vmapped through the engines by the
+  Monte-Carlo fleet.
+
+* :mod:`ringpop_tpu.sim.scenarios` — the scenario-grid compiler on top:
+  sweep a parameter grid (churn dose × loss × partition width, with
+  suspicion timeout as a static outer axis) into stacked plans, run ONE
+  AOT-warm-started batched program, reduce the batched telemetry
+  journal into per-scenario verdicts and 2-D response surfaces
+  (``simbench mc_chaos``).
 
 Fault injection is first-class: partition group arrays (symmetric or
 directed via ``reach[G, G]``), scalar and per-node drop probabilities,
@@ -50,7 +60,7 @@ from ringpop_tpu.sim.fullview import FullViewSim, FullViewParams
 from ringpop_tpu.sim.delta import DeltaSim, DeltaParams
 from ringpop_tpu.sim.lifecycle import LifecycleSim, LifecycleParams
 from ringpop_tpu.sim.montecarlo import MonteCarlo, detection_latency_distribution
-from ringpop_tpu.sim.chaos import FaultPlan, faults_at, score_blocks
+from ringpop_tpu.sim.chaos import FaultPlan, faults_at, score_blocks, stack_plans
 
 __all__ = [
     "FullViewSim",
@@ -64,4 +74,5 @@ __all__ = [
     "FaultPlan",
     "faults_at",
     "score_blocks",
+    "stack_plans",
 ]

@@ -46,6 +46,16 @@ canonical simbench scenarios (``churn``, ``flap``, ``asym``) plus the
 chaos ratchet — one builder shared by the bench, its sharded-twin
 subprocess, and the tests, so the certified plan can't drift from the
 measured one.
+
+Batching (r12): a FaultPlan is also a *batchable axis*.  ``stack_plans``
+stacks B heterogeneous solo plans into one ``[B, ...]`` plan pytree
+(missing legs materialize value-neutral defaults; ``reach`` matrices pad
+to the largest group count), ``plan_axes`` is its vmap ``in_axes``, and
+``index_plan`` slices one member back out for per-scenario scoring.
+``faults_at`` is elementwise, so the stacked plan maps through the
+engines' step unchanged — ``sim/montecarlo.py`` vmaps the chaos-enabled
+step over (plan, seed) and ``sim/scenarios.py`` compiles parameter grids
+into stacked plans, one jitted program per sweep.
 """
 
 from __future__ import annotations
@@ -345,6 +355,191 @@ def scenario_plan(name: str, n: int, seed: int = 0, horizon: int = 256) -> Fault
     raise ValueError(f"unknown chaos scenario {name!r}")
 
 
+# -- plan batching: B scenarios as one [B, ...] plan pytree -------------------
+
+# Solo (unbatched) ndim per FaultPlan leg — the contract every batching
+# helper dispatches on: a leaf with one MORE axis than its solo rank
+# carries a leading scenario axis.  ``faults_at`` is elementwise in the
+# per-node legs and broadcasts the scalars, so a stacked plan vmaps
+# through the engines unchanged (sim/montecarlo.py maps the step over
+# (plan, state) with ``plan_axes``).
+PLAN_LEG_NDIM = {
+    "base_up": 1,
+    "crash_tick": 1,
+    "restart_tick": 1,
+    "flap_period": 1,
+    "flap_phase": 1,
+    "flap_down": 1,
+    "group": 1,
+    "part_from": 0,
+    "part_until": 0,
+    "reach": 2,
+    "drop_rate": 0,
+    "drop_node": 1,
+}
+
+
+def _leg_rank(field: str, value) -> int:
+    nd = int(getattr(value, "ndim", 0))
+    solo = PLAN_LEG_NDIM[field]
+    if nd not in (solo, solo + 1):
+        raise ValueError(
+            f"plan leg {field!r} has ndim {nd}; expected {solo} (solo) or "
+            f"{solo + 1} (stacked [B, ...])"
+        )
+    return nd - solo
+
+
+def plan_axes(plan: FaultPlan) -> Optional[FaultPlan]:
+    """vmap ``in_axes`` pytree for a (possibly) stacked plan: 0 for legs
+    carrying a leading scenario axis, None for shared legs — or None when
+    nothing is batched (the solo-plan fast path)."""
+    axes = {}
+    batched = False
+    for field, value in zip(plan._fields, plan):
+        if value is None:
+            continue
+        if _leg_rank(field, value):
+            axes[field] = 0
+            batched = True
+    return FaultPlan(**axes) if batched else None
+
+
+def plan_batch_size(plan: FaultPlan) -> Optional[int]:
+    """B of a stacked plan (None for a solo plan).  Mixed batch sizes in
+    one plan are a construction error."""
+    sizes = {
+        int(value.shape[0])
+        for field, value in zip(plan._fields, plan)
+        if value is not None and _leg_rank(field, value)
+    }
+    if not sizes:
+        return None
+    if len(sizes) > 1:
+        raise ValueError(f"stacked plan carries mixed batch sizes {sorted(sizes)}")
+    return sizes.pop()
+
+
+def _leg_default(field: str, n: Optional[int], groups: int):
+    """The inert default a member missing leg ``field`` stacks as — chosen
+    so the materialized leg is VALUE-neutral: crash windows that never
+    open, flap periods of zero, group -1 everywhere, loss 0.0 (the
+    engines' drop comparison ``u >= 0.0``/``u < 1.0`` passes every leg),
+    and an identity ``reach`` (same-group ⇔ connected — exactly the
+    symmetric-partition semantics a reach-less plan has)."""
+    if field == "base_up":
+        return jnp.ones((n,), bool)
+    if field in ("crash_tick", "restart_tick"):
+        return jnp.full((n,), NO_TICK, jnp.int32)
+    if field in ("flap_period", "flap_phase", "flap_down"):
+        return jnp.zeros((n,), jnp.int32)
+    if field == "group":
+        return jnp.full((n,), -1, jnp.int32)
+    if field == "part_from":
+        return jnp.asarray(0, jnp.int32)
+    if field == "part_until":
+        return jnp.asarray(NO_TICK, jnp.int32)
+    if field == "reach":
+        return jnp.eye(groups, dtype=bool)
+    if field == "drop_rate":
+        return jnp.asarray(0.0, jnp.float32)
+    if field == "drop_node":
+        return jnp.zeros((n,), jnp.float32)
+    raise ValueError(f"unknown plan leg {field!r}")
+
+
+def _pad_reach(reach, groups: int):
+    """Embed a [G, G] reach matrix in [groups, groups]: original verdicts
+    top-left, identity (symmetric semantics) on the padded diagonal.  The
+    padded rows are unreachable by that member's own group ids — padding
+    only exists so heterogeneous members stack to one dense leaf."""
+    reach = jnp.asarray(reach, bool)
+    g = reach.shape[0]
+    if g == groups:
+        return reach
+    out = jnp.eye(groups, dtype=bool)
+    return out.at[:g, :g].set(reach)
+
+
+def stack_plans(plans) -> FaultPlan:
+    """Stack B (heterogeneous) solo FaultPlans into ONE plan whose legs
+    carry a leading scenario axis — the batchable unit the Monte-Carlo
+    fleet vmaps over (one compiled program evaluates all B scenarios).
+
+    A leg set by ANY member is materialized for every member (missing
+    members get the inert default, value-identical to the leg's absence
+    — ``_leg_default``); a leg set by NO member stays None and compiles
+    out exactly as in a solo plan.  ``reach`` matrices of different group
+    counts are padded to the largest (``_pad_reach``).  B = 1 is legal
+    and bit-identical to the solo run (pinned by tests/test_scenarios.py).
+    """
+    plans = list(plans)
+    if not plans:
+        raise ValueError("stack_plans needs at least one plan")
+    for p in plans:
+        for field, value in zip(p._fields, p):
+            if value is not None and _leg_rank(field, value):
+                raise ValueError(f"stack_plans takes SOLO plans; {field!r} is already stacked")
+    # n inferred from any per-node leg; only needed when one must be defaulted
+    n = next(
+        (
+            int(v.shape[0])
+            for p in plans
+            for f, v in zip(p._fields, p)
+            if v is not None and PLAN_LEG_NDIM[f] == 1
+        ),
+        None,
+    )
+    # the padded reach must cover every member's group-id range, not just
+    # the reach-carrying members': a symmetric member's ids index the
+    # identity default it materializes, and an out-of-range id would
+    # silently clamp into someone else's row (connecting groups its solo
+    # run keeps apart)
+    groups = max(
+        [int(p.reach.shape[0]) for p in plans if p.reach is not None]
+        + [int(np.asarray(p.group).max()) + 1 for p in plans if p.group is not None],
+        default=0,
+    )
+    legs = {}
+    for field in FaultPlan._fields:
+        values = [getattr(p, field) for p in plans]
+        if all(v is None for v in values):
+            continue
+        if field == "reach":
+            stacked = [
+                _pad_reach(v, groups) if v is not None
+                else _leg_default("reach", n, groups)
+                for v in values
+            ]
+        else:
+            if n is None and PLAN_LEG_NDIM[field] == 1:
+                raise ValueError(
+                    f"cannot default per-node leg {field!r}: no member names n"
+                )
+            default = None
+            stacked = []
+            for v in values:
+                if v is None:
+                    if default is None:
+                        default = _leg_default(field, n, groups)
+                    v = default
+                stacked.append(jnp.asarray(v))
+        legs[field] = jnp.stack(stacked)
+    return FaultPlan(**legs)
+
+
+def index_plan(plan: FaultPlan, b: int) -> FaultPlan:
+    """Member ``b`` of a stacked plan as a solo plan (batched legs are
+    sliced, shared legs pass through) — what the scorer hands
+    ``plan_events``/``up_at_host`` per scenario."""
+    legs = {}
+    for field, value in zip(plan._fields, plan):
+        if value is None:
+            continue
+        legs[field] = value[b] if _leg_rank(field, value) else value
+    return FaultPlan(**legs)
+
+
 # -- host-side timeline introspection ----------------------------------------
 
 
@@ -388,12 +583,16 @@ def plan_events(plan: FaultPlan) -> list[dict]:
             events.append(
                 {"kind": "restart", "tick": int(t), "nodes": int((restart == t).sum())}
             )
-    if plan.group is not None:
+    # a group leg of all -1 is the materialized stacked default (no node
+    # partitioned — stack_plans value-neutrality), and part_until ==
+    # NO_TICK is the stacked encoding of "never heals" (solo plans use
+    # None): neither is an event that occurs
+    if plan.group is not None and bool((np.asarray(plan.group) >= 0).any()):
         split = int(np.asarray(plan.part_from)) if plan.part_from is not None else 0
         events.append({"kind": "partition", "tick": split,
                        "nodes": int((np.asarray(plan.group) > 0).sum()),
                        "directed": plan.reach is not None})
-        if plan.part_until is not None:
+        if plan.part_until is not None and int(np.asarray(plan.part_until)) != NO_TICK:
             events.append({"kind": "heal", "tick": int(np.asarray(plan.part_until))})
     if plan.flap_period is not None:
         period = np.asarray(plan.flap_period)
@@ -432,6 +631,7 @@ def score_blocks(
     *,
     n: int,
     scenario: str = "",
+    scenario_id: Optional[int] = None,
 ) -> dict:
     """Reduce a lifecycle run journal (the ``kind == "block"`` records of
     ``sim/telemetry.py``, in order) plus the plan's event timeline into a
@@ -497,7 +697,7 @@ def score_blocks(
                 rejoin = int(b["tick"]) - last_restart
                 break
 
-    return {
+    out = {
         "kind": "score",
         "scenario": scenario,
         "n": n,
@@ -517,6 +717,11 @@ def score_blocks(
         "final_detect_frac": detect[-1] if detect else None,
         "rejoin_convergence_ticks": rejoin,
     }
+    if scenario_id is not None:
+        # batched-fleet journals: which member of the stacked plan this
+        # verdict scores (same id the fleet's block records carry)
+        out["scenario_id"] = int(scenario_id)
+    return out
 
 
 # -- stats bridge -------------------------------------------------------------

@@ -15,6 +15,14 @@ Semantics are exactly `LifecycleSim`: replica b of
 the state `LifecycleSim(seed=s)` produces (pinned by
 `tests/test_montecarlo.py`).
 
+The fault model is a batchable axis too (r12): `faults` may carry a
+leading replica axis on any `DeltaFaults` leaf, or be a STACKED
+`chaos.FaultPlan` (`chaos.stack_plans`) — B *different* time-varying
+scenarios evaluated by one compiled program, with the r7 telemetry
+counters optionally accumulated under the batch axis and fetched as B
+per-scenario journal records in one `device_get` (`fetch_telemetry`).
+`sim/scenarios.py` builds parameter-grid sweeps on top of this.
+
 Reference analogs: failure detection `swim/node.go:470-513`; the suspicion
 timeout sweep scenario (BASELINE `sweep100k`).
 """
@@ -50,29 +58,122 @@ def init_replicas(params: LifecycleParams, seeds: Sequence[int]):
     return jax.vmap(lambda k: init_state_from_key(params, k))(keys)
 
 
-def _faults_axes(faults: DeltaFaults):
-    """vmap ``in_axes`` pytree for the fault masks, or None when nothing is
-    batched.  Heterogeneous-scenario studies (per-replica churn/partitions)
-    give ``up`` and/or ``group`` a leading replica axis ([B, N]); each
-    2-D leaf maps over axis 0 while 1-D/absent leaves broadcast — so
-    batched churn with a shared partition map (or vice versa) both work."""
-
-    def ax(x):
-        return 0 if x is not None and getattr(x, "ndim", 1) == 2 else None
-
-    # scalar legs (drop_rate) and per-node legs without a replica axis
-    # broadcast (axis None); only 2-D up/group masks map over replicas
-    axes = DeltaFaults(up=ax(faults.up), group=ax(faults.group))
-    return None if (axes.up is None and axes.group is None) else axes
+# solo (unbatched) ndim per DeltaFaults leaf — a leaf with one more axis
+# carries a leading replica axis and maps over it (chaos.PLAN_LEG_NDIM is
+# the FaultPlan analog)
+_DELTA_FAULTS_NDIM = {"up": 1, "group": 1, "drop_rate": 0, "drop_node": 1, "reach": 2}
 
 
-def _mc_block(params: LifecycleParams, states, faults: DeltaFaults, ticks: int):
+def _faults_axes(faults):
+    """vmap ``in_axes`` pytree for the fault model, or None when nothing
+    is batched.  Both fault vocabularies batch:
+
+    * ``DeltaFaults`` — any leaf with one more axis than its solo rank
+      maps over replicas (``up``/``group``/``drop_node`` as [B, N],
+      ``drop_rate`` as [B], ``reach`` as [B, G, G]); solo/absent leaves
+      broadcast, so batched churn with a shared partition map (or vice
+      versa) both work.
+    * ``chaos.FaultPlan`` — a STACKED plan (``chaos.stack_plans``), every
+      scenario a different member: ``chaos.plan_axes`` decides per leg.
+      This is what makes the fault plan a batchable axis end-to-end: one
+      jitted program evaluates B scenarios × R replicas.
+    """
+    from ringpop_tpu.sim import chaos
+
+    if isinstance(faults, chaos.FaultPlan):
+        return chaos.plan_axes(faults)
+
+    def ax(field, x):
+        if x is None:
+            return None
+        # .ndim is static Python metadata even on tracers — no concretization
+        return 0 if getattr(x, "ndim", 0) == _DELTA_FAULTS_NDIM[field] + 1 else None
+
+    axes = {f: ax(f, getattr(faults, f)) for f in _DELTA_FAULTS_NDIM}
+    if all(v is None for v in axes.values()):
+        return None
+    return DeltaFaults(**axes)
+
+
+def _mc_block(params: LifecycleParams, states, faults, ticks: int, telemetry=None):
+    """``ticks`` vmapped steps in one fused loop.  ``telemetry`` (a
+    [B]-batched ``telemetry.TelemetryState`` or None): when given, the
+    loop carry is the (states, telemetry) pair and the per-tick counters
+    accumulate UNDER the replica axis — the None leg compiles out, so the
+    telemetry-free program is exactly the one r9 traced."""
     axes = _faults_axes(faults)
+    if telemetry is None:
+        if axes is not None:
+            vstep = jax.vmap(lambda s, f: step(params, s, f), in_axes=(0, axes))
+            return jax.lax.fori_loop(0, ticks, lambda _, s: vstep(s, faults), states)
+        vstep = jax.vmap(lambda s: step(params, s, faults))
+        return jax.lax.fori_loop(0, ticks, lambda _, s: vstep(s), states)
     if axes is not None:
-        vstep = jax.vmap(lambda s, f: step(params, s, f), in_axes=(0, axes))
-        return jax.lax.fori_loop(0, ticks, lambda _, s: vstep(s, faults), states)
-    vstep = jax.vmap(lambda s: step(params, s, faults))
-    return jax.lax.fori_loop(0, ticks, lambda _, s: vstep(s), states)
+        vstep = jax.vmap(
+            lambda s, t, f: step(params, s, f, telemetry=t), in_axes=(0, 0, axes)
+        )
+        return jax.lax.fori_loop(
+            0, ticks, lambda _, c: vstep(c[0], c[1], faults), (states, telemetry)
+        )
+    vstep = jax.vmap(lambda s, t: step(params, s, faults, telemetry=t))
+    return jax.lax.fori_loop(
+        0, ticks, lambda _, c: vstep(c[0], c[1]), (states, telemetry)
+    )
+
+
+def fleet_state_shardings(mesh, k=None):
+    """Shardings for a [B, ...] replica batch over a ("node", "rumor")
+    mesh: the batch axis replicates (scenarios are mutually independent —
+    sharding it would be trivial-parallel, not a partitioning exercise)
+    and every underlying state axis keeps the canonical
+    ``lifecycle.state_shardings`` layout.  Used by the sharded mc_chaos
+    ksweep section and the jaxlint fleet entry point."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ringpop_tpu.sim.lifecycle import state_shardings
+
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, P(None, *s.spec)),
+        state_shardings(mesh, k=k),
+    )
+
+
+def _index_faults(faults, b: int):
+    """Replica ``b``'s solo fault model out of a (possibly) batched one —
+    batched leaves are sliced, shared leaves pass through (the DeltaFaults
+    analog of ``chaos.index_plan``)."""
+    from ringpop_tpu.sim import chaos
+
+    if isinstance(faults, chaos.FaultPlan):
+        return chaos.index_plan(faults, b)
+    return DeltaFaults(
+        **{
+            f: (
+                None
+                if getattr(faults, f) is None
+                else getattr(faults, f)[b]
+                if getattr(getattr(faults, f), "ndim", 0)
+                == _DELTA_FAULTS_NDIM[f] + 1
+                else getattr(faults, f)
+            )
+            for f in _DELTA_FAULTS_NDIM
+        }
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("axes",))
+def _mc_fetch(tel, states, faults, *, axes):
+    """Batched telemetry fetch: reduce every replica's accumulators to a
+    [B]-column block record plus per-replica state digests in ONE
+    dispatch (``telemetry.split_batched`` then splits the single
+    ``device_get`` into per-scenario journal records).  ``axes`` is the
+    hashable fault ``in_axes`` pytree (static so each fault structure
+    compiles once)."""
+    from ringpop_tpu.sim import telemetry as _tm
+
+    record, fresh = jax.vmap(_tm.fetch, in_axes=(0, 0, axes))(tel, states, faults)
+    digests = jax.vmap(_tm.tree_digest)(states)
+    return record, fresh, digests
 
 
 @functools.partial(
@@ -126,20 +227,55 @@ def _mc_run_until_device(
 
 
 class MonteCarlo:
-    """B lockstep cluster replicas differing only in PRNG seed.
+    """B lockstep cluster replicas differing in PRNG seed AND (optionally)
+    fault scenario: ``faults`` may be a ``DeltaFaults`` with [B, ...]
+    leaves or a STACKED ``chaos.FaultPlan`` (``chaos.stack_plans``), so
+    one compiled program evaluates B scenarios × their seeds.
+
+    ``telemetry=True`` carries a [B]-batched r7 counter accumulator
+    through every :meth:`run` tick; :meth:`fetch_telemetry` reduces it to
+    B per-scenario block records (tagged ``scenario_id``) in one dispatch
+    + one ``device_get`` — the journal ``chaos.score_blocks`` reduces
+    into per-scenario verdicts with no host round-trips per scenario.
+    The scored path is exact-horizon :meth:`run` blocks
+    (``scenarios.scored_fleet``); :meth:`run_until_detected`'s device
+    loop does NOT carry the accumulator and refuses to run with one
+    armed rather than pair advanced state with stale counters.
+
+    ``aot="tag"`` routes the batched detection program through the
+    ``util/aot.py`` warm-start front door (``aot_info`` collects the
+    measured ``cache_hit``/``compile_s`` per keyed program).
 
     >>> mc = MonteCarlo(LifecycleParams(n=512, k=32), seeds=range(32))
     >>> ticks, detected = mc.run_until_detected(victims=[3, 99], faults=f)
     >>> np.median(ticks[detected])   # detection-latency distribution
     """
 
-    def __init__(self, params: LifecycleParams, seeds: Sequence[int]):
+    def __init__(
+        self,
+        params: LifecycleParams,
+        seeds: Sequence[int],
+        telemetry: bool = False,
+        aot: Optional[str] = None,
+    ):
         self.params = params
         self.seeds = list(seeds)
         self.states = init_replicas(params, self.seeds)
         self._block = jax.jit(
             functools.partial(_mc_block, self.params), static_argnames="ticks"
         )
+        self._aot_tag = aot
+        self._aot_calls: dict = {}
+        self.aot_info: dict = {}
+        self.telemetry = None
+        if telemetry:
+            from ringpop_tpu.sim import telemetry as _tm
+
+            tz = _tm.zeros(params)
+            b = len(self.seeds)
+            self.telemetry = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (b,) + x.shape), tz
+            )
 
     def detection_fractions(
         self, subjects, faults: DeltaFaults = DeltaFaults(), min_status: int = FAULTY
@@ -154,11 +290,11 @@ class MonteCarlo:
         rows = []
         for b in range(self.n_replicas):
             one = jax.tree.map(lambda x: x[b], self.states)
-            # slice only the replica-batched ([B, N]) fault leaves
-            fb = jax.tree.map(
-                lambda x: x[b] if getattr(x, "ndim", 1) == 2 else x, faults
+            rows.append(
+                np.asarray(
+                    detection_fraction(one, subjects, _index_faults(faults, b), min_status)
+                )
             )
-            rows.append(np.asarray(detection_fraction(one, subjects, fb, min_status)))
         return np.stack(rows)
 
     @property
@@ -166,8 +302,63 @@ class MonteCarlo:
         return len(self.seeds)
 
     def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()):
-        self.states = self._block(self.states, faults, ticks=ticks)
+        if self.telemetry is None:
+            self.states = self._block(self.states, faults, ticks=ticks)
+        else:
+            self.states, self.telemetry = self._block(
+                self.states, faults, ticks=ticks, telemetry=self.telemetry
+            )
         return self.states
+
+    def fetch_telemetry(self, faults: DeltaFaults = DeltaFaults()) -> list[dict]:
+        """Fetch-and-reset the batched accumulators: B per-scenario host
+        block records (``scenario_id`` = replica index, per-replica
+        ``state_digest`` attached), produced by ONE jitted reduction and
+        ONE ``device_get`` (``telemetry.split_batched``)."""
+        if self.telemetry is None:
+            raise ValueError("MonteCarlo built without telemetry=True")
+        from ringpop_tpu.sim import telemetry as _tm
+
+        record, self.telemetry, digests = _mc_fetch(
+            self.telemetry, self.states, faults, axes=_faults_axes(faults)
+        )
+        return _tm.split_batched(record, {"state_digest": digests})
+
+    def _until_call(self, states, faults, subjects, *, min_status, block_ticks, max_blocks):
+        """Dispatch the whole-fleet detection program — through the AOT
+        warm-start front door when the instance carries a tag.  Memoized
+        per (statics, faults structure + leaf avals, subjects aval) —
+        every dynamic shape the exported executable is fixed to, same
+        discrimination rule as ``LifecycleSim._block_call``."""
+        kw = dict(min_status=min_status, block_ticks=block_ticks)
+        if self._aot_tag is None:
+            return _mc_run_until_device(
+                self.params, states, faults, subjects, max_blocks=max_blocks, **kw
+            )
+        from ringpop_tpu.util import aot as _aot
+
+        fdesc = (
+            str(jax.tree.structure(faults))
+            + "|".join(_aot._leaf_descriptor(x) for x in jax.tree.leaves(faults))
+            + "|s:" + _aot._leaf_descriptor(subjects)
+        )
+        memo = (min_status, block_ticks, fdesc)
+        if memo not in self._aot_calls:
+            import hashlib as _hl
+
+            tag = (
+                f"{self._aot_tag}-mc{block_ticks}"
+                f"-f{_hl.sha256(fdesc.encode()).hexdigest()[:6]}"
+            )
+            call, info = _aot.load_or_compile(
+                functools.partial(_mc_run_until_device, self.params),
+                states, faults, subjects,
+                dyn_kw={"max_blocks": max_blocks},
+                tag=tag, static_kw=kw, statics=(repr(self.params),),
+            )
+            self._aot_calls[memo] = call
+            self.aot_info[tag] = info
+        return self._aot_calls[memo](states, faults, subjects, max_blocks=max_blocks)
 
     def run_until_detected(
         self,
@@ -186,10 +377,16 @@ class MonteCarlo:
         ``max_ticks``.  Replicas that finish early keep stepping (lockstep
         is what makes this one program); their recorded tick is frozen.
         """
+        if self.telemetry is not None:
+            raise ValueError(
+                "run_until_detected does not carry the telemetry accumulator "
+                "(its counters would silently stay stale while the state "
+                "advances) — use run() + fetch_telemetry (the scored_fleet "
+                "path) or build the MonteCarlo without telemetry=True"
+            )
         subjects = jnp.asarray(list(victims), jnp.int32)
         max_blocks = -(-max_ticks // check_every)  # host loop ran ceil(max/check)
-        self.states, _, first_block = _mc_run_until_device(
-            self.params,
+        self.states, _, first_block = self._until_call(
             self.states,
             faults,
             subjects,
@@ -290,17 +487,14 @@ def detection_latency_under_churn(
     b_count = len(seeds)
     victims = sorted(int(v) for v in victims)
 
-    rng = np.random.default_rng(churn_seed)
-    candidates = np.setdiff1d(np.arange(n), np.asarray(victims, np.int64))
-    up = np.ones((b_count, n), bool)
-    up[:, victims] = False
-    churn_counts = []
-    for b in range(b_count):
-        extra = round(b / max(b_count - 1, 1) * churn_max)
-        churn_counts.append(extra)
-        if extra:
-            down = rng.choice(candidates, size=extra, replace=False)
-            up[b, down] = False
+    # the dose ladder and per-dose masks are THE shared definition
+    # (sim/scenarios.py) — the mc_chaos surface's loss-0 row reuses them,
+    # so the 1-D slice and the surface cannot drift apart (lazy import:
+    # scenarios imports MonteCarlo from this module at load time)
+    from ringpop_tpu.sim.scenarios import churn_dose_masks, mc_churn_doses
+
+    churn_counts = mc_churn_doses(b_count, churn_max)
+    up = churn_dose_masks(n, victims, churn_counts, churn_seed)
     faults = DeltaFaults(up=jnp.asarray(up))
 
     mc = MonteCarlo(params, seeds)

@@ -1,0 +1,308 @@
+"""Scenario-grid compiler: parameter sweeps as ONE batched chaos program.
+
+r10's chaos plane scores one FaultPlan per run; r12 made the plan a
+batchable axis (``chaos.stack_plans`` + the Monte-Carlo fleet in
+``sim/montecarlo.py``).  This module is the host-side compiler on top:
+sweep a protocol-parameter grid — background-churn dose × packet loss ×
+partition width (plan legs, batched), with suspicion timeout as a static
+outer axis — into a stacked ``[B, ...]`` plan, run it through one
+AOT-warm-started program, and reduce the results into 2-D response
+surfaces.  The exemplar is the Ising-on-TPU-clusters treatment
+(PAPERS.md, arXiv:1903.11714): million-replica parameter studies as one
+dense program, compilation and dispatch amortized across the sweep.
+
+Grid axes and where they live:
+
+* **churn dose** — per-scenario background crash cohorts, drawn with
+  EXACTLY the rng sequence ``montecarlo.detection_latency_under_churn``
+  draws (``churn_dose_masks``), so the loss-0 row of the churn×loss
+  surface is bit-identical to the committed ``mc_churn`` 1-D slice
+  (SIMBENCH_r05: cliff at dose 107) — the surface extends the slice, it
+  does not re-measure it.
+* **loss** — the scalar ``drop_rate`` leg, batched ``[B]``.  A 0.0 rate
+  is value-identical to no drop leg at all (the survival comparisons
+  ``u >= 0.0`` / ``u < 1.0`` pass every leg and the engines' key splits
+  don't depend on the drop leg), which is what lets one dense program
+  cover the loss-free row too.
+* **partition width** — optional symmetric split window (minority
+  fraction per scenario; width 0 = no partition leg for that member).
+* **suspicion timeout** — STATIC (``LifecycleParams.suspect_ticks`` is
+  compile-time), so it sweeps as an outer host loop: one compiled
+  program per timeout value, everything else batched inside it
+  (``sweep_static``).
+
+The scored path (``scored_fleet``) carries the r7 telemetry counters
+under the batch axis and reduces them per scenario with ONE device fetch
+per journal block — ``chaos.score_blocks`` then turns each scenario's
+block slice into a verdict with its grid coordinates attached.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.sim import chaos
+from ringpop_tpu.sim.chaos import FaultPlan
+from ringpop_tpu.sim.lifecycle import LifecycleParams
+from ringpop_tpu.sim.montecarlo import MonteCarlo
+
+
+# -- grid construction (host-side) --------------------------------------------
+
+
+def mc_churn_doses(b_count: int, churn_max: int) -> list[int]:
+    """The dose ladder ``detection_latency_under_churn`` uses: dose j =
+    round(j/(B-1)·churn_max) — shared so the surface's churn axis cannot
+    drift from the committed 1-D slice's."""
+    return [round(b / max(b_count - 1, 1) * churn_max) for b in range(b_count)]
+
+
+def churn_dose_masks(
+    n: int, victims: Sequence[int], doses: Sequence[int], churn_seed: int
+) -> np.ndarray:
+    """``up[D, N]`` masks, one per dose: the study victims plus ``dose``
+    background crashes.  The rng sequence is EXACTLY the one
+    ``detection_latency_under_churn`` consumes (one ``choice`` per
+    non-zero dose, in dose order), so dose j's mask here is bit-equal to
+    replica j's mask there — the parity the loss-0 surface row rests on."""
+    victims = sorted(int(v) for v in victims)
+    rng = np.random.default_rng(churn_seed)
+    candidates = np.setdiff1d(np.arange(n), np.asarray(victims, np.int64))
+    up = np.ones((len(doses), n), bool)
+    up[:, victims] = False
+    for j, dose in enumerate(doses):
+        if dose:
+            down = rng.choice(candidates, size=int(dose), replace=False)
+            up[j, down] = False
+    return up
+
+
+def scenario_grid(
+    n: int,
+    *,
+    victims: Sequence[int],
+    doses: Sequence[int],
+    losses: Sequence[float] = (0.0,),
+    parts: Sequence[float] = (0.0,),
+    churn_seed: int = 1234,
+    part_from: int = 0,
+    part_until: Optional[int] = None,
+) -> tuple[FaultPlan, list[dict]]:
+    """Compile a (loss × part × churn-dose) grid into ONE stacked plan
+    plus its meta table.
+
+    Returns ``(plan, meta)``: ``plan`` is the ``[B, ...]`` stacked
+    FaultPlan (B = len(losses)·len(parts)·len(doses), loss-major /
+    dose-minor), ``meta[i]`` carries ``scenario_id``, the grid
+    coordinates (``churn``/``loss``/``part``) and ``dose_index`` —
+    callers seed scenario i with ``base_seed + dose_index`` so every
+    loss/part row reuses the churn slice's (seed, dose) pairing.  Churn
+    masks are drawn once per dose (``churn_dose_masks``) and shared
+    across rows; a non-zero ``part`` adds a symmetric split window
+    ``[part_from, part_until)`` over the first ``part`` fraction of
+    nodes."""
+    masks = churn_dose_masks(n, victims, doses, churn_seed)
+    plans, meta = [], []
+    for loss in losses:
+        for part in parts:
+            for j, dose in enumerate(doses):
+                legs = dict(
+                    base_up=jnp.asarray(masks[j]),
+                    drop_rate=jnp.asarray(np.float32(loss)),
+                )
+                if part > 0:
+                    group = np.zeros(n, np.int32)
+                    group[: int(part * n)] = 1
+                    legs.update(
+                        group=jnp.asarray(group),
+                        part_from=jnp.asarray(np.int32(part_from)),
+                        part_until=jnp.asarray(
+                            np.int32(part_until if part_until is not None else chaos.NO_TICK)
+                        ),
+                    )
+                plans.append(FaultPlan(**legs))
+                meta.append(
+                    {
+                        "scenario_id": len(meta),
+                        "churn": int(dose),
+                        "loss": float(loss),
+                        "part": float(part),
+                        "dose_index": j,
+                    }
+                )
+    return chaos.stack_plans(plans), meta
+
+
+def grid_seeds(meta: list[dict], base_seed: int) -> list[int]:
+    """Per-scenario seeds reusing the 1-D churn slice's pairing: scenario
+    i runs at ``base_seed + dose_index`` (every loss/part row replays the
+    same seeds, so rows differ only in the swept parameter)."""
+    return [base_seed + m["dose_index"] for m in meta]
+
+
+def sweep_static(values: Sequence[int], run_fn) -> dict:
+    """The static outer axis (suspicion timeout): ``run_fn(value)`` once
+    per value — one compiled program each, everything else batched inside
+    it.  Returns {value: result}.  Exists so the grid vocabulary names
+    ALL four axes even though one cannot ride the batch dimension (a
+    compile-time constant is a different program by definition)."""
+    return {int(v): run_fn(int(v)) for v in values}
+
+
+# -- fleet runners ------------------------------------------------------------
+
+
+def detect_surface(
+    params: LifecycleParams,
+    plan: FaultPlan,
+    seeds: Sequence[int],
+    victims: Sequence[int],
+    *,
+    max_ticks: int = 4096,
+    check_every: int = 1,
+    aot: Optional[str] = None,
+) -> tuple[np.ndarray, np.ndarray, dict]:
+    """First-detection ticks for every scenario of a stacked plan, in ONE
+    dispatch of the fleet detection program (1-tick resolution by
+    default, like the committed mc_churn slice).  Returns
+    ``(ticks[B], detected[B], aot_info)`` — ``aot_info`` carries the
+    front door's measured ``cache_hit``/``compile_s`` when a tag was
+    given (``{}`` otherwise)."""
+    mc = MonteCarlo(params, seeds, aot=aot)
+    ticks, detected = mc.run_until_detected(
+        victims, plan, max_ticks=max_ticks, check_every=check_every
+    )
+    return ticks, detected, next(iter(mc.aot_info.values()), {})
+
+
+def sequential_detect(
+    params: LifecycleParams,
+    plan: FaultPlan,
+    seeds: Sequence[int],
+    victims: Sequence[int],
+    *,
+    max_ticks: int = 4096,
+    check_every: int = 1,
+    fresh_compile: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The baseline the fleet replaces: B sequential solo runs, one per
+    scenario — one compile + one dispatch per grid point.
+    ``fresh_compile=True`` clears the jit caches between runs so the
+    measurement prices that workflow honestly inside one process (each
+    grid point of the pre-fleet sweep was its own bench invocation and
+    paid its own trace+compile); False prices the best-case warm-cache
+    sequential loop instead.  Both are reported by ``simbench mc_chaos``."""
+    ticks = np.full(len(seeds), -1, np.int64)
+    detected = np.zeros(len(seeds), bool)
+    for b, seed in enumerate(seeds):
+        if fresh_compile:
+            jax.clear_caches()
+        mc = MonteCarlo(params, [seed])
+        t, d = mc.run_until_detected(
+            victims,
+            chaos.index_plan(plan, b),
+            max_ticks=max_ticks,
+            check_every=check_every,
+        )
+        ticks[b], detected[b] = int(t[0]), bool(d[0])
+    return ticks, detected
+
+
+def scored_fleet(
+    params: LifecycleParams,
+    plan: FaultPlan,
+    meta: list[dict],
+    seeds: Sequence[int],
+    *,
+    horizon: int,
+    journal_every: int = 16,
+    sink=None,
+    scenario: str = "mc_chaos",
+) -> list[dict]:
+    """Run the fleet for ``horizon`` ticks with the telemetry counters
+    accumulated under the batch axis, journal one block record per
+    (scenario, block) — ONE device fetch per block for ALL scenarios —
+    and reduce each scenario's journal slice into a ``chaos.score_blocks``
+    verdict carrying its grid coordinates.  ``sink`` (a
+    ``telemetry.TelemetrySink`` or None) receives every per-scenario
+    block record and, when it journals, every score record."""
+    mc = MonteCarlo(params, seeds, telemetry=True)
+    blocks: list[list[dict]] = [[] for _ in meta]
+    ticks_left = horizon
+    while ticks_left > 0:
+        # exactly ``horizon`` ticks: full journal blocks plus one short
+        # remainder block (its own compile of the static-ticks program)
+        # when journal_every does not divide the horizon
+        mc.run(min(journal_every, ticks_left), plan)
+        ticks_left -= min(journal_every, ticks_left)
+        for rec in mc.fetch_telemetry(plan):
+            blocks[rec["scenario_id"]].append(rec)
+            if sink is not None:
+                sink(rec)
+    scores = []
+    for b, m in enumerate(meta):
+        sc = chaos.score_blocks(
+            blocks[b],
+            chaos.index_plan(plan, b),
+            n=params.n,
+            scenario=scenario,
+            scenario_id=b,
+        )
+        sc.update({k: v for k, v in m.items() if k != "scenario_id"})
+        scores.append(sc)
+        if sink is not None and getattr(sink, "journal", None) is not None:
+            sink.journal.score(sc)
+    return scores
+
+
+# -- surface reduction --------------------------------------------------------
+
+
+def response_surface(
+    meta: list[dict],
+    values: Sequence,
+    *,
+    rows: str = "loss",
+    cols: str = "churn",
+) -> dict:
+    """Reduce per-scenario values into a 2-D response surface keyed by
+    two grid axes.  Cells with several scenarios (a third axis collapsed)
+    take the median of their non-null values; cells where every value is
+    null stay null.  Returns ``{"row_axis", "rows", "col_axis", "cols",
+    "cells"}`` with ``cells[i][j]`` the value at (rows[i], cols[j])."""
+    row_vals = sorted({m[rows] for m in meta})
+    col_vals = sorted({m[cols] for m in meta})
+    buckets: dict[tuple, list] = {}
+    for m, v in zip(meta, values):
+        buckets.setdefault((m[rows], m[cols]), []).append(v)
+    cells = []
+    for r in row_vals:
+        row = []
+        for c in col_vals:
+            got = [v for v in buckets.get((r, c), []) if v is not None]
+            row.append(float(np.median(got)) if got else None)
+        cells.append(row)
+    return {
+        "row_axis": rows,
+        "rows": row_vals,
+        "col_axis": cols,
+        "cols": col_vals,
+        "cells": cells,
+    }
+
+
+def locate_cliff(curve: Sequence[tuple]) -> tuple[Optional[int], Optional[float]]:
+    """The dose at the largest jump between consecutive detected points
+    of a dose-response curve (the mc_churn cliff finder, factored here so
+    the 1-D slice and every surface row share one definition).  Takes
+    ``[(dose, ticks-or-None), ...]``; returns ``(cliff_at, jump)`` or
+    ``(None, None)`` when fewer than two points detected."""
+    pts = [(c, t) for c, t in curve if t is not None]
+    if len(pts) < 2:
+        return None, None
+    jump, at = max((t2 - t1, c2) for (_, t1), (c2, t2) in zip(pts, pts[1:]))
+    return at, jump

@@ -234,6 +234,27 @@ def fetch(
     return record, fresh
 
 
+def split_batched(record: dict, extra: Optional[dict] = None) -> list[dict]:
+    """Split ONE batched block record (every value ``[B]``-leading, the
+    output of a vmapped :func:`fetch`) into B per-scenario host records,
+    each tagged ``scenario_id`` — the one ``device_get`` that replaces B
+    per-scenario round-trips in the Monte-Carlo fleet.  ``extra`` merges
+    additional ``[B]`` columns (e.g. per-replica state digests) before
+    the split.  Scalars (no leading axis) broadcast to every record."""
+    host = jax.device_get({**record, **(extra or {})})
+    b = max(
+        (np.asarray(v).shape[0] for v in host.values() if np.ndim(v) >= 1),
+        default=1,
+    )
+    out = []
+    for i in range(b):
+        sliced = {
+            k: (np.asarray(v)[i] if np.ndim(v) >= 1 else v) for k, v in host.items()
+        }
+        out.append({"scenario_id": i, **_to_host(sliced)})
+    return out
+
+
 # -- order-sensitive state digest (journal pairing) --------------------------
 
 

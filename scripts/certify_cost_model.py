@@ -36,6 +36,11 @@ story"):
   schedule now hides.  Slower beyond noise REFUTES the pipelining (the
   fused switch costs more than the overlap buys), as does any
   bit-inequality.
+- (r12) the batched chaos fleet: ``mc_chaos`` — B stacked-FaultPlan
+  scenarios as one vmapped program vs the same B stepped sequentially,
+  both warm.  The model says the fleet amortizes per-dispatch overhead,
+  so it must be no slower per tick; slower REFUTES the fleet lowering,
+  as does any scenario's final state diverging from its solo run.
 
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
@@ -203,6 +208,32 @@ def main() -> int:
         )
     elif "error" in pe:
         verdicts.append(("pipelined exchange legs", None, pe["error"]))
+    # the r12 batched chaos fleet: B stacked-FaultPlan scenarios as one
+    # vmapped program vs the same B stepped sequentially (both warm — the
+    # compile-amortization half of the claim is the CPU SIMBENCH mc_chaos
+    # record).  The model says batching amortizes per-dispatch overhead,
+    # so the fleet must be no slower per tick than the sequential sweep;
+    # slower REFUTES the fleet lowering, as does any scenario's final
+    # state diverging from its solo run.
+    # "error" wins even when both medians landed first: a crash in the
+    # bit_equal comparison (host transfer of the fleet state) leaves the
+    # medians behind, and that run is INCONCLUSIVE, not a refutation.
+    mc = cap.get("mc_chaos") or {}
+    if "error" in mc:
+        verdicts.append(("batched chaos fleet", None, mc["error"]))
+    elif mc.get("batched_ms_per_tick_median") is not None and mc.get(
+        "sequential_ms_per_tick_median"
+    ) is not None:
+        b_ms, s_ms = mc["batched_ms_per_tick_median"], mc["sequential_ms_per_tick_median"]
+        ok = bool(mc.get("bit_equal")) and b_ms <= s_ms * 1.05
+        verdicts.append(
+            (f"batched chaos fleet (B={mc.get('b')}, n={mc.get('n')}, "
+             f"sharded={mc.get('sharded')})",
+             ok,
+             f"batched {b_ms} vs sequential {s_ms} ms/tick "
+             f"(amortization {round(s_ms / max(b_ms, 1e-9), 2)}x), "
+             f"bit_equal={mc.get('bit_equal')}")
+        )
     prof = next(
         ((p, budget) for p, budget in
          ((os.path.join(REPO, "captures", f), b) for f, b in BUDGET_CAPTURES)

@@ -1,0 +1,166 @@
+"""mc-smoke — the CI gate for the batched chaos fleet (r12 tentpole:
+``chaos.stack_plans`` + the Monte-Carlo fleet + ``sim/scenarios.py``).
+
+Runs a tiny churn×loss scenario grid through the batched machinery and
+asserts:
+
+1. **B=1 identity**: a single-member stacked plan run through the fleet
+   (vmapped step, batched telemetry) ends bit-identical — state digest
+   AND telemetry block record — to the same plan through the solo
+   ``LifecycleSim`` chaos path.  The batch axis must never change a
+   member's trajectory.
+2. **Scored-journal round-trip**: the fleet journal (one header, B block
+   records per fetch each tagged ``scenario_id``, one ``kind: "score"``
+   verdict per scenario with its grid coordinates) parses back equal.
+3. **Surface shape**: the grid's detection response surface has one cell
+   per (loss, dose) and the batched first-detection ticks match a solo
+   re-run of one probe scenario exactly.
+
+Exit 0 on success, 1 with a diagnosis on any failure.  Wall cost is a
+few seconds (n=128) — wired into `make test` next to chaos-smoke.
+
+Usage:
+    python scripts/mc_smoke.py [--out /tmp/mc_smoke.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="journal path (default: temp file)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from ringpop_tpu.sim import chaos, lifecycle, scenarios, telemetry
+    from ringpop_tpu.sim.montecarlo import MonteCarlo
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    configure_compile_cache()
+
+    path = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="mcsmoke_"), "mc_smoke.jsonl"
+    )
+    n, k, seed, horizon, block = 128, 16, 0, 64, 16
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=6, rng="counter")
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=2, replace=False).tolist())
+    doses = [0, 4]
+    losses = (0.0, 0.1)
+    plan, meta = scenarios.scenario_grid(
+        n, victims=victims, doses=doses, losses=losses, churn_seed=seed + 777
+    )
+    seeds = scenarios.grid_seeds(meta, seed)
+    failures: list[str] = []
+
+    # -- 1: B=1 identity (fleet vs solo LifecycleSim, same chaos plan) -------
+    solo_plan = chaos.scenario_plan("smoke", n, seed=seed, horizon=horizon)
+    b1 = chaos.stack_plans([solo_plan])
+    mc1 = MonteCarlo(params, [seed], telemetry=True)
+    recs1 = []
+    for _ in range(horizon // block):
+        mc1.run(block, b1)
+        recs1.append(mc1.fetch_telemetry(b1)[0])
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(
+        n=n, k=k, seed=seed, suspect_ticks=6, rng="counter", telemetry=sink
+    )
+    for _ in range(horizon // block):
+        sim.run(block, solo_plan)
+    solo_digest = int(telemetry.tree_digest(sim.state))
+    if recs1[-1]["state_digest"] != solo_digest:
+        failures.append(
+            f"B=1 state digest {recs1[-1]['state_digest']:#010x} != solo "
+            f"{solo_digest:#010x}"
+        )
+    for i, (fleet_rec, solo_rec) in enumerate(zip(recs1, sink.records)):
+        for key in solo_rec:
+            if key in ("state_digest",):
+                continue
+            if fleet_rec.get(key) != solo_rec[key]:
+                failures.append(
+                    f"B=1 telemetry block {i} field {key!r}: fleet "
+                    f"{fleet_rec.get(key)} != solo {solo_rec[key]}"
+                )
+                break
+
+    # -- 2: scored journal round-trip over the tiny grid ---------------------
+    with telemetry.TelemetryJournal(path) as journal:
+        journal.header(
+            "lifecycle", "mc-smoke",
+            {"n": n, "k": k, "seed": seed, "grid": {"doses": doses, "losses": list(losses)}},
+        )
+        gsink = telemetry.TelemetrySink(journal=journal)
+        scores = scenarios.scored_fleet(
+            params, plan, meta, seeds, horizon=horizon, journal_every=block,
+            sink=gsink, scenario="mc-smoke",
+        )
+    try:
+        records = telemetry.read_journal(path)
+    except Exception as e:  # noqa: BLE001 — the diagnosis IS the product
+        records = []
+        failures.append(f"journal unparseable: {type(e).__name__}: {e}")
+    jblocks = [r for r in records if r.get("kind") == "block"]
+    jscores = [r for r in records if r.get("kind") == "score"]
+    if len(jscores) != len(meta):
+        failures.append(f"expected {len(meta)} score records, found {len(jscores)}")
+    if {b.get("scenario_id") for b in jblocks} != set(range(len(meta))):
+        failures.append("journal blocks missing scenario_id coverage")
+    for s in jscores:
+        if "churn" not in s or "loss" not in s:
+            failures.append("score record lost its grid coordinates")
+            break
+    by_id = {s["scenario_id"]: s for s in jscores if "scenario_id" in s}
+    if by_id and scores:
+        want = scores[0]["false_positive_suspects"]
+        if by_id.get(0, {}).get("false_positive_suspects") != want:
+            failures.append("journaled score differs from the computed one")
+
+    # -- 3: surface shape + one-probe solo agreement -------------------------
+    ticks, detected, _ = scenarios.detect_surface(
+        params, plan, seeds, victims, max_ticks=512, check_every=4
+    )
+    surface = scenarios.response_surface(
+        meta, [int(t) if d else None for t, d in zip(ticks, detected)],
+        rows="loss", cols="churn",
+    )
+    if (len(surface["cells"]), len(surface["cells"][0])) != (len(losses), len(doses)):
+        failures.append(f"surface shape {np.shape(surface['cells'])} != grid")
+    probe = len(meta) - 1  # highest-loss, highest-dose corner
+    mc_solo = MonteCarlo(params, [seeds[probe]])
+    t_solo, d_solo = mc_solo.run_until_detected(
+        victims, chaos.stack_plans([chaos.index_plan(plan, probe)]),
+        max_ticks=512, check_every=4,
+    )
+    if (int(t_solo[0]), bool(d_solo[0])) != (int(ticks[probe]), bool(detected[probe])):
+        failures.append(
+            f"probe scenario {probe}: solo ticks {int(t_solo[0])} != "
+            f"batched {int(ticks[probe])}"
+        )
+
+    if failures:
+        print("mc-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(
+        f"mc-smoke: OK — B={len(meta)} grid scored ({len(jscores)} verdicts, "
+        f"{len(jblocks)} blocks) at {path}; B=1 fleet digest-equal to solo "
+        f"({solo_digest:#010x}); surface {len(losses)}x{len(doses)} with "
+        f"{int(np.asarray(detected).sum())}/{len(meta)} detected"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

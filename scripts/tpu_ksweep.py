@@ -18,6 +18,11 @@ every section so a mid-run tunnel death still leaves partial evidence):
    FaultPlan evaluated inside the jitted step) vs the plain tick, at the
    same config; sharded over the visible chips when >1 (the number that
    certifies the chaos plane's claimed ~zero overhead on real ICI).
+1e. **mc_chaos** — the r12 batched chaos fleet: B=16 stacked-FaultPlan
+   (churn×loss) scenarios stepped as ONE vmapped program vs the same 16
+   stepped sequentially, both warm; sharded (batch replicated,
+   node/rumor canonical) when >1 chip.  Judged by certify_cost_model:
+   the fleet must be no slower per tick and bit-equal per scenario.
 2. Headline detection at the official config (k=256, 1000 victims),
    fresh state, wall + ticks; cross-checked against the cost model.
 3. Convergence (view-checksum agreement + quiescence) continuing from
@@ -431,6 +436,113 @@ def main() -> None:
             )
     except Exception as e:  # pragma: no cover - hardware-dependent
         out.setdefault("chaos_tick", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
+    # -- 1e: mc_chaos — the r12 batched chaos fleet vs sequential B runs ----
+    # B=16 (churn dose x loss) scenarios — a stacked FaultPlan grid
+    # (sim/scenarios.py) — stepped as ONE vmapped program vs the same 16
+    # stepped one at a time.  Both sides warm: the compile-amortization
+    # half of the claim is priced on CPU in SIMBENCH mc_chaos; this
+    # section prices the per-dispatch amortization on real hardware.
+    # Sharded over every visible chip when the window exposes >1 device
+    # (batch axis replicated, node/rumor canonical —
+    # montecarlo.fleet_state_shardings).  certify_cost_model REFUTES if
+    # the fleet is slower than the sequential loop or any scenario's
+    # final state diverges from its solo run (bit_equal).
+    try:
+        import functools as _ft
+
+        from ringpop_tpu.sim import chaos, montecarlo, scenarios
+
+        n_mc = int(os.environ.get("KSWEEP_MC_N", 16384))
+        k_mc = 64
+        mc_ticks = block
+        rng2 = np.random.default_rng(1)
+        mc_victims = sorted(rng2.choice(n_mc, size=8, replace=False).tolist())
+        doses = scenarios.mc_churn_doses(8, n_mc // 32)
+        plan, meta = scenarios.scenario_grid(
+            n_mc, victims=mc_victims, doses=doses, losses=(0.0, 0.05),
+            churn_seed=777,
+        )
+        seeds = scenarios.grid_seeds(meta, 0)
+        b_mc = len(meta)
+        params_mc = lifecycle.LifecycleParams(
+            n=n_mc, k=k_mc, suspect_ticks=10, rng="counter"
+        )
+        sharded = len(jax.devices()) > 1 and out["platform"] != "cpu"
+        sec = {"n": n_mc, "k": k_mc, "b": b_mc, "block_ticks": mc_ticks,
+               "sharded": sharded}
+        out["mc_chaos"] = sec
+        blk = jax.jit(
+            _ft.partial(montecarlo._mc_block, params_mc), static_argnames="ticks"
+        )
+        bstate = montecarlo.init_replicas(params_mc, seeds)
+        if sharded:
+            from jax.sharding import Mesh
+
+            n_dev = len(jax.devices())
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            sec["n_devices"] = n_dev
+            sec["mesh"] = f"{n_dev // rumor}x{rumor} (node x rumor), batch replicated"
+            bstate = jax.tree.map(
+                jax.device_put, bstate,
+                montecarlo.fleet_state_shardings(mesh, k=k_mc),
+            )
+        bstate = blk(bstate, plan, ticks=mc_ticks)
+        jax.block_until_ready(bstate.learned)  # compile + warm block 1
+        per_rep = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            bstate = blk(bstate, plan, ticks=mc_ticks)
+            jax.block_until_ready(bstate.learned)
+            per_rep.append(time.perf_counter() - t0)
+        sec["batched_ms_per_tick_median"] = round(
+            sorted(per_rep)[len(per_rep) // 2] / mc_ticks * 1e3, 3
+        )
+        flush()
+        # sequential loop: B=1 slices of the same grid, one compile shared
+        # (warm), run for the SAME total blocks so finals are comparable
+        finals = [
+            montecarlo.init_replicas(params_mc, [seeds[b2]]) for b2 in range(b_mc)
+        ]
+        if sharded:
+            # same mesh on both sides: an unsharded baseline would hand the
+            # fleet an n_devices x hardware advantage and the certificate
+            # would stop pricing dispatch amortization
+            finals = [
+                jax.tree.map(
+                    jax.device_put, f,
+                    montecarlo.fleet_state_shardings(mesh, k=k_mc),
+                )
+                for f in finals
+            ]
+        solo_plans = [
+            chaos.stack_plans([chaos.index_plan(plan, b2)]) for b2 in range(b_mc)
+        ]
+        per_rep = []
+        for r in range(1 + reps):
+            t0 = time.perf_counter()
+            for b2 in range(b_mc):
+                finals[b2] = blk(finals[b2], solo_plans[b2], ticks=mc_ticks)
+            jax.block_until_ready(finals[-1].learned)
+            if r > 0:  # rep 0 pays the B=1 compile — warm parity with batched
+                per_rep.append(time.perf_counter() - t0)
+        sec["sequential_ms_per_tick_median"] = round(
+            sorted(per_rep)[len(per_rep) // 2] / mc_ticks * 1e3, 3
+        )
+        # one host transfer per fleet leaf, not one per (leaf, scenario)
+        host_b = [np.asarray(a) for a in jax.tree_util.tree_leaves(bstate)]
+        sec["bit_equal"] = all(
+            bool((hb[b2] == np.asarray(c)[0]).all())
+            for b2, fin in enumerate(finals)
+            for hb, c in zip(host_b, jax.tree_util.tree_leaves(fin))
+        )
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        out.setdefault("mc_chaos", {})["error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
 
     # -- 2+3: headline detection then convergence at the official config ----

@@ -16,11 +16,23 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
 
 from ringpop_tpu.parallel.multihost import make_multihost_mesh
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+# the two-process bring-up path (init_distributed in each worker) probes
+# jax.distributed.is_initialized, which this container's jax 0.4.37
+# lacks — the workers would die with AttributeError before any collective
+# runs, so the test can only certify anything on a newer jax.  Skip with
+# the reason instead of failing pre-existing (ISSUE 7 satellite).
+requires_distributed_api = pytest.mark.skipif(
+    not hasattr(jax.distributed, "is_initialized"),
+    reason="jax.distributed.is_initialized unavailable (jax "
+    f"{jax.__version__} < 0.5): multihost bring-up cannot initialize",
+)
 
 
 def _free_port() -> int:
@@ -38,6 +50,7 @@ def test_single_host_mesh_shape():
 
 
 @pytest.mark.slow
+@requires_distributed_api
 def test_two_process_mesh_runs_sharded_step():
     port = _free_port()
     env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(WORKER)))

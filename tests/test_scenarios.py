@@ -1,0 +1,372 @@
+"""Batched chaos fleet (r12): stacking semantics, B=1 bit-identity, and
+the scenario-grid compiler.
+
+The tentpole claim is strong: a stacked ``[B, ...]`` FaultPlan run
+through the vmapped fleet is bit-for-bit the B solo runs — state AND
+telemetry — with materialized default legs changing nothing.  These
+tests pin that, plus the grid compiler's parity contract with the
+committed mc_churn 1-D slice (same rng sequence → same masks → the
+loss-0 surface row IS the slice).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import chaos, delta, lifecycle, scenarios, telemetry
+from ringpop_tpu.sim.chaos import FaultPlan
+from ringpop_tpu.sim.montecarlo import MonteCarlo
+
+N, K = 128, 16
+PARAMS = dict(n=N, k=K, suspect_ticks=6, rng="counter")
+
+
+# -- stacking semantics -------------------------------------------------------
+
+
+def test_stack_plans_legs_and_defaults():
+    plans = [
+        chaos.scenario_plan("churn", N, seed=0, horizon=64),
+        chaos.scenario_plan("asym", N, seed=1, horizon=64),
+    ]
+    stacked = chaos.stack_plans(plans)
+    assert chaos.plan_batch_size(stacked) == 2
+    # churn member materialized an identity reach (the asym member has one)
+    assert stacked.reach.shape == (2, 2, 2)
+    np.testing.assert_array_equal(np.asarray(stacked.reach[0]), np.eye(2, dtype=bool))
+    # legs set by NO member stay None (compile out)
+    assert stacked.drop_node is None
+    # crash legs: member 1 (asym rides a small churn cohort) keeps its own
+    np.testing.assert_array_equal(
+        np.asarray(stacked.crash_tick[0]), np.asarray(plans[0].crash_tick)
+    )
+
+
+def test_stack_plans_rejects_already_stacked_and_empty():
+    stacked = chaos.stack_plans([chaos.scenario_plan("churn", N, seed=0)])
+    with pytest.raises(ValueError, match="SOLO"):
+        chaos.stack_plans([stacked])
+    with pytest.raises(ValueError, match="at least one"):
+        chaos.stack_plans([])
+
+
+def test_plan_axes_and_index_round_trip():
+    plans = [
+        chaos.scenario_plan("churn", N, seed=0, horizon=64),
+        chaos.scenario_plan("flap", N, seed=1, horizon=64),
+    ]
+    stacked = chaos.stack_plans(plans)
+    axes = chaos.plan_axes(stacked)
+    for field in stacked._fields:
+        leg, ax = getattr(stacked, field), getattr(axes, field)
+        assert (leg is None) == (ax is None), field
+        if leg is not None:
+            assert ax == 0
+    # solo plans report nothing batched
+    assert chaos.plan_axes(plans[0]) is None
+    assert chaos.plan_batch_size(plans[0]) is None
+    # index_plan(stack_plans(ps), b) evaluates like ps[b] at every tick
+    for b in range(2):
+        member = chaos.index_plan(stacked, b)
+        for t in (0, 7, 31, 63):
+            got = chaos.up_at_host(member, t, N)
+            want = chaos.up_at_host(plans[b], t, N)
+            np.testing.assert_array_equal(got, want, err_msg=f"b={b} t={t}")
+
+
+def test_mixed_batch_sizes_rejected():
+    a = FaultPlan(drop_rate=jnp.zeros((2,), jnp.float32))
+    b = FaultPlan(base_up=jnp.ones((3, N), bool))
+    merged = FaultPlan(drop_rate=a.drop_rate, base_up=b.base_up)
+    with pytest.raises(ValueError, match="mixed batch sizes"):
+        chaos.plan_batch_size(merged)
+
+
+def test_default_legs_are_value_neutral():
+    """A plan stacked alongside a leg-richer member must produce the SAME
+    trajectory it produces solo: the materialized defaults (NO_TICK crash
+    windows, zero flap periods, group -1, 0.0 loss, identity reach) are
+    inert by construction."""
+    lean = chaos.churn_plan(N, n_churn=4, n_permanent=2, first=4, waves=2, seed=3)
+    rich = chaos.scenario_plan("asym", N, seed=1, horizon=64)
+    stacked = chaos.stack_plans([lean, rich])
+    params = lifecycle.LifecycleParams(**PARAMS)
+    mc = MonteCarlo(params, [5, 6])
+    mc.run(24, stacked)
+    solo = lifecycle.LifecycleSim(seed=5, **PARAMS)
+    solo.run(24, lean)
+    for field in solo.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(mc.states, field))[0],
+            np.asarray(getattr(solo.state, field)),
+            err_msg=field,
+        )
+
+
+def test_run_until_detected_refuses_armed_telemetry():
+    # the fleet detection loop does not carry the counter accumulator —
+    # it must refuse loudly rather than pair advanced state with stale
+    # counters in the next fetch_telemetry journal
+    mc = MonteCarlo(lifecycle.LifecycleParams(**PARAMS), [0], telemetry=True)
+    with pytest.raises(ValueError, match="telemetry"):
+        mc.run_until_detected([3], max_ticks=16)
+
+
+# -- B=1 / heterogeneous bit-identity (the ISSUE 7 acceptance pins) ----------
+
+
+def test_b1_stacked_lifecycle_bit_identical_state_and_telemetry():
+    plan = chaos.scenario_plan("smoke", N, seed=0, horizon=64)
+    params = lifecycle.LifecycleParams(**PARAMS)
+    mc = MonteCarlo(params, [0], telemetry=True)
+    fleet_blocks = []
+    for _ in range(4):
+        mc.run(16, chaos.stack_plans([plan]))
+        fleet_blocks.append(mc.fetch_telemetry(chaos.stack_plans([plan]))[0])
+
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(seed=0, telemetry=sink, **PARAMS)
+    for _ in range(4):
+        sim.run(16, plan)
+
+    assert fleet_blocks[-1]["state_digest"] == int(telemetry.tree_digest(sim.state))
+    for field in sim.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(mc.states, field))[0],
+            np.asarray(getattr(sim.state, field)),
+            err_msg=field,
+        )
+    for i, (got, want) in enumerate(zip(fleet_blocks, sink.records)):
+        for key, v in want.items():
+            if key == "state_digest":
+                continue
+            assert got[key] == v, (i, key, got[key], v)
+
+
+def test_b1_stacked_delta_bit_identical():
+    """The delta engine batches through the same seam: a B=1 stacked plan
+    vmapped over ``delta.step`` ends bit-identical (state digest AND
+    coverage record) to the solo DeltaSim chaos run."""
+    plan = chaos.scenario_plan("smoke", N, seed=0, horizon=64)
+    stacked = chaos.stack_plans([plan])
+    axes = chaos.plan_axes(stacked)
+    params = delta.DeltaParams(n=N, k=K, rng="counter")
+    state_b = jax.tree.map(lambda x: x[None], delta.init_state(params, seed=0))
+    vstep = jax.vmap(lambda s, p: delta.step(params, s, p), in_axes=(0, axes))
+    blk = jax.jit(lambda s, p: jax.lax.fori_loop(0, 32, lambda _, c: vstep(c, p), s))
+    out = blk(state_b, stacked)
+
+    sim = delta.DeltaSim(n=N, k=K, seed=0, rng="counter")
+    for _ in range(32):
+        sim.tick(plan)
+    for field in sim.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, field))[0],
+            np.asarray(getattr(sim.state, field)),
+            err_msg=field,
+        )
+    assert int(telemetry.tree_digest(jax.tree.map(lambda x: x[0], out))) == int(
+        telemetry.tree_digest(sim.state)
+    )
+    rec_fleet = telemetry.delta_record(jax.tree.map(lambda x: x[0], out), plan)
+    rec_solo = telemetry.delta_record(sim.state, plan)
+    assert float(rec_fleet["coverage"]) == float(rec_solo["coverage"])
+
+
+def test_heterogeneous_batch_reproduces_solo_digests():
+    plans = [
+        chaos.scenario_plan("churn", N, seed=0, horizon=64),
+        chaos.scenario_plan("flap", N, seed=1, horizon=64),
+        chaos.scenario_plan("asym", N, seed=2, horizon=64),
+    ]
+    stacked = chaos.stack_plans(plans)
+    seeds = [3, 7, 11]
+    params = lifecycle.LifecycleParams(**PARAMS)
+    mc = MonteCarlo(params, seeds, telemetry=True)
+    mc.run(32, stacked)
+    recs = mc.fetch_telemetry(stacked)
+    assert [r["scenario_id"] for r in recs] == [0, 1, 2]
+    for b, (plan, seed) in enumerate(zip(plans, seeds)):
+        sink = telemetry.TelemetrySink()
+        sim = lifecycle.LifecycleSim(seed=seed, telemetry=sink, **PARAMS)
+        sim.run(32, plan)
+        assert recs[b]["state_digest"] == int(telemetry.tree_digest(sim.state)), b
+        for key in ("ping_send", "refuted", "decl_suspect", "detect_frac",
+                    "census_alive", "heal_attempts"):
+            assert recs[b][key] == sink.records[0][key], (b, key)
+
+
+# -- the scenario-grid compiler ----------------------------------------------
+
+
+def test_grid_meta_ordering_and_seeds():
+    doses = [0, 4, 8]
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=doses, losses=(0.0, 0.1), churn_seed=1
+    )
+    assert chaos.plan_batch_size(plan) == 6
+    assert [m["churn"] for m in meta] == doses * 2
+    assert [m["loss"] for m in meta] == [0.0] * 3 + [0.1] * 3
+    assert scenarios.grid_seeds(meta, 100) == [100, 101, 102, 100, 101, 102]
+    # dose masks shared across loss rows (drawn once per dose)
+    np.testing.assert_array_equal(
+        np.asarray(plan.base_up[1]), np.asarray(plan.base_up[4])
+    )
+
+
+def test_churn_masks_match_mc_churn_rng_sequence():
+    """The parity contract under the loss-0 surface row: same rng
+    consumption as detection_latency_under_churn's mask loop."""
+    victims = [3, 9]
+    doses = scenarios.mc_churn_doses(4, 12)
+    masks = scenarios.churn_dose_masks(N, victims, doses, churn_seed=77)
+    rng = np.random.default_rng(77)
+    candidates = np.setdiff1d(np.arange(N), np.asarray(victims, np.int64))
+    up = np.ones((4, N), bool)
+    up[:, victims] = False
+    for b in range(4):
+        extra = round(b / 3 * 12)
+        if extra:
+            up[b, rng.choice(candidates, size=extra, replace=False)] = False
+    np.testing.assert_array_equal(masks, up)
+
+
+def test_loss0_row_matches_unbatched_churn_study():
+    """End-to-end parity at test scale: the fleet's loss-0 row equals the
+    committed 1-D study machinery tick-for-tick (same seeds, same masks,
+    same detection predicate at 1-tick resolution)."""
+    from ringpop_tpu.sim.montecarlo import detection_latency_under_churn
+
+    n, b, seed = 256, 4, 0
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=2, replace=False).tolist())
+    out = detection_latency_under_churn(
+        n=n, seeds=range(seed, seed + b), victims=victims, churn_max=8,
+        k=16, max_ticks=512, churn_seed=seed + 777,
+    )
+    doses = scenarios.mc_churn_doses(b, 8)
+    plan, meta = scenarios.scenario_grid(
+        n, victims=victims, doses=doses, losses=(0.0, 0.05),
+        churn_seed=seed + 777,
+    )
+    params = lifecycle.LifecycleParams(n=n, k=16)
+    ticks, det, _ = scenarios.detect_surface(
+        params, plan, scenarios.grid_seeds(meta, seed), victims,
+        max_ticks=512, check_every=1,
+    )
+    row0 = [int(t) if d else None for t, d in zip(ticks[:b], det[:b])]
+    assert row0 == [t for _, t in out["churn_ticks"]]
+
+
+def test_response_surface_and_cliff():
+    meta = [
+        {"churn": c, "loss": l} for l in (0.0, 0.1) for c in (0, 10, 20)
+    ]
+    values = [10, 11, 40, 12, None, 44]
+    surf = scenarios.response_surface(meta, values, rows="loss", cols="churn")
+    assert surf["rows"] == [0.0, 0.1] and surf["cols"] == [0, 10, 20]
+    assert surf["cells"] == [[10.0, 11.0, 40.0], [12.0, None, 44.0]]
+    at, jump = scenarios.locate_cliff(list(zip(surf["cols"], surf["cells"][0])))
+    assert (at, jump) == (20, 29.0)
+    assert scenarios.locate_cliff([(0, None), (1, 5)]) == (None, None)
+
+
+def test_scored_fleet_verdicts_carry_grid_coordinates():
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=[0, 4], losses=(0.0, 0.1), churn_seed=1
+    )
+    params = lifecycle.LifecycleParams(**PARAMS)
+    scores = scenarios.scored_fleet(
+        params, plan, meta, scenarios.grid_seeds(meta, 0), horizon=32,
+        journal_every=16, scenario="test",
+    )
+    assert len(scores) == 4
+    for i, s in enumerate(scores):
+        assert s["scenario_id"] == i
+        assert s["kind"] == "score" and s["scenario"] == "test"
+        assert (s["churn"], s["loss"]) == (meta[i]["churn"], meta[i]["loss"])
+        assert s["blocks"] == 2 and s["ticks"] == 32
+
+
+def test_split_batched_one_fetch_per_block():
+    rec = {"a": jnp.arange(3), "b": jnp.float32(1.5), "tick": jnp.asarray([4, 4, 4])}
+    out = telemetry.split_batched(rec, {"extra": jnp.asarray([7, 8, 9])})
+    assert [r["scenario_id"] for r in out] == [0, 1, 2]
+    assert [r["a"] for r in out] == [0, 1, 2]
+    assert all(r["b"] == 1.5 for r in out)
+    assert [r["extra"] for r in out] == [7, 8, 9]
+
+
+def test_sweep_static_suspect_ticks_outer_axis():
+    """The fourth grid axis: suspicion timeout cannot ride the batch
+    dimension (a compile-time constant is a different program), so it
+    sweeps as a static outer loop — ``sweep_static`` composing with the
+    batched fleet, one compiled program per timeout value.  Longer
+    suspicion must never speed up faulty declaration."""
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=[0, 4], losses=(0.0,), churn_seed=1
+    )
+    seeds = scenarios.grid_seeds(meta, 0)
+
+    def run(suspect_ticks):
+        params = lifecycle.LifecycleParams(
+            n=N, k=K, suspect_ticks=suspect_ticks, rng="counter"
+        )
+        ticks, detected, _ = scenarios.detect_surface(
+            params, plan, seeds, [3, 9], max_ticks=256
+        )
+        assert bool(np.asarray(detected).all())
+        return [int(t) for t in ticks]
+
+    out = scenarios.sweep_static([4, 12], run)
+    assert sorted(out) == [4, 12]
+    assert all(a <= b for a, b in zip(out[4], out[12]))
+    assert out[4] != out[12]  # the timeout genuinely moved detection
+
+
+def test_plan_events_stacked_defaults_are_eventless():
+    """The materialized stacked defaults must be event-neutral too: a
+    part=0 member of a partitioned grid reports NO partition/heal events,
+    and a never-healing split (part_until=None -> NO_TICK in the stacked
+    encoding) reports a partition but NO heal — same as its solo form."""
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3], doses=[0], losses=(0.0,), parts=(0.0, 0.25),
+        churn_seed=1, part_from=2, part_until=None,
+    )
+    kinds0 = [e["kind"] for e in chaos.plan_events(chaos.index_plan(plan, 0))]
+    assert "partition" not in kinds0 and "heal" not in kinds0
+    events1 = chaos.plan_events(chaos.index_plan(plan, 1))
+    kinds1 = [e["kind"] for e in events1]
+    assert "partition" in kinds1 and "heal" not in kinds1
+    part = next(e for e in events1 if e["kind"] == "partition")
+    assert part["tick"] == 2 and part["nodes"] == N // 4
+
+
+def test_stack_plans_reach_pads_to_symmetric_group_range():
+    """The padded identity reach must cover every member's group-id
+    range, not just the reach-carrying members' G: a symmetric member
+    using group id 2 stacked with a [2,2]-reach member previously got
+    eye(2), and its id-2 rows clamped into group 1's — connecting groups
+    its solo run keeps apart."""
+    group = np.full(N, -1, np.int32)
+    group[:4], group[4:8], group[8:12] = 0, 1, 2
+    sym = FaultPlan(
+        group=jnp.asarray(group),
+        part_from=jnp.asarray(0, jnp.int32),
+        part_until=jnp.asarray(64, jnp.int32),
+    )
+    asym = chaos.scenario_plan("asym", N, seed=1, horizon=64)  # reach [2, 2]
+    stacked = chaos.stack_plans([sym, asym])
+    assert stacked.reach.shape[1:] == (3, 3)
+    np.testing.assert_array_equal(np.asarray(stacked.reach[0]), np.eye(3, dtype=bool))
+    a = jnp.asarray([0, 4, 8, 8], jnp.int32)  # groups 0, 1, 2, 2
+    b = jnp.asarray([4, 8, 9, 0], jnp.int32)  # groups 1, 2, 2, 0
+    solo = delta.pair_connected(chaos.faults_at(sym, jnp.int32(1)), a, b)
+    member = delta.pair_connected(
+        chaos.faults_at(chaos.index_plan(stacked, 0), jnp.int32(1)), a, b
+    )
+    assert np.asarray(solo).tolist() == [False, False, True, False]
+    np.testing.assert_array_equal(np.asarray(member), np.asarray(solo))
