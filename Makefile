@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -42,6 +42,15 @@ chaos-smoke:
 # must round-trip, and the response surface must match a solo probe.
 mc-smoke:
 	$(PY) scripts/mc_smoke.py
+
+# serve-the-ring gate (serve/): tiny 2-frontend shared-memory A/B —
+# owner digests serve==bisect per (worker, rep), generation-pinned
+# answers, live-update re-certification, B=1 oracle match, serve-journal
+# telemetry schema, DGRO movement gate.  Correctness only: throughput
+# ratios are priced by the committed SIMBENCH serve_ring artifact, not
+# asserted here (2-core CI container).
+serve-smoke:
+	$(PY) scripts/serve_smoke.py
 
 # AOT warm-start gate (util/aot.py): serialize the sharded (pipelined)
 # tick block, reload it through the front door in a fresh subprocess —

@@ -486,6 +486,31 @@ def run_bench() -> None:
     jax.block_until_ready(_qps_loop(tokens, owners, hashes))
     ring_qps = batch * 10 / (time.perf_counter() - t_r)
 
+    # -- the serve tier's resident program (r13, PERF.md "serve the ring"):
+    # the same ring at FIXED capacity with traced live count + generation —
+    # the fused dispatch the shared serving collector amortizes across
+    # frontend processes.  Measured with the same jitted-loop methodology
+    # so the headline record prices the padding + generation fusion the
+    # serving path actually pays.
+    from ringpop_tpu.serve.state import device_ring, serve_lookup_fused
+
+    sring = device_ring(
+        np.asarray(tokens), np.asarray(owners), 2 * int(tokens.shape[0])
+    )
+
+    @jax.jit
+    def _serve_loop(ring, hashes):
+        def body(i, acc):
+            out = serve_lookup_fused(ring, hashes + i.astype(hashes.dtype))
+            return acc + out.astype(jax.numpy.uint32).sum()
+
+        return jax.lax.fori_loop(0, 10, body, jax.numpy.uint32(0))
+
+    jax.block_until_ready(_serve_loop(sring, hashes))  # compile
+    t_r = time.perf_counter()
+    jax.block_until_ready(_serve_loop(sring, hashes))
+    serve_qps = batch * 10 / (time.perf_counter() - t_r)
+
     baseline_s = 60.0  # BASELINE.json north star
     baseline_n = 1_000_000
     # vs_baseline is only honest when the metric's scale matches the
@@ -538,6 +563,7 @@ def run_bench() -> None:
         "delta_aot_compile_s": delta_aot["compile_s"],
         "delta_aot_error": delta_aot["error"],
         "ring_lookup_qps": round(ring_qps, 0),
+        "serve_lookup_qps": round(serve_qps, 0),
         "view_checksum_s": round(checksum_s, 4),
         "platform": platform,
         # lets the parent purge exactly this dir if the XLA:CPU AOT loader

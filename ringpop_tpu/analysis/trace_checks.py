@@ -321,19 +321,29 @@ def census_of_text(hlo_text: str) -> dict:
         os.unlink(path)
 
 
+def _census_parse_guard(entry: str, census: dict) -> list[Finding]:
+    """RPJ000 when the census parsed nothing — a zero-computation parse
+    means dump/text format drift, and NO census verdict can be trusted
+    until ``analysis/hlo_census.parse_collectives`` is fixed."""
+    if census.get("total_computations", 0) != 0:
+        return []
+    return [
+        Finding(
+            "RPJ000", f"<trace:{entry}>", 0, entry,
+            "compiled-HLO census parsed ZERO computations from a "
+            "non-trivial module — dump/text format drift; fix "
+            "analysis/hlo_census.parse_collectives before trusting "
+            "any confinement result",
+        )
+    ]
+
+
 def check_hlo_confinement(entry: str, hlo_text: str) -> list[Finding]:
     census = census_of_text(hlo_text)
     findings = []
-    if census.get("total_computations", 0) == 0:
-        return [
-            Finding(
-                "RPJ000", f"<trace:{entry}>", 0, entry,
-                "compiled-HLO census parsed ZERO computations from a "
-                "non-trivial module — dump/text format drift; fix "
-                "analysis/hlo_census.parse_collectives before trusting "
-                "any confinement result",
-            )
-        ]
+    guard = _census_parse_guard(entry, census)
+    if guard:
+        return guard
     rows = list(hlo_census.executed_rows(census))
     if not rows:
         return [
@@ -359,6 +369,30 @@ def check_hlo_confinement(entry: str, hlo_text: str) -> list[Finding]:
                     "scripts/profile_mesh.py for the full table)",
                 )
             )
+    return findings
+
+
+def check_hlo_collective_free(entry: str, hlo_text: str) -> list[Finding]:
+    """RPJ206 (collective-FREE flavor, r13): the serve-tier lookup
+    programs are dense elementwise/searchsorted code — their compiled
+    census must contain ZERO collectives.  Any collective here means the
+    serving dispatch grew a cross-device dependency that would serialize
+    every frontend's lookup behind it."""
+    census = census_of_text(hlo_text)
+    guard = _census_parse_guard(entry, census)
+    if guard:
+        return guard
+    findings = []
+    for comp, r in hlo_census.executed_rows(census):
+        findings.append(
+            Finding(
+                "RPJ206", f"<trace:{entry}>", 0, entry,
+                f"compiled {r['kind']} ({r['bytes']} B, computation "
+                f"{comp}) in a serve-tier lookup program that is "
+                "collective-free BY CONSTRUCTION — a cross-device "
+                "dependency crept into the serving dispatch",
+            )
+        )
     return findings
 
 
@@ -437,6 +471,25 @@ def _stacked_plan(n):
     )
 
 
+def _serve_ring(capacity=256, t=180, b=64):
+    """A deterministic capacity-padded DeviceRing + key batch for the
+    serve-tier entry points (duplicate tokens included via the modulo)."""
+    import numpy as np
+
+    from ringpop_tpu.serve import state as serve_state
+
+    toks = np.sort(
+        ((np.arange(t, dtype=np.uint64) * np.uint64(2654435761)) % (1 << 32))
+        .astype(np.uint32)
+    )
+    owners = (np.arange(t) % 12).astype(np.int32)
+    ring = serve_state.device_ring(toks, owners, capacity, gen=3)
+    hashes = ((np.arange(b, dtype=np.uint64) * np.uint64(40503)) % (1 << 32)).astype(
+        np.uint32
+    )
+    return ring, hashes
+
+
 def build_entrypoints(mesh=None) -> dict:
     """{name: ClosedJaxpr} for the ten public jitted entry points, traced
     dense (``mesh=None``) or with the shard-local exchange lowering
@@ -482,6 +535,22 @@ def build_entrypoints(mesh=None) -> dict:
     out["telemetry_fetch"] = jax.make_jaxpr(
         lambda t, s, f: telemetry.fetch(t, s, f)
     )(tel, lstate, lfaults)
+
+    # the serve-tier lookup programs (r13): capacity-padded shared-ring
+    # dispatch (fused owners+generation transfer) and the windowed
+    # N-owner scan — dense elementwise/searchsorted programs that must
+    # stay 32-bit, callback-free and collective-free (RPJ201/202/203
+    # here; the compiled census lives in run_hlo_checks)
+    from ringpop_tpu.ops import ring_ops
+    from ringpop_tpu.serve import state as serve_state
+
+    sring, shashes = _serve_ring()
+    out["serve_lookup"] = jax.make_jaxpr(
+        lambda r, h: serve_state.serve_lookup_fused(r, h)
+    )(sring, jnp.asarray(shashes))
+    out["serve_lookup_n"] = jax.make_jaxpr(
+        lambda t, o, c, h: ring_ops._lookup_n_window_padded(t, o, c, h, 3, 16)
+    )(sring.tokens, sring.owners, sring.count[0], jnp.asarray(shashes))
 
     # the chaos-enabled steps: the same engines driven by a time-varying
     # FaultPlan with every leg populated — fault evaluation (the
@@ -626,6 +695,26 @@ def _donation_checks() -> list[Finding]:
         mblk.lower(mc_states, _stacked_plan(_N), ticks=1).as_text(),
         len(jax.tree.leaves(mc_states)),
     )
+    # the serve tier's generation swap (r13): ring_commit donates the
+    # retiring DeviceRing — every leaf must alias an output, else a
+    # membership change holds TWO rings live at peak
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.serve import state as serve_state
+
+    sring, _ = _serve_ring()
+    findings += check_donation(
+        "ring_commit",
+        serve_state.ring_commit.lower(
+            sring,
+            jnp.asarray(np.zeros(256, np.uint32)),
+            jnp.asarray(np.zeros(256, np.int32)),
+            jnp.asarray([7], jnp.int32),
+            jnp.asarray([4], jnp.uint32),
+        ).as_text(),
+        len(jax.tree.leaves(sring)),
+    )
     return findings
 
 
@@ -684,6 +773,22 @@ def run_hlo_checks() -> list[Finding]:
     with _no_compile_cache():
         fleet_text = mblk.lower(mc_states, stacked, ticks=1).compile().as_text()
     findings += check_hlo_confinement("mc_chaos_block[hlo,sharded]", fleet_text)
+
+    # r13: the serve-tier lookup program compiled DENSE — censused
+    # collective-free (the serving dispatch is one device's searchsorted;
+    # a collective here would serialize every frontend behind ICI)
+    from ringpop_tpu.serve import state as serve_state
+
+    sring, shashes = _serve_ring()
+    import jax.numpy as jnp
+
+    with _no_compile_cache():
+        serve_text = (
+            serve_state.serve_lookup_fused.lower(sring, jnp.asarray(shashes))
+            .compile()
+            .as_text()
+        )
+    findings += check_hlo_collective_free("serve_lookup[hlo,dense]", serve_text)
     return findings
 
 

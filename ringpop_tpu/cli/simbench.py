@@ -1383,6 +1383,79 @@ print(json.dumps(dict(
             "error": f"twin child rc={r.returncode}: " + (r.stderr or "")[-300:]}
 
 
+def bench_serve_ring(seed: int, full: bool) -> dict:
+    """The serve-the-ring paired A/B (serve/bench.py): F frontend
+    PROCESSES drive keyed lookups through the shared device-resident ring
+    (shared-memory micro-batching into padded ``ring_ops`` dispatches)
+    vs their own per-process host bisect walk, interleaved rep by rep
+    behind a cross-process barrier (the ``forward_ab`` pairing).  The
+    certificate is bit-identity: every (worker, rep) owner-digest pair
+    must match, every serve answer must carry the pinned membership
+    generation, and a live ring update must re-certify against the
+    post-update oracle.  The DGRO placement pass is scored alongside
+    (key-movement-under-churn, the ring1m rebalance metric): the chosen
+    candidate must move no more keys than random replica placement at
+    equal token count — placement stays OFF by default."""
+    from ringpop_tpu.serve.bench import run_ab
+    from ringpop_tpu.serve.placement import dgro_place
+
+    journal = None
+    if _TELEMETRY_PATH is not None:
+        from ringpop_tpu.sim.telemetry import TelemetryJournal
+
+        journal = TelemetryJournal(_TELEMETRY_PATH, append=True)
+        journal.header(
+            "serve", "serve_ring", {"seed": seed, "full": full}
+        )
+    kw = (
+        dict(n_servers=64, frontends=4, batch=8192, batches_per_rep=16,
+             reps=5, warm_reps=1, latency_reqs=300)
+        if full
+        else dict(n_servers=64, frontends=4, batch=4096, batches_per_rep=8,
+                  reps=3, warm_reps=1, latency_reqs=150)
+    )
+    try:
+        rec = run_ab(seed=seed, transport="shm", journal=journal, **kw)
+    finally:
+        if journal is not None:
+            journal.close()
+
+    # the DGRO placement score: churn movement per candidate on one
+    # batched device program; candidate 0 IS the random placement, so
+    # the gate (chosen <= random) is scored against the real baseline
+    _t, _o, report = dgro_place(
+        [f"10.8.{i // 256}.{i % 256}:3000" for i in range(kw["n_servers"])],
+        100, candidates=8, probes=1 << 14, churn_frac=0.02, seed=seed,
+    )
+    placement = {
+        "chosen": report["chosen"],
+        "movement_random": report["movement_random"],
+        "movement_chosen": report["movement_chosen"],
+        "movement_gate_ok": report["movement_chosen"]
+        <= report["movement_random"] + 1e-9,
+        "imbalance_random": report["imbalance_random"],
+        "imbalance_chosen": report["imbalance_chosen"],
+        "excess_movement_all_zero": all(
+            e == 0.0 for e in report["excess_movement"]
+        ),
+        "default": "random",  # the serving path never runs DGRO unless asked
+    }
+    certified = bool(
+        rec["digest_equal"]
+        and rec["generation_pinned"]
+        and rec["update_certified"]
+        and rec["latency_b1"]["owners_match_oracle"]
+    )
+    return {
+        "metric": "serve_ring_shared_device_tier",
+        "value": rec["speedup_median"],
+        "unit": "qps_ratio_serve_over_bisect",
+        "certified": certified,
+        "placement": placement,
+        **rec,
+    }
+
+
 def _run_chaos_scenario(scenario: str, plan_name: str, n: int, k: int,
                         horizon: int, seed: int, suspect_ticks: int = 10,
                         journal_every: int = 16) -> dict:
@@ -1471,6 +1544,7 @@ BENCHES = {
     "forward": bench_forward_qps,
     "forward_comparator": bench_forward_comparator,
     "forward_ab": bench_forward_ab,
+    "serve_ring": bench_serve_ring,
     "mc_churn": bench_mc_churn,
     "mc_chaos": bench_mc_chaos,
     "partition_lc": bench_partition_lifecycle,

@@ -12,9 +12,12 @@ uint64 token array + parallel owner-index array:
 * **batched lookup is vectorizable** (`numpy searchsorted` here,
   ``ringpop_tpu.ops.ring_ops`` for the jnp/TPU version) — the reference's
   pointer-chasing tree cannot batch at all;
-* membership changes rebuild the token array O(T) — at 100 vnodes/server this
-  is microseconds up to thousands of servers and the rebuild amortizes to
-  nothing against lookup traffic.
+* membership changes maintain the sorted token array INCREMENTALLY — removed
+  servers' rows are masked out and added servers' pre-sorted token blocks are
+  merge-inserted at their ``searchsorted`` positions, O(T + A·log T) with no
+  global re-sort; ``_rebuild`` (the from-scratch argsort) is kept as the
+  oracle the incremental path is pinned bit-identical to
+  (``tests/test_hashring.py``).
 
 Token collisions between (server, replica) pairs are resolved by (token,
 server) order, deterministically.
@@ -98,16 +101,18 @@ class HashRing:
         return toks
 
     def _rebuild(self) -> None:
-        """Rebuild the sorted token/owner arrays from the server set."""
+        """Rebuild the sorted token/owner arrays from the server set — the
+        from-scratch argsort.  The mutation path maintains the arrays
+        incrementally (:meth:`_apply_incremental`); this full rebuild is
+        kept as the INDEPENDENT oracle the incremental path is pinned
+        bit-identical to (``tests/test_hashring.py`` calls it directly on
+        the comparison ring — it has no production call sites)."""
         servers = sorted(self._server_tokens)
         self._server_list = servers
         if not servers:
             self._tokens = np.empty(0, dtype=np.uint64)
             self._owners = np.empty(0, dtype=np.int64)
-            self._tokens32 = np.empty(0, dtype=np.uint32)
-            self._owners32 = np.empty(0, dtype=np.uint32)
-            self._tokens_list = []
-            self._owners_list = []
+            self._refresh_caches()
             return
         toks = np.concatenate([self._server_tokens[s] for s in servers])
         owners = np.repeat(np.arange(len(servers), dtype=np.int64), self.replica_points)
@@ -116,13 +121,83 @@ class HashRing:
         order = np.argsort(composite, kind="stable")
         self._tokens = toks[order]
         self._owners = owners[order]
-        # uint32 views cached once per rebuild for the batched native walks,
+        self._refresh_caches()
+
+    def _refresh_caches(self) -> None:
+        # uint32 views cached once per mutation for the batched native walks,
         # plus plain-int lists for the bisect single-key fast path (python
         # ints compare ~30x faster than numpy scalars under bisect)
         self._tokens32 = np.ascontiguousarray(self._tokens, dtype=np.uint32)
         self._owners32 = np.ascontiguousarray(self._owners, dtype=np.uint32)
         self._tokens_list = self._tokens.tolist()
         self._owners_list = self._owners.tolist()
+
+    def _apply_incremental(self, added: list[str], removed: list[str]) -> None:
+        """Update the sorted token/owner arrays in place for one batch of
+        membership changes, without the global re-sort:
+
+        1. renumber surviving owner ids through an old→new lookup table
+           (server ids are positions in the sorted server list, so one
+           add/remove shifts every later id).  The renumbering is
+           STRICTLY MONOTONE over survivors — both lists are sorted, so
+           relative order is preserved — which is what keeps the masked
+           survivors in composite (token, owner) order with no tie
+           repair inside equal-token runs;
+        2. mask out removed servers' rows;
+        3. merge-insert the added servers' pre-sorted token blocks at their
+           ``searchsorted`` positions.
+
+        Bit-identical to :meth:`_rebuild` by construction, pinned by
+        ``tests/test_hashring.py`` against randomized churn sequences
+        including collision-heavy token spaces."""
+        # a server in BOTH lists of one batch (added then removed — e.g. a
+        # flapping node in one SWIM membership update) is a net no-op: it
+        # is no longer in _server_tokens, so it must not reach the
+        # merge-insert (the event still reports both legs, as the rebuild
+        # path always did)
+        added = [s for s in added if s in self._server_tokens]
+        old_servers = self._server_list
+        new_servers = sorted(self._server_tokens)
+        new_index = {s: i for i, s in enumerate(new_servers)}
+        if old_servers:
+            lut = np.array(
+                [new_index.get(s, -1) for s in old_servers], dtype=np.int64
+            )
+            mapped = lut[self._owners]
+            keep = mapped >= 0
+            kept_toks = self._tokens[keep]
+            kept_owners = mapped[keep]
+        else:
+            kept_toks = np.empty(0, dtype=np.uint64)
+            kept_owners = np.empty(0, dtype=np.int64)
+        if added:
+            a_srv = sorted(added)
+            a_toks = np.concatenate([self._server_tokens[s] for s in a_srv])
+            a_owners = np.repeat(
+                np.array([new_index[s] for s in a_srv], dtype=np.int64),
+                self.replica_points,
+            )
+            a_comp = (a_toks << np.uint64(32)) | a_owners.astype(np.uint64)
+            a_order = np.argsort(a_comp, kind="stable")
+            a_toks, a_owners, a_comp = a_toks[a_order], a_owners[a_order], a_comp[a_order]
+            kept_comp = (kept_toks << np.uint64(32)) | kept_owners.astype(np.uint64)
+            pos = np.searchsorted(kept_comp, a_comp, side="left")
+            total = kept_toks.size + a_toks.size
+            out_t = np.empty(total, dtype=np.uint64)
+            out_o = np.empty(total, dtype=np.int64)
+            a_target = pos + np.arange(a_toks.size)
+            mask = np.ones(total, dtype=bool)
+            mask[a_target] = False
+            out_t[a_target] = a_toks
+            out_o[a_target] = a_owners
+            out_t[mask] = kept_toks
+            out_o[mask] = kept_owners
+        else:
+            out_t, out_o = kept_toks, kept_owners
+        self._server_list = new_servers
+        self._tokens = out_t
+        self._owners = out_o
+        self._refresh_caches()
 
     def _hash_keys(self, keys: list[str]) -> np.ndarray:
         """uint32 hashes of ``keys`` under this ring's hash function — batch
@@ -162,7 +237,7 @@ class HashRing:
                     removed.append(r)
             if not added and not removed:
                 return False
-            self._rebuild()
+            self._apply_incremental(added, removed)
             self._compute_checksum()
             self._emit(RingChangedEvent(servers_added=added, servers_removed=removed))
             return True

@@ -21,6 +21,21 @@ import numpy as np
 from ringpop_tpu.hashing import ring_tokens as _ring_tokens
 
 
+def _as_u32(a: jax.Array) -> jax.Array:
+    """Reinterpret any 32-bit-valued integer array as uint32.
+
+    The ring's token space is uint32, but callers routinely arrive with
+    int32/int64 hashes (``np.array`` of python ints defaults to int64,
+    which ``jnp.asarray`` truncates to int32 under disabled x64).  A hash
+    >= 2**31 then compares SIGNED against the tokens and ``searchsorted``
+    answers the wrap instead of the owner — silently, only for the top
+    half of the hash space.  ``astype(uint32)`` is the two's-complement
+    reinterpretation, which restores the intended value exactly for any
+    lossless-truncated input; pinned by the dtype rows of the
+    ``test_ring_properties`` suite."""
+    return a.astype(jnp.uint32)
+
+
 def build_ring_tokens(servers: list[str], replica_points: int = 100):
     """Host-side construction of the (tokens, owners) arrays for a server
     list — same hash/replica scheme as the host ring
@@ -36,7 +51,7 @@ def build_ring_tokens(servers: list[str], replica_points: int = 100):
 def ring_lookup(tokens: jax.Array, owners: jax.Array, key_hashes: jax.Array) -> jax.Array:
     """Owner index for each key hash: first token >= hash, wrapping to 0
     (parity: ``hashring.go:279-301`` walk semantics)."""
-    idx = jnp.searchsorted(tokens, key_hashes, side="left")
+    idx = jnp.searchsorted(_as_u32(tokens), _as_u32(key_hashes), side="left")
     idx = jnp.where(idx == tokens.shape[0], 0, idx)
     return owners[idx]
 
@@ -47,7 +62,7 @@ def _lookup_n_window(tokens, owners, key_hashes, n: int, w: int):
     tokens from each key's start position, plus the per-key unique count
     (for the exactness rescue in :func:`ring_lookup_n`)."""
     b = key_hashes.shape[0]
-    start = jnp.searchsorted(tokens, key_hashes, side="left")
+    start = jnp.searchsorted(_as_u32(tokens), _as_u32(key_hashes), side="left")
     pos = jnp.arange(w)
     offs = (start[:, None] + pos[None, :]) % tokens.shape[0]
     cand = owners[offs].astype(jnp.int32)  # [B, w]
@@ -101,3 +116,106 @@ def ring_lookup_n(
         if w >= t or bool((found >= need).all()):
             return out
         w = min(2 * w, t)
+
+
+# ---------------------------------------------------------------------------
+# Capacity-padded device ring (the serve tier's resident state)
+# ---------------------------------------------------------------------------
+#
+# The plain ops above take exact-size arrays, so every membership change
+# (T tokens -> T') retraces and recompiles the lookup — fine for a bench,
+# fatal for a serving tier whose ring updates ride live SWIM churn.  The
+# padded variants keep the ring at a fixed CAPACITY with a traced live
+# count: tokens[count:] hold PAD_TOKEN (0xFFFFFFFF — sorts last; a real
+# token of the same value still wins the side="left" search) and owners
+# [count:] hold -1.  Updates swap values, never shapes, so the serving
+# program compiles once per (capacity, batch-size) and a generation swap
+# is pure data movement (``serve.state.ring_commit`` ping-pongs two
+# donated buffer sets — churn never allocates, peak HBM is two rings,
+# and a snapshot survives one concurrent commit).
+
+PAD_TOKEN = 0xFFFFFFFF
+
+
+def pad_ring_arrays(tokens, owners, capacity: int):
+    """Host-side: (uint32[C], int32[C], count) from exact-size arrays."""
+    tokens = np.asarray(tokens, dtype=np.uint32)
+    owners = np.asarray(owners, dtype=np.int32)
+    count = int(tokens.shape[0])
+    if count > capacity:
+        raise ValueError(f"ring of {count} tokens exceeds capacity {capacity}")
+    pt = np.full(capacity, PAD_TOKEN, dtype=np.uint32)
+    po = np.full(capacity, -1, dtype=np.int32)
+    pt[:count] = tokens
+    po[:count] = owners
+    return pt, po, count
+
+
+@jax.jit
+def ring_lookup_padded(
+    tokens: jax.Array, owners: jax.Array, count: jax.Array, key_hashes: jax.Array
+) -> jax.Array:
+    """:func:`ring_lookup` against a capacity-padded ring.  ``count`` is the
+    traced live-token count; an empty ring answers -1 for every key."""
+    idx = jnp.searchsorted(_as_u32(tokens), _as_u32(key_hashes), side="left")
+    # past the live region (pads, or == C on an unpadded full ring): wrap.
+    # A key hashing to PAD_TOKEN exactly still finds a real token of that
+    # value first (side="left"), so the wrap only fires when no token >= h
+    # exists among the live entries.
+    idx = jnp.where(idx >= count, 0, idx)
+    return jnp.where(count > 0, owners[idx], jnp.int32(-1))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "w"))
+def _lookup_n_window_padded(tokens, owners, count, key_hashes, n: int, w: int):
+    """The windowed scan of :func:`_lookup_n_window` with a traced live
+    count: walk positions advance mod ``count`` (not capacity), so wrapped
+    revisits are literal duplicates the uniqueness machinery drops."""
+    b = key_hashes.shape[0]
+    cnt = jnp.maximum(count, 1)
+    start = jnp.searchsorted(_as_u32(tokens), _as_u32(key_hashes), side="left")
+    start = jnp.where(start >= count, 0, start)
+    pos = jnp.arange(w)
+    offs = (start[:, None] + pos[None, :]) % cnt
+    cand = jnp.where(count > 0, owners[offs].astype(jnp.int32), -1)  # [B, w]
+    spos = jnp.argsort(cand, axis=1).astype(jnp.int32)
+    sowner = jnp.take_along_axis(cand, spos, axis=1)
+    head = jnp.concatenate(
+        [jnp.ones((b, 1), bool), sowner[:, 1:] != sowner[:, :-1]], axis=1
+    )
+    # an empty ring's -1 candidates must not count as an owner
+    head = head & (sowner >= 0)
+    b_idx = jnp.broadcast_to(jnp.arange(b)[:, None], cand.shape)
+    first_seen = jnp.zeros((b, w), bool).at[b_idx, spos].set(head)
+    rank = jnp.cumsum(first_seen, axis=1) - 1
+    take = first_seen & (rank < n)
+    slot = jnp.where(take, rank, n)
+    out = jnp.full((b, n + 1), -1, dtype=jnp.int32)
+    out = out.at[b_idx, slot].set(jnp.where(take, cand, -1))
+    return out[:, :n], first_seen.sum(axis=1)
+
+
+def ring_lookup_n_padded(
+    tokens: jax.Array,
+    owners: jax.Array,
+    count: jax.Array,
+    num_servers: jax.Array,
+    key_hashes: jax.Array,
+    n: int,
+) -> jax.Array:
+    """:func:`ring_lookup_n` against a capacity-padded ring — same
+    window-doubling rescue, same exactness contract (the property suite
+    pins both against the host bisect walk), but shape-stable in the ring:
+    ``count``/``num_servers`` are traced, so membership churn re-executes
+    the same compiled windows instead of retracing."""
+    c = int(tokens.shape[0])
+    if c == 0 or n <= 0:
+        return jnp.full((key_hashes.shape[0], max(n, 0)), -1, jnp.int32)
+    need = jnp.minimum(n, num_servers)
+    w = min(max(4 * n, 16), c)
+    while True:
+        out, found = _lookup_n_window_padded(tokens, owners, count, key_hashes, n, w)
+        # w >= capacity >= count covers the whole live ring: exact
+        if w >= c or bool((found >= need).all()):
+            return out
+        w = min(2 * w, c)

@@ -1,0 +1,63 @@
+"""Serve the ring: a shared device-resident lookup tier.
+
+The host plane answers keyed lookups at ~15-24k req/s per process (the
+bisect walk, PERF.md "Host-plane performance target") while the device op
+(``ops/ring_ops.py``) sustains tens of millions of lookups/s — a ~1000×
+gap.  This package closes it for serving: many frontend processes submit
+key-hash batches to ONE device-resident ring over the ``net/channel.py``
+framing, a micro-batching collector coalesces pending requests across
+frontends into single padded-ring dispatches (flush at B keys or T µs),
+and live membership changes swap new ring generations in under a
+generation counter read back from the device with every answer — so every
+routing decision is certified against the exact membership generation
+that produced it.
+
+Pieces:
+
+* :mod:`~ringpop_tpu.serve.state` — ``DeviceRing`` (capacity-padded
+  tokens/owners + count + generation, all device-resident),
+  ``ring_commit`` (the donating generation swap), ``RingStore`` (the
+  host-side feed: incremental `hashring` updates → padded arrays →
+  commit; subscribes to live ``RingChangedEvent`` streams).
+* :mod:`~ringpop_tpu.serve.service` — ``RingService``: the asyncio
+  micro-batching collector + telemetry (batch-size/queue-wait/dispatch
+  histograms through the r7 stats plumbing, JSONL journal with
+  generation records).
+* :mod:`~ringpop_tpu.serve.client` — ``ServeClient`` (frontend half) and
+  ``HostBisectFrontend`` (the per-process baseline the A/B prices).
+* :mod:`~ringpop_tpu.serve.placement` — the DGRO-style token-placement
+  pass (PAPERS.md: diameter/spread-guided), opt-in behind the default
+  random replica placement.
+* :mod:`~ringpop_tpu.serve.bench` — the multi-process paired A/B driver
+  simbench's ``serve_ring`` scenario and ``make serve-smoke`` share.
+"""
+
+_EXPORTS = {
+    "DeviceRing": "ringpop_tpu.serve.state",
+    "RingStore": "ringpop_tpu.serve.state",
+    "ring_commit": "ringpop_tpu.serve.state",
+    "serve_lookup": "ringpop_tpu.serve.state",
+    "serve_lookup_fused": "ringpop_tpu.serve.state",
+    "RingService": "ringpop_tpu.serve.service",
+    "ServeClient": "ringpop_tpu.serve.client",
+    "HostBisectFrontend": "ringpop_tpu.serve.client",
+    "ShmServer": "ringpop_tpu.serve.shm",
+    "ShmClient": "ringpop_tpu.serve.shm",
+    "dgro_place": "ringpop_tpu.serve.placement",
+    "key_movement": "ringpop_tpu.serve.placement",
+    "run_ab": "ringpop_tpu.serve.bench",
+}
+
+
+def __getattr__(name):
+    # lazy like the facade package: frontend processes import
+    # serve.client without paying the device tier's jax import
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+__all__ = list(_EXPORTS)

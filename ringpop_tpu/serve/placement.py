@@ -1,0 +1,218 @@
+"""DGRO-style token placement: diameter/spread-guided, churn-scored.
+
+DGRO (PAPERS.md, arxiv 2410.11142) optimizes ring memberships by
+diameter-guided search over candidate orderings; the analog for a
+consistent-hash ring is TOKEN PLACEMENT: the reference's random replica
+points (``farm32(addr + i)``) leave both large uncovered arcs (the ring
+"diameter" — the largest token gap, which one owner's load scales with)
+and load imbalance.  This pass scores a small family of candidate
+placements as ONE batched device computation and picks the best — guided
+by diameter and spread, gated by the same key-movement-under-churn metric
+the ``ring1m`` churn-rebalance harness measures, so a candidate can never
+win by sacrificing the consistent-hashing property the ring exists for.
+
+Candidate family: per-(server, replica) re-mixes ``mix32(base ^ salt_c)``
+of the default farm tokens.  Candidate 0 is the UNMODIFIED default
+placement, and each candidate's tokens depend only on (server address,
+replica index, salt) — membership churn never moves a surviving server's
+tokens under any fixed candidate, so the scoring differences are pure
+placement quality.  Scores per candidate (all computed on device, vmapped
+over the candidate axis):
+
+* ``movement`` — fraction of probe keys whose owner changes when a churn
+  cohort is removed (the ring1m rebalance metric).  Minimal movement
+  equals the cohort's load share, so this doubles as load-under-churn.
+* ``excess`` — moved keys whose OLD owner survived the churn: nonzero
+  means the placement broke consistent hashing (asserted zero in tests).
+* ``imbalance`` — max/mean owner load over the probe set.
+* ``diameter`` — largest uncovered arc (max token gap incl. wraparound),
+  as a fraction of the hash space.
+
+Selection: among candidates whose ``movement`` does not exceed candidate
+0's (the acceptance gate: never worse than random replica placement at
+equal token count), minimize ``imbalance`` then ``diameter``.  Opt-in:
+``RingStore(placement="dgro")``; the default serving path never runs it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.hashing import ring_tokens
+from ringpop_tpu.sim.packbits import mix32
+
+_SALT_STRIDE = np.uint32(0x9E37_79B9)
+
+
+def _candidate_tokens(base: jax.Array, salt: jax.Array) -> jax.Array:
+    """uint32[T] tokens of one candidate: salt 0 = the default placement,
+    else a full-avalanche re-mix keyed on (base token, salt) only."""
+    return jnp.where(salt == 0, base, mix32(base ^ salt))
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _score_candidates(base, owners, salts, probes, cohort):
+    """Per-candidate (movement, excess, imbalance, diameter) — one
+    batched program over the candidate axis.
+
+    base: uint32[T] default tokens (owner-major, replica-minor order);
+    owners: int32[T]; salts: uint32[C]; probes: uint32[P];
+    cohort: bool[S] — servers removed by the churn probe.
+    """
+    t = base.shape[0]
+    n_servers = cohort.shape[0]
+    space = jnp.float32(2.0**32)
+
+    def one(salt):
+        toks = _candidate_tokens(base, salt)
+        # stable argsort == the host composite (token, owner) order:
+        # the flat layout is owner-ascending, so ties keep owner order
+        order = jnp.argsort(toks, stable=True)
+        st, so = toks[order], owners[order]
+
+        def lookup(sorted_toks, sorted_owners, live_t):
+            idx = jnp.searchsorted(sorted_toks, probes, side="left")
+            idx = jnp.where(idx >= live_t, 0, idx)
+            return sorted_owners[idx]
+
+        before = lookup(st, so, t)  # [P]
+        # churn: push the cohort's tokens past the live region and re-sort
+        dead = cohort[so]
+        toks_after = jnp.where(dead, jnp.uint32(0xFFFF_FFFF), st)
+        order2 = jnp.argsort(toks_after, stable=True)
+        st2, so2 = toks_after[order2], so[order2]
+        live_t = t - dead.sum()
+        after = lookup(st2, so2, live_t)
+
+        moved = before != after
+        movement = moved.mean(dtype=jnp.float32)
+        excess = (moved & ~cohort[before]).mean(dtype=jnp.float32)
+        loads = jnp.zeros(n_servers, jnp.float32).at[before].add(1.0)
+        imbalance = loads.max() * n_servers / jnp.float32(probes.shape[0])
+        if t > 1:
+            gaps = st[1:] - st[:-1]
+            wrap = st[0] + (jnp.uint32(0xFFFF_FFFF) - st[-1]) + jnp.uint32(1)
+            diameter = jnp.maximum(gaps.max(), wrap).astype(jnp.float32) / space
+        else:  # a single token owns the whole ring
+            diameter = jnp.float32(1.0)
+        return movement, excess, imbalance, diameter
+
+    return jax.vmap(one)(salts)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _materialize(base, owners, salt):
+    """The chosen candidate's (sorted tokens, sorted owners)."""
+    toks = _candidate_tokens(base, salt)
+    order = jnp.argsort(toks, stable=True)
+    return toks[order], owners[order]
+
+
+def dgro_place(
+    servers: list[str],
+    replica_points: int,
+    *,
+    candidates: int = 8,
+    probes: int = 1 << 15,
+    churn_frac: float = 0.01,
+    seed: int = 0,
+    fixed_salt: int | None = None,
+):
+    """(tokens uint32[T], owners int32[T], report) — the DGRO pass.
+
+    ``fixed_salt`` replays a previously chosen candidate without
+    re-scoring — the sticky mode ``RingStore`` uses after its first
+    placement so membership churn never flips candidates mid-flight
+    (a flip would move every token, exactly what the movement gate
+    exists to prevent).
+    """
+    s = len(servers)
+    base = jnp.asarray(
+        ring_tokens(servers, replica_points).reshape(-1).astype(np.uint32)
+    )
+    owners = jnp.asarray(
+        np.repeat(np.arange(s, dtype=np.int32), replica_points)
+    )
+    if fixed_salt is not None:
+        st, so = _materialize(base, owners, jnp.uint32(fixed_salt))
+        return (
+            np.asarray(st),
+            np.asarray(so),
+            {"salt": int(fixed_salt), "rescored": False},
+        )
+    rng = np.random.default_rng(seed)
+    salt_arr = (np.arange(candidates, dtype=np.uint64) * _SALT_STRIDE).astype(
+        np.uint32
+    )
+    probe_arr = rng.integers(0, 2**32, size=probes, dtype=np.uint32)
+    m = max(1, int(round(churn_frac * s))) if s > 1 else 0
+    cohort = np.zeros(s, bool)
+    if m:
+        cohort[rng.choice(s, size=m, replace=False)] = True
+    movement, excess, imbalance, diameter = (
+        np.asarray(a)
+        for a in _score_candidates(
+            base, owners, jnp.asarray(salt_arr), jnp.asarray(probe_arr),
+            jnp.asarray(cohort),
+        )
+    )
+    # the gate: never worse than random (candidate 0) on churn movement;
+    # then diameter/spread-guided among the eligible
+    eligible = movement <= movement[0] + 1e-9
+    score = np.where(eligible, imbalance + diameter, np.inf)
+    chosen = int(np.argmin(score))
+    st, so = _materialize(base, owners, jnp.uint32(salt_arr[chosen]))
+    report = {
+        "chosen": chosen,
+        "salt": int(salt_arr[chosen]),
+        "rescored": True,
+        "candidates": candidates,
+        "probes": probes,
+        "churn_cohort": int(m),
+        "movement": [round(float(v), 6) for v in movement],
+        "excess_movement": [round(float(v), 6) for v in excess],
+        "imbalance": [round(float(v), 4) for v in imbalance],
+        "diameter": [round(float(v), 6) for v in diameter],
+        "movement_random": round(float(movement[0]), 6),
+        "movement_chosen": round(float(movement[chosen]), 6),
+        "imbalance_random": round(float(imbalance[0]), 4),
+        "imbalance_chosen": round(float(imbalance[chosen]), 4),
+    }
+    return np.asarray(st), np.asarray(so), report
+
+
+def key_movement(
+    tokens_a, owners_a, servers_a: list[str],
+    tokens_b, owners_b, servers_b: list[str],
+    hashes,
+) -> dict:
+    """Key movement between two ring snapshots over a probe hash batch —
+    the ring1m churn-rebalance metric, shared with the DGRO scorer.
+
+    Owner ids are matched ACROSS snapshots through the server lists (ids
+    renumber on membership change), so ``moved`` counts real ownership
+    transfers.  ``excess_moved`` is the consistent-hashing violation
+    count: keys that moved between two servers present in BOTH snapshots
+    (always 0 for identity-keyed token placement)."""
+    from ringpop_tpu.ops.ring_ops import ring_lookup
+
+    oa = np.asarray(ring_lookup(jnp.asarray(tokens_a), jnp.asarray(owners_a), hashes))
+    ob = np.asarray(ring_lookup(jnp.asarray(tokens_b), jnp.asarray(owners_b), hashes))
+    index_a = {srv: i for i, srv in enumerate(servers_a)}
+    # b-id -> a-id (or -1 for servers new in b)
+    b_to_a = np.array([index_a.get(srv, -1) for srv in servers_b], np.int64)
+    survivors_a = np.zeros(len(servers_a), bool)
+    survivors_a[b_to_a[b_to_a >= 0]] = True
+    ob_in_a = b_to_a[ob]
+    moved = ob_in_a != oa
+    excess = moved & survivors_a[oa] & (ob_in_a >= 0)
+    return {
+        "probes": int(oa.shape[0]),
+        "moved_frac": round(float(moved.mean()), 6),
+        "excess_moved": int(excess.sum()),
+        "removed_load_frac": round(float((~survivors_a[oa]).mean()), 6),
+    }

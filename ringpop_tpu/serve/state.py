@@ -1,0 +1,289 @@
+"""Device-resident ring state with generation-certified swaps.
+
+``DeviceRing`` keeps the serving ring's sorted token/owner arrays at a
+fixed CAPACITY on the device (``ops/ring_ops.py`` padded variants), with
+the live count and a generation counter as device scalars.  Updates are
+value swaps at constant shape — the serving program compiles once per
+(capacity, batch-size) and never retraces on membership churn — and
+``ring_commit`` donates buffers ping-pong style (commit N reuses
+generation N-2's HBM; jaxlint RPJ204 pins every leaf aliased), so churn
+never allocates and a snapshot held by an in-flight dispatch stays
+valid across one concurrent commit.
+
+``serve_lookup`` returns the generation ALONGSIDE the owners, read from
+the same device state inside the same dispatch — the answer and the
+membership generation it was computed against are atomically paired,
+which is what lets the serving tier certify routing decisions per
+generation (the ``serve_ring`` A/B's owner-decision digests are keyed by
+it).
+
+``RingStore`` is the host-side feed: it owns a ``hashring.HashRing``
+(incremental token add/remove), pads, commits, and journals one
+``ring_update`` record per generation.  ``listen_to`` subscribes it to
+any ``RingChangedEvent`` emitter (a live SWIM node's ring, or a sim
+snapshot replayed in bench mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.events import RingChangedEvent
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.ops.ring_ops import (
+    pad_ring_arrays,
+    ring_lookup_n_padded,
+    ring_lookup_padded,
+)
+
+
+class DeviceRing(NamedTuple):
+    """The device-resident serving ring (capacity-padded)."""
+
+    tokens: jax.Array  # uint32[C], PAD_TOKEN past count
+    owners: jax.Array  # int32[C], -1 past count
+    count: jax.Array  # int32[1] live tokens
+    gen: jax.Array  # uint32[1] membership generation
+
+
+def device_ring(tokens, owners, capacity: int, gen: int = 0) -> DeviceRing:
+    """Host arrays -> a fresh DeviceRing at ``capacity``."""
+    pt, po, count = pad_ring_arrays(tokens, owners, capacity)
+    return DeviceRing(
+        tokens=jnp.asarray(pt),
+        owners=jnp.asarray(po),
+        count=jnp.asarray([count], jnp.int32),
+        gen=jnp.asarray([gen], jnp.uint32),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def ring_commit(
+    ring: DeviceRing, tokens: jax.Array, owners: jax.Array, count: jax.Array,
+    gen: jax.Array,
+) -> DeviceRing:
+    """Swap a new generation into the DONATED old ring — every leaf is a
+    full-length in-place update of the old buffer (dynamic_update_slice at
+    offset 0).  ``RingStore`` ping-pongs two buffer sets through this:
+    commit N donates generation N-2's buffers, so a reader holding the
+    previous snapshot stays valid across one concurrent commit (peak HBM
+    is two rings, never three — and never a fresh allocation per churn
+    event)."""
+    upd = jax.lax.dynamic_update_slice
+    z = (jnp.int32(0),)
+    return DeviceRing(
+        tokens=upd(ring.tokens, tokens, z),
+        owners=upd(ring.owners, owners, z),
+        count=upd(ring.count, count, z),
+        gen=upd(ring.gen, gen, z),
+    )
+
+
+@jax.jit
+def serve_lookup(ring: DeviceRing, key_hashes: jax.Array):
+    """Single-owner lookup + the generation it was answered against, in one
+    dispatch (int32[B] owners, uint32[1] gen)."""
+    return (
+        ring_lookup_padded(ring.tokens, ring.owners, ring.count[0], key_hashes),
+        ring.gen,
+    )
+
+
+@jax.jit
+def serve_lookup_fused(ring: DeviceRing, key_hashes: jax.Array) -> jax.Array:
+    """:func:`serve_lookup` with the generation FUSED into the owner vector
+    (int32[B+1], generation in the last slot) — one device array, one
+    host transfer.  The collector's n=1 flushes ride this: the second
+    ``np.asarray`` sync for the generation scalar is measurable against a
+    microsecond-scale lookup."""
+    owners = ring_lookup_padded(ring.tokens, ring.owners, ring.count[0], key_hashes)
+    return jnp.concatenate([owners, ring.gen.astype(jnp.int32)])
+
+
+def serve_lookup_n(ring: DeviceRing, num_servers, key_hashes: jax.Array, n: int):
+    """N-owner preference-list lookup against the padded ring (exact —
+    the window-doubling rescue of ``ring_lookup_n_padded``)."""
+    return (
+        ring_lookup_n_padded(
+            ring.tokens, ring.owners, ring.count[0],
+            jnp.asarray(num_servers, jnp.int32), key_hashes, n,
+        ),
+        ring.gen,
+    )
+
+
+class RingStore:
+    """Host-side owner of the DeviceRing: membership in, generations out.
+
+    Capacity doubles (one retrace) when the server set outgrows it;
+    every committed generation's server list is retained in a short
+    ring buffer so responses tagged with a recent generation can still be
+    resolved to addresses by frontends.
+    """
+
+    def __init__(
+        self,
+        servers: Optional[list[str]] = None,
+        *,
+        replica_points: int = 100,
+        capacity: Optional[int] = None,
+        keep_generations: int = 8,
+        placement: str = "random",
+        placement_kw: Optional[dict] = None,
+        on_update: Optional[Callable[[dict], None]] = None,
+    ):
+        if placement not in ("random", "dgro"):
+            raise ValueError(f"unknown placement {placement!r}")
+        self._lock = threading.Lock()
+        self.ring = HashRing(replica_points=replica_points)
+        self.placement = placement
+        self.placement_kw = dict(placement_kw or {})
+        self.keep_generations = keep_generations
+        self.on_update = on_update
+        self._gens: dict[int, list[str]] = {}
+        self.gen = 0
+        if servers:
+            self.ring.add_remove_servers(list(servers), [])
+        count = self.ring._tokens.shape[0]
+        cap = capacity if capacity is not None else max(2 * count, 1024)
+        tokens, owners = self._placed_arrays()
+        self.device = device_ring(tokens, owners, cap, gen=self.gen)
+        # host mirror of the COMMITTED (placed) arrays: the degenerate
+        # point-lookup fast lane answers from these under the same lock
+        # and generation — bit-identical to the device ring by the
+        # property-suite pin, without a device round trip for one key
+        self.host_tokens = np.asarray(tokens, np.uint32)
+        self.host_owners = np.asarray(owners, np.int32)
+        self.capacity = cap
+        # the generation before last, whose buffers the NEXT value-swap
+        # commit donates (ping-pong): a snapshot of the current ring is
+        # guaranteed valid across one concurrent commit; the dispatch
+        # paths retry on the (double-commit-mid-dispatch) tail
+        self._retired: Optional[DeviceRing] = None
+        self._gens[self.gen] = self.ring.servers()
+
+    # -- placement -----------------------------------------------------------
+
+    def _placed_arrays(self):
+        """(tokens uint32, owners int32) for the current server set under
+        the configured placement.  ``random`` is the ring's own (reference
+        hashring.go) placement; ``dgro`` re-places tokens through the
+        diameter/spread-guided pass (serve/placement.py) — opt-in, and
+        STICKY: the candidate is scored once, then replayed by salt on
+        every later membership change (a candidate flip would move every
+        token — the movement the pass exists to bound)."""
+        toks, owners, servers = self.ring.token_arrays()
+        if self.placement == "dgro" and servers:
+            from ringpop_tpu.serve.placement import dgro_place
+
+            kw = dict(self.placement_kw)
+            salt = getattr(self, "_dgro_salt", None)
+            if salt is not None:
+                kw["fixed_salt"] = salt
+            toks32, owners32, report = dgro_place(
+                servers, self.ring.replica_points, **kw
+            )
+            if salt is None:
+                self.placement_report = report
+            self._dgro_salt = report["salt"]
+            return toks32, owners32
+        return toks.astype(np.uint32), owners.astype(np.int32)
+
+    # -- mutation ------------------------------------------------------------
+
+    def update(self, add=None, remove=None) -> Optional[dict]:
+        """Apply one membership change and commit the next generation.
+        Returns the ``ring_update`` journal record (None on no-op)."""
+        with self._lock:
+            if not self.ring.add_remove_servers(list(add or []), list(remove or [])):
+                return None
+            return self._commit(added=list(add or []), removed=list(remove or []))
+
+    def _commit(self, added: list[str], removed: list[str]) -> dict:
+        tokens, owners = self._placed_arrays()
+        self.host_tokens = np.asarray(tokens, np.uint32)
+        self.host_owners = np.asarray(owners, np.int32)
+        count = int(tokens.shape[0])
+        if count > self.capacity:
+            # outgrown: reallocate at double capacity (one retrace of the
+            # serving programs at the new C — rare, logged in the record).
+            # Both resident buffer sets have the old capacity, so the
+            # ping-pong restarts: nothing to donate into.
+            self.capacity = max(2 * count, 2 * self.capacity)
+            self.gen += 1
+            self.device = device_ring(tokens, owners, self.capacity, gen=self.gen)
+            self._retired = None
+            reallocated = True
+        else:
+            pt, po, count = pad_ring_arrays(tokens, owners, self.capacity)
+            self.gen += 1
+            if self._retired is not None:
+                new = ring_commit(
+                    self._retired,
+                    jnp.asarray(pt),
+                    jnp.asarray(po),
+                    jnp.asarray([count], jnp.int32),
+                    jnp.asarray([self.gen], jnp.uint32),
+                )
+            else:
+                new = device_ring(tokens, owners, self.capacity, gen=self.gen)
+            self._retired = self.device
+            self.device = new
+            reallocated = False
+        self._gens[self.gen] = self.ring.servers()
+        for g in list(self._gens):
+            if g <= self.gen - self.keep_generations:
+                del self._gens[g]
+        record = {
+            "kind": "ring_update",
+            "gen": self.gen,
+            "checksum": self.ring.checksum(),
+            "n_servers": self.ring.server_count(),
+            "count": count,
+            "capacity": self.capacity,
+            "reallocated": reallocated,
+            "added": added,
+            "removed": removed,
+        }
+        if self.on_update is not None:
+            self.on_update(record)
+        return record
+
+    # -- live feed -----------------------------------------------------------
+
+    def listen_to(self, emitter_owner) -> None:
+        """Subscribe to a ``RingChangedEvent`` source (a ``HashRing`` or
+        anything exposing ``register_listener``) — the live SWIM membership
+        feed.  Each event becomes one committed generation."""
+        store = self
+
+        class _L:
+            def handle_event(self, event):
+                if isinstance(event, RingChangedEvent):
+                    store.update(event.servers_added, event.servers_removed)
+
+        emitter_owner.register_listener(_L())
+
+    # -- queries -------------------------------------------------------------
+
+    def snapshot(self) -> tuple[DeviceRing, int, int]:
+        """(device ring, generation, n_servers) — one consistent view."""
+        with self._lock:
+            return self.device, self.gen, self.ring.server_count()
+
+    def snapshot_host(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """(host tokens, host owners, generation) — the committed
+        generation's placed arrays, for the point-lookup fast lane."""
+        with self._lock:
+            return self.host_tokens, self.host_owners, self.gen
+
+    def servers_at(self, gen: int) -> Optional[list[str]]:
+        """Server list of a recent generation (None if aged out)."""
+        with self._lock:
+            return self._gens.get(gen)

@@ -41,6 +41,14 @@ story"):
   both warm.  The model says the fleet amortizes per-dispatch overhead,
   so it must be no slower per tick; slower REFUTES the fleet lowering,
   as does any scenario's final state diverging from its solo run.
+- (r13) the serve tier's shared-ring dispatch: ``serve_lookup`` — the
+  capacity-padded fused lookup program (owners + generation, one
+  transfer) over a 1M-vnode ring vs the per-process host bisect walk,
+  bit_equal per key.  The serving model says one amortized device
+  dispatch beats a host process by >= 2x per-key throughput (the CPU
+  container already shows >2x END TO END through sockets/shm; the raw
+  dispatch on a real chip should be orders beyond) — less than 2x or
+  any bit-inequality REFUTES the serve-tier premise.
 
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
@@ -233,6 +241,26 @@ def main() -> int:
              f"batched {b_ms} vs sequential {s_ms} ms/tick "
              f"(amortization {round(s_ms / max(b_ms, 1e-9), 2)}x), "
              f"bit_equal={mc.get('bit_equal')}")
+        )
+    # the r13 serve-tier dispatch: bit-equal to the host walk and >= 2x a
+    # host bisect process per key, else the shared-ring premise is refuted
+    sl = cap.get("serve_lookup") or {}
+    if "error" in sl:
+        verdicts.append(("serve-tier shared-ring dispatch", None, sl["error"]))
+    elif sl.get("device_qps") is not None and sl.get(
+        "bisect_qps_per_process"
+    ) is not None:
+        ok = bool(sl.get("bit_equal")) and (
+            sl["device_qps"] >= 2.0 * sl["bisect_qps_per_process"]
+        )
+        verdicts.append(
+            (f"serve-tier shared-ring dispatch (batch={sl.get('batch')}, "
+             f"{sl.get('n_servers')}x{sl.get('replica_points')} vnodes)",
+             ok,
+             f"device {sl['device_qps']} vs bisect "
+             f"{sl['bisect_qps_per_process']} keys/s per process "
+             f"(amortization {sl.get('amortization')}x), "
+             f"bit_equal={sl.get('bit_equal')}")
         )
     prof = next(
         ((p, budget) for p, budget in

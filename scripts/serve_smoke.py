@@ -1,0 +1,100 @@
+"""serve-smoke: the CI gate for the serve-the-ring tier.
+
+Runs a small multi-process paired A/B (2 frontends, shared-memory
+transport) plus a DGRO placement score and asserts the CORRECTNESS
+certificates — owner digests bit-identical serve vs bisect per (worker,
+rep), answers pinned to the membership generation, live-update
+re-certification, B=1 owners matching the oracle, the movement gate —
+and that the serve journal carries the batch-size / queue-wait
+telemetry schema.  Throughput ratios are recorded but NOT asserted: the
+committed SIMBENCH artifact prices those on a full run; a 2-core CI
+container under ambient load must not flake the gate on wall-clock.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    from ringpop_tpu.serve.bench import run_ab
+    from ringpop_tpu.serve.placement import dgro_place
+    from ringpop_tpu.sim.telemetry import TelemetryJournal
+
+    path = os.path.join(tempfile.gettempdir(), f"serve_smoke_{os.getpid()}.jsonl")
+    journal = TelemetryJournal(path)
+    journal.header("serve", "serve_smoke", {"gate": "make serve-smoke"})
+    try:
+        rec = run_ab(
+            n_servers=32, frontends=2, batch=2048, batches_per_rep=4,
+            reps=2, warm_reps=1, latency_reqs=60, transport="shm",
+            journal=journal,
+        )
+    finally:
+        journal.close()
+
+    failures = []
+    if not rec["digest_equal"]:
+        failures.append("serve/bisect owner digests diverged")
+    if not rec["generation_pinned"]:
+        failures.append(f"answers left the pinned generation: {rec['generations_seen']}")
+    if not rec["update_certified"]:
+        failures.append("live ring update failed the generation certificate")
+    if not rec["latency_b1"]["owners_match_oracle"]:
+        failures.append("B=1 degenerate path mis-routed vs the bisect oracle")
+
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    os.unlink(path)
+    serves = [r for r in records if r.get("kind") == "serve"]
+    updates = [r for r in records if r.get("kind") == "ring_update"]
+    if not serves:
+        failures.append("journal carries no 'serve' telemetry records")
+    else:
+        want = {"keys_per_flush", "queue_wait_us", "dispatch_us", "flushes",
+                "requests", "keys", "gen"}
+        missing = want - set(serves[0])
+        if missing:
+            failures.append(f"serve record missing fields: {sorted(missing)}")
+        hist = serves[0].get("keys_per_flush", {})
+        if not {"mean", "p50", "p90", "max"} <= set(hist):
+            failures.append(f"batch-size histogram malformed: {hist}")
+    if not updates:
+        failures.append("journal carries no 'ring_update' generation record")
+    elif updates[-1].get("gen") != rec["update_record"]["gen"]:
+        failures.append("ring_update journal gen != committed generation")
+
+    _t, _o, report = dgro_place(
+        [f"10.5.0.{i}:3000" for i in range(24)], 50,
+        candidates=4, probes=1 << 12, churn_frac=0.05,
+    )
+    if report["movement_chosen"] > report["movement_random"] + 1e-9:
+        failures.append(
+            f"DGRO movement gate broken: chosen {report['movement_chosen']} "
+            f"> random {report['movement_random']}"
+        )
+    if any(e != 0.0 for e in report["excess_movement"]):
+        failures.append("DGRO candidate broke consistent hashing (excess movement)")
+
+    summary = {
+        "speedup_median": rec["speedup_median"],
+        "latency_b1_ratio_p50": rec["latency_b1"]["ratio_p50"],
+        "keys_per_flush_mean": rec["telemetry"]["keys_per_flush_mean"],
+        "movement_random": report["movement_random"],
+        "movement_chosen": report["movement_chosen"],
+        "failures": failures,
+    }
+    print(json.dumps(summary, indent=1))
+    if failures:
+        print("serve-smoke: FAIL", file=sys.stderr)
+        return 1
+    print("serve-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

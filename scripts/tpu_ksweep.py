@@ -689,6 +689,53 @@ def main() -> None:
         out["ring_lookup_qps"] = f"{type(e).__name__}: {e}"[:300]
     flush()
 
+    # -- 5b: serve_lookup — the serve tier's capacity-padded shared-ring
+    # dispatch (fused owners+generation transfer, the program the
+    # micro-batching collector actually runs) vs the per-process host
+    # bisect walk, bit_equal per key.  The serving claim on real HW: one
+    # device dispatch amortized across frontends beats any number of
+    # per-process bisect walkers; certify_cost_model judges the margin.
+    try:
+        from ringpop_tpu.serve.client import HostBisectFrontend
+        from ringpop_tpu.serve.state import RingStore, serve_lookup_fused
+
+        n_srv, rp = 4096, 256
+        srv = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_srv)]
+        sec = {"n_servers": n_srv, "replica_points": rp}
+        out["serve_lookup"] = sec
+        store = RingStore(srv, replica_points=rp)
+        sring, _gen, _ns = store.snapshot()
+        sb = 262_144
+        sec["batch"] = sb
+        shashes = np.random.default_rng(1).integers(
+            0, 2**32, size=sb, dtype=np.uint32
+        )
+        dev_h = jnp.asarray(shashes)
+        fused = serve_lookup_fused(sring, dev_h)
+        jax.block_until_ready(fused)  # compile + warm
+        sreps = max(reps, 3)
+        t0 = time.perf_counter()
+        for _ in range(sreps):
+            fused = serve_lookup_fused(sring, dev_h)
+        dev_owned = np.asarray(fused)[:sb]  # includes the host sync
+        dt = (time.perf_counter() - t0) / sreps
+        sec["device_qps"] = round(sb / dt, 0)
+        sec["device_ms_per_batch"] = round(dt * 1e3, 3)
+        bisect_fe = HostBisectFrontend(srv, rp)
+        hb = shashes[:32_768]  # the scalar walk needs no 262k to price
+        t0 = time.perf_counter()
+        host_owned = bisect_fe.lookup_hashes(hb)
+        sec["bisect_qps_per_process"] = round(
+            hb.shape[0] / (time.perf_counter() - t0), 0
+        )
+        sec["bit_equal"] = bool(np.array_equal(dev_owned[: hb.shape[0]], host_owned))
+        sec["amortization"] = round(
+            sec["device_qps"] / max(sec["bisect_qps_per_process"], 1), 1
+        )
+    except Exception as e:  # pragma: no cover
+        out.setdefault("serve_lookup", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
     # -- 6: Pallas FarmHash vs jnp lowering ---------------------------------
     try:
         from ringpop_tpu.hashing.farm import pack_strings

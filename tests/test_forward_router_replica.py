@@ -213,6 +213,35 @@ def test_router_local_vs_remote_and_cache():
     assert f.made == ["b:2"]  # cached, factory called once
 
 
+def test_router_batch_default_and_serve_source():
+    """get_client_batch resolves a whole key wave in one lookup — through
+    the fallback scalar/batch ring path, or through an injected
+    serve-tier lookup source (the shared device ring's resolver)."""
+    rp = FakeRingpop("a:1", {"k1": "a:1", "k2": "b:2", "k3": "b:2"})
+    f = Factory()
+    router = Router(rp, f)
+    out = router.get_client_batch(["k1", "k2", "k3"])
+    assert out == [("LOCAL", True), ("REMOTE(b:2)", False), ("REMOTE(b:2)", False)]
+    assert f.made == ["b:2"]  # one remote client for the whole wave
+    assert router.get_client_batch([]) == []
+
+    # injected source: the batch resolver wins over ringpop.lookup
+    calls = []
+
+    def serve_source(keys):
+        calls.append(list(keys))
+        return ["c:3" for _ in keys]
+
+    f2 = Factory()
+    router2 = Router(rp, f2, lookup_source=serve_source)
+    out2 = router2.get_client_batch(["k1", "k2"])
+    assert calls == [["k1", "k2"]]
+    assert out2 == [("REMOTE(c:3)", False), ("REMOTE(c:3)", False)]
+    assert f2.made == ["c:3"]
+    # scalar path unchanged: still ringpop.lookup
+    assert router2.get_client("k1") == ("LOCAL", True)
+
+
 def test_router_evicts_on_faulty():
     from ringpop_tpu.swim import events as swim_ev
     from ringpop_tpu.swim.member import Change, FAULTY

@@ -141,3 +141,97 @@ def test_zero_replica_points_lookup_paths():
     assert ring.lookup_n("k", 3) == []
     assert ring.lookup_n_batch(["k"], 2) == [[]]
     assert ring.lookup_batch(["k", "k2"]) == [None, None]
+
+
+# -- incremental maintenance vs the rebuild oracle (serve-the-ring PR) -------
+
+
+def _oracle_of(live, hashfunc=None, replica_points=10):
+    oracle = HashRing(hashfunc=hashfunc, replica_points=replica_points)
+    oracle.add_remove_servers(sorted(live), [])
+    # force the FROM-SCRATCH argsort: the pin is incremental-vs-rebuild,
+    # not incremental-vs-incremental-from-empty
+    oracle._rebuild()
+    oracle._compute_checksum()
+    return oracle
+
+
+def _assert_bit_identical(ring, oracle):
+    assert np.array_equal(ring._tokens, oracle._tokens)
+    assert np.array_equal(ring._owners, oracle._owners)
+    assert np.array_equal(ring._tokens32, oracle._tokens32)
+    assert np.array_equal(ring._owners32, oracle._owners32)
+    assert ring._tokens_list == oracle._tokens_list
+    assert ring._owners_list == oracle._owners_list
+    assert ring._server_list == oracle._server_list
+    assert ring.checksum() == oracle.checksum()
+
+
+def test_incremental_matches_rebuild_random_churn():
+    """The incremental add/remove path (merge-insert + mask + tie repair)
+    must be BIT-identical to the from-scratch rebuild after every batch of
+    a randomized churn sequence."""
+    rng = np.random.default_rng(7)
+    ring = HashRing(replica_points=10)
+    pool = [f"10.1.{i // 256}.{i % 256}:3000" for i in range(160)]
+    live: set[str] = set()
+    for _ in range(40):
+        free = [p for p in pool if p not in live]
+        adds = list(rng.choice(free, size=min(len(free), int(rng.integers(0, 5))),
+                               replace=False))
+        rems = list(rng.choice(sorted(live),
+                               size=min(len(live), int(rng.integers(0, 4))),
+                               replace=False)) if live else []
+        ring.add_remove_servers(adds, rems)
+        live |= set(adds)
+        live -= set(rems)
+        _assert_bit_identical(ring, _oracle_of(live))
+
+
+def test_incremental_matches_rebuild_collision_heavy():
+    """A 97-value token space forces equal-token runs whose (token, owner)
+    tie order the owner renumbering flips — the local re-sort repair must
+    keep the arrays bit-identical to the rebuild."""
+
+    def tiny(s):
+        data = s if isinstance(s, bytes) else s.encode()
+        return fingerprint32(data) % 97
+
+    rng = np.random.default_rng(11)
+    ring = HashRing(hashfunc=tiny, replica_points=5)
+    pool = [f"s{i}:3000" for i in range(60)]
+    live: set[str] = set()
+    for _ in range(30):
+        free = [p for p in pool if p not in live]
+        adds = list(rng.choice(free, size=min(len(free), int(rng.integers(0, 4))),
+                               replace=False))
+        rems = list(rng.choice(sorted(live),
+                               size=min(len(live), int(rng.integers(0, 3))),
+                               replace=False)) if live else []
+        ring.add_remove_servers(adds, rems)
+        live |= set(adds)
+        live -= set(rems)
+        _assert_bit_identical(ring, _oracle_of(live, hashfunc=tiny,
+                                               replica_points=5))
+
+
+def test_incremental_drain_to_empty_and_refill():
+    ring = HashRing(replica_points=10)
+    srv = [f"a{i}:1" for i in range(8)]
+    ring.add_remove_servers(srv, [])
+    ring.add_remove_servers([], srv)  # drain through the incremental path
+    assert ring._tokens.shape == (0,)
+    assert ring._tokens_list == []
+    ring.add_remove_servers(srv[:3], [])  # refill from empty
+    _assert_bit_identical(ring, _oracle_of(set(srv[:3])))
+
+
+def test_incremental_simultaneous_add_remove_renumbers():
+    """One batch that adds a server sorting BEFORE the survivors and
+    removes one sorting in the middle shifts every later owner id — the
+    renumber LUT (not just the merge) is what keeps lookups right."""
+    ring = HashRing(replica_points=10)
+    ring.add_remove_servers(["m:1", "q:1", "t:1"], [])
+    ring.add_remove_servers(["a:1", "z:1"], ["q:1"])
+    _assert_bit_identical(ring, _oracle_of({"m:1", "t:1", "a:1", "z:1"}))
+    assert ring.lookup("some-key") in {"a:1", "m:1", "t:1", "z:1"}

@@ -1,0 +1,267 @@
+"""Property suite for the device ring-lookup ops (serve-the-ring PR).
+
+Randomized rings with DUPLICATE and ADJACENT token hashes plus keys that
+hash exactly onto a token: for every (n, window) configuration —
+including windows forced small enough that the window-overflow rescue
+must fire — the device result must equal the host bisect walk.  The
+padded (capacity + traced count) serve-tier variants are pinned to the
+same oracle and to the exact-size ops.
+
+Also pins the dtype edge this PR fixed: int64/int32 key hashes (a caller
+forgetting the uint32 cast; ``jnp.asarray`` truncates int64 to int32
+under disabled x64) used to compare SIGNED against the uint32 tokens,
+silently mis-routing every key in the top half of the hash space.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.hashing import fingerprint32
+from ringpop_tpu.ops.ring_ops import (
+    PAD_TOKEN,
+    _lookup_n_window,
+    _lookup_n_window_padded,
+    pad_ring_arrays,
+    ring_lookup,
+    ring_lookup_n,
+    ring_lookup_n_padded,
+    ring_lookup_padded,
+)
+
+
+def _walk_oracle(tokens, owners, h, n, num_servers):
+    """The host ring walk (hashring._lookup_n_hash semantics) on raw
+    arrays: first n unique owners at token >= h with wraparound."""
+    t = len(tokens)
+    if t == 0 or n <= 0:
+        return [-1] * max(n, 0)
+    start = int(np.searchsorted(tokens, np.uint32(h), side="left"))
+    out, seen = [], set()
+    for i in range(t):
+        o = int(owners[(start + i) % t])
+        if o not in seen:
+            seen.add(o)
+            out.append(o)
+            if len(out) == min(n, num_servers):
+                break
+    return out + [-1] * (n - len(out))
+
+
+def _adversarial_ring(rng, t, num_servers):
+    """(tokens uint32, owners int32) with long same-owner runs (forces the
+    rescue), duplicate tokens, and composite (token, owner) sort order —
+    the host ring's collision-resolution order."""
+    owners = np.sort(rng.integers(0, num_servers, size=t)).astype(np.int32)
+    rng.shuffle(owners[: t // 2])  # half shuffled, half one long run
+    vals = (
+        rng.integers(0, max(t // 3, 2), size=t).astype(np.uint64)
+        * np.uint64(int(rng.integers(1, 2**26)))
+    ) & np.uint64(0xFFFFFFFF)
+    tokens = np.sort(vals).astype(np.uint32)
+    comp = tokens.astype(np.uint64) << np.uint64(32) | owners.astype(np.uint64)
+    order = np.argsort(comp, kind="stable")
+    return tokens[order], owners[order]
+
+
+def _probe_keys(rng, tokens):
+    """Random keys + every token exactly + token±1 + hash-space extremes."""
+    return np.unique(
+        np.concatenate(
+            [
+                rng.integers(0, 2**32, size=24, dtype=np.uint32),
+                tokens,
+                tokens + np.uint32(1),
+                tokens - np.uint32(1),
+                np.array([0, 1, 2**32 - 1, 2**32 - 2], dtype=np.uint32),
+            ]
+        ).astype(np.uint32)
+    )
+
+
+def test_lookup_n_matches_walk_oracle_adversarial():
+    rng = np.random.default_rng(41)
+    for trial in range(6):
+        t = int(rng.integers(3, 48))
+        ns = int(rng.integers(1, 7))
+        tokens, owners = _adversarial_ring(rng, t, ns)
+        keys = _probe_keys(rng, tokens)
+        jt, jo, jk = jnp.asarray(tokens), jnp.asarray(owners), jnp.asarray(keys)
+        got1 = np.asarray(ring_lookup(jt, jo, jk))
+        for n in (1, 2, ns, ns + 2):
+            got = np.asarray(ring_lookup_n(jt, jo, jk, n, ns))
+            for i, h in enumerate(keys.tolist()):
+                want = _walk_oracle(tokens, owners, h, n, ns)
+                assert list(got[i]) == want, (trial, n, i, h)
+                if n >= 1:
+                    assert got[i][0] == got1[i] or want[0] == got1[i]
+
+
+def test_lookup_n_every_window_config():
+    """Drive the windowed scan DIRECTLY at every window size 1..t: any w
+    that reports all keys satisfied must agree with the oracle prefix,
+    and w == t (the overflow fallback) must be exact for every key."""
+    rng = np.random.default_rng(42)
+    t, ns = 24, 4
+    tokens, owners = _adversarial_ring(rng, t, ns)
+    keys = _probe_keys(rng, tokens)
+    jt, jo, jk = jnp.asarray(tokens), jnp.asarray(owners), jnp.asarray(keys)
+    for n in (1, 2, 4, 6):
+        need = min(n, ns)
+        for w in (1, 2, 3, n, t // 2, t):
+            w = max(1, min(w, t))
+            out, found = _lookup_n_window(jt, jo, jk, n, w)
+            out, found = np.asarray(out), np.asarray(found)
+            for i, h in enumerate(keys.tolist()):
+                want = _walk_oracle(tokens, owners, h, n, ns)
+                if w == t or found[i] >= need:
+                    assert list(out[i]) == want, (n, w, i, h)
+                else:
+                    # a partial window may only report a PREFIX of the walk
+                    k = int(found[i])
+                    assert list(out[i][:k]) == want[:k], (n, w, i, h)
+
+
+@pytest.mark.parametrize("extra_cap", [0, 3, 17])
+def test_padded_variants_match_exact_and_oracle(extra_cap):
+    rng = np.random.default_rng(43)
+    for trial in range(4):
+        t = int(rng.integers(1, 40))
+        ns = int(rng.integers(1, 6))
+        tokens, owners = _adversarial_ring(rng, t, ns)
+        keys = _probe_keys(rng, tokens)
+        cap = t + extra_cap
+        pt, po, count = pad_ring_arrays(tokens, owners, cap)
+        jt, jo = jnp.asarray(pt), jnp.asarray(po)
+        jc = jnp.asarray(count, jnp.int32)
+        jk = jnp.asarray(keys)
+        got1 = np.asarray(ring_lookup_padded(jt, jo, jc, jk))
+        exact1 = np.asarray(
+            ring_lookup(jnp.asarray(tokens), jnp.asarray(owners), jk)
+        )
+        assert np.array_equal(got1, exact1)
+        for n in (1, 2, ns + 1):
+            got = np.asarray(
+                ring_lookup_n_padded(jt, jo, jc, jnp.asarray(ns, jnp.int32), jk, n)
+            )
+            for i, h in enumerate(keys.tolist()):
+                assert list(got[i]) == _walk_oracle(tokens, owners, h, n, ns), (
+                    trial, extra_cap, n, i, h,
+                )
+
+
+def test_padded_window_mod_count_not_capacity():
+    """Walk positions must advance mod COUNT: with capacity > count, a
+    key landing near the end of the live region must wrap back to live
+    token 0, never into the PAD_TOKEN tail."""
+    tokens = np.array([10, 20, 30], np.uint32)
+    owners = np.array([0, 1, 2], np.int32)
+    pt, po, count = pad_ring_arrays(tokens, owners, 8)
+    out, found = _lookup_n_window_padded(
+        jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+        jnp.asarray([25], jnp.uint32), 3, 4,
+    )
+    assert list(np.asarray(out)[0]) == [2, 0, 1]
+    assert int(np.asarray(found)[0]) == 3
+
+
+def test_padded_empty_ring_answers_minus_one():
+    pt, po, count = pad_ring_arrays(
+        np.empty(0, np.uint32), np.empty(0, np.int32), 4
+    )
+    keys = jnp.asarray([0, 1, 2**32 - 1], jnp.uint32)
+    got = np.asarray(
+        ring_lookup_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32), keys
+        )
+    )
+    assert (got == -1).all()
+    gotn = np.asarray(
+        ring_lookup_n_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+            jnp.asarray(0, jnp.int32), keys, 2,
+        )
+    )
+    assert (gotn == -1).all()
+
+
+def test_key_hashing_exactly_pad_token_value():
+    """A key hashing to 0xFFFFFFFF (== PAD_TOKEN): with a live token of
+    that exact value, side='left' must find the real token; without one,
+    the lookup must wrap to live token 0 — never answer a pad owner."""
+    with_hit = np.array([5, PAD_TOKEN], np.uint32)
+    owners = np.array([0, 1], np.int32)
+    pt, po, count = pad_ring_arrays(with_hit, owners, 6)
+    got = np.asarray(
+        ring_lookup_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+            jnp.asarray([PAD_TOKEN], jnp.uint32),
+        )
+    )
+    assert got[0] == 1
+    without = np.array([5, 9], np.uint32)
+    pt, po, count = pad_ring_arrays(without, owners, 6)
+    got = np.asarray(
+        ring_lookup_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+            jnp.asarray([PAD_TOKEN], jnp.uint32),
+        )
+    )
+    assert got[0] == 0  # wrapped to the first live token
+
+
+def test_signed_dtype_hashes_route_like_uint32():
+    """The fixed edge: hashes arriving int64/int32 with values >= 2**31
+    must route exactly like their uint32 reinterpretation (previously the
+    signed comparison answered the wrap owner for the top half of the
+    hash space)."""
+    tokens = np.array([100, 2**31 + 5, 2**32 - 10], np.uint32)
+    owners = np.array([0, 1, 2], np.int32)
+    jt, jo = jnp.asarray(tokens), jnp.asarray(owners)
+    h_int64 = np.array([2**31 + 5, 2**31 + 6, 2**32 - 5, 50], dtype=np.int64)
+    h_u32 = h_int64.astype(np.uint32)
+    a = np.asarray(ring_lookup(jt, jo, jnp.asarray(h_int64)))
+    b = np.asarray(ring_lookup(jt, jo, jnp.asarray(h_u32)))
+    assert np.array_equal(a, b)
+    # 2**31+5 and +6 land on/after token[1]; 2**32-5 exceeds every token
+    # (wraps to owner 0); 50 lands before token[0]
+    assert list(b) == [1, 2, 0, 0]
+    an = np.asarray(ring_lookup_n(jt, jo, jnp.asarray(h_int64), 2, 3))
+    bn = np.asarray(ring_lookup_n(jt, jo, jnp.asarray(h_u32), 2, 3))
+    assert np.array_equal(an, bn)
+    # padded flavors too (the serve tier's resident programs)
+    pt, po, count = pad_ring_arrays(tokens, owners, 5)
+    pa = np.asarray(
+        ring_lookup_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+            jnp.asarray(h_int64),
+        )
+    )
+    assert np.array_equal(pa, b)
+
+
+def test_lookup_matches_live_hash_ring():
+    """End to end: the padded device ring built from a real HashRing's
+    token arrays answers every key like ring.lookup (including keys
+    crafted to collide with vnode tokens)."""
+    servers = [f"10.0.0.{i}:3000" for i in range(12)]
+    ring = HashRing(replica_points=20)
+    ring.add_remove_servers(servers, [])
+    toks, owns, slist = ring.token_arrays()
+    pt, po, count = pad_ring_arrays(
+        toks.astype(np.uint32), owns.astype(np.int32), toks.shape[0] + 13
+    )
+    keys = [f"user:{i}" for i in range(300)]
+    hashes = np.array(
+        [fingerprint32(k.encode()) for k in keys], dtype=np.uint32
+    )
+    got = np.asarray(
+        ring_lookup_padded(
+            jnp.asarray(pt), jnp.asarray(po), jnp.asarray(count, jnp.int32),
+            jnp.asarray(hashes),
+        )
+    )
+    want = [slist.index(ring.lookup(k)) for k in keys]
+    assert list(got) == want

@@ -1,0 +1,602 @@
+"""Serve-the-ring tier: device ring state, micro-batching collector,
+shared-memory + TCP transports, DGRO placement (ringpop_tpu/serve/)."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ringpop_tpu.hashring import HashRing
+from ringpop_tpu.serve.bench import ServiceThread
+from ringpop_tpu.serve.client import HostBisectFrontend, ServeClient
+from ringpop_tpu.serve.service import RingService
+from ringpop_tpu.serve.state import (
+    RingStore,
+    serve_lookup,
+    serve_lookup_fused,
+    serve_lookup_n,
+)
+
+SERVERS = [f"10.7.0.{i}:3000" for i in range(24)]
+
+
+def _hashes(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 2**32, size=n, dtype=np.uint32)
+
+
+class _Journal:
+    def __init__(self):
+        self.records = []
+
+    def _write(self, obj):
+        self.records.append(obj)
+
+
+class _Stats:
+    def __init__(self):
+        self.counts = {}
+        self.gauges = {}
+        self.timings = []
+
+    def incr(self, key, n=1):
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def gauge(self, key, v):
+        self.gauges[key] = v
+
+    def timing(self, key, v):
+        self.timings.append((key, v))
+
+
+# -- RingStore / DeviceRing --------------------------------------------------
+
+
+def test_store_lookup_matches_host_oracle():
+    store = RingStore(SERVERS, replica_points=20)
+    ring, gen, _ = store.snapshot()
+    probe = _hashes(512)
+    dev = np.asarray(serve_lookup(ring, jnp.asarray(probe))[0])
+    oracle = HostBisectFrontend(SERVERS, 20).lookup_hashes(probe)
+    assert np.array_equal(dev, oracle)
+    assert gen == 0
+
+
+def test_store_update_bumps_generation_and_swaps_values():
+    store = RingStore(SERVERS, replica_points=10)
+    probe = _hashes(256, seed=1)
+    rec = store.update(add=["10.7.1.1:3000"], remove=[SERVERS[0]])
+    assert rec["gen"] == 1 and rec["kind"] == "ring_update"
+    assert rec["added"] == ["10.7.1.1:3000"] and rec["removed"] == [SERVERS[0]]
+    ring, gen, _ = store.snapshot()
+    owners, dev_gen = serve_lookup(ring, jnp.asarray(probe))
+    assert int(np.asarray(dev_gen)[0]) == gen == 1
+    live = store.servers_at(1)
+    oracle = HostBisectFrontend(live, 10).lookup_hashes(probe)
+    assert np.array_equal(np.asarray(owners), oracle)
+    # no-op update commits nothing
+    assert store.update(add=["10.7.1.1:3000"]) is None
+    assert store.gen == 1
+
+
+def test_store_checksum_tracks_host_ring():
+    store = RingStore(SERVERS[:4], replica_points=10)
+    rec = store.update(add=["b:1"])
+    oracle = HashRing(replica_points=10)
+    oracle.add_remove_servers(sorted(SERVERS[:4]) + ["b:1"], [])
+    assert rec["checksum"] == oracle.checksum()
+
+
+def test_store_capacity_reallocates_on_overflow():
+    store = RingStore(SERVERS[:2], replica_points=10, capacity=25)
+    assert store.capacity == 25
+    rec = store.update(add=["c:1"])  # 30 tokens > 25
+    assert rec["reallocated"] and rec["count"] == 30
+    assert store.capacity >= 30
+    probe = _hashes(64, seed=2)
+    ring, gen, _ = store.snapshot()
+    dev = np.asarray(serve_lookup(ring, jnp.asarray(probe))[0])
+    oracle = HostBisectFrontend(store.servers_at(gen), 10).lookup_hashes(probe)
+    assert np.array_equal(dev, oracle)
+
+
+def test_store_generation_ring_buffer_ages_out():
+    store = RingStore(SERVERS[:3], replica_points=5, keep_generations=2)
+    for i in range(4):
+        store.update(add=[f"x{i}:1"])
+    assert store.servers_at(store.gen) is not None
+    assert store.servers_at(store.gen - 1) is not None
+    assert store.servers_at(0) is None
+
+
+def test_store_host_mirror_matches_device():
+    store = RingStore(SERVERS, replica_points=10)
+    store.update(add=["z:9"])
+    toks, owns, gen = store.snapshot_host()
+    probe = _hashes(256, seed=3)
+    idx = np.searchsorted(toks, probe, side="left")
+    host = owns[np.where(idx == toks.shape[0], 0, idx)]
+    ring, dgen, _ = store.snapshot()
+    dev = np.asarray(serve_lookup(ring, jnp.asarray(probe))[0])
+    assert gen == dgen and np.array_equal(host, dev)
+
+
+def test_store_listens_to_live_ring_changes():
+    """The live-update feed: RingChangedEvents from a host HashRing drive
+    committed generations."""
+    store = RingStore(SERVERS[:4], replica_points=10)
+    live = HashRing(replica_points=10)
+    live.add_remove_servers(SERVERS[:4], [])
+    store.listen_to(live)
+    live.add_remove_servers(["new:1"], [SERVERS[0]])
+    assert store.gen == 1
+    assert "new:1" in store.servers_at(1)
+    assert SERVERS[0] not in store.servers_at(1)
+
+
+def test_serve_lookup_fused_matches_pair():
+    store = RingStore(SERVERS[:6], replica_points=10)
+    store.update(add=["q:1"])
+    ring, gen, _ = store.snapshot()
+    probe = _hashes(33, seed=4)
+    owners, dev_gen = serve_lookup(ring, jnp.asarray(probe))
+    fused = np.asarray(serve_lookup_fused(ring, jnp.asarray(probe)))
+    assert np.array_equal(fused[:-1], np.asarray(owners))
+    assert fused[-1] == int(np.asarray(dev_gen)[0]) == gen
+
+
+def test_serve_lookup_n_preference_lists():
+    store = RingStore(SERVERS[:8], replica_points=10)
+    ring, gen, ns = store.snapshot()
+    probe = _hashes(64, seed=5)
+    out, _ = serve_lookup_n(ring, ns, jnp.asarray(probe), 3)
+    out = np.asarray(out)
+    host = HashRing(replica_points=10)
+    host.add_remove_servers(SERVERS[:8], [])
+    slist = host.servers()
+    for i, h in enumerate(probe.tolist()):
+        want = [slist.index(s) for s in host._lookup_n_hash(h, 3)]
+        assert list(out[i]) == want
+
+
+def test_empty_store_answers_minus_one():
+    store = RingStore([], replica_points=10)
+    ring, gen, _ = store.snapshot()
+    out = np.asarray(serve_lookup(ring, jnp.asarray(_hashes(8)))[0])
+    assert (out == -1).all() and gen == 0
+
+
+# -- the micro-batching collector -------------------------------------------
+
+
+def test_collector_coalesces_same_iteration_submits():
+    journal = _Journal()
+    stats = _Stats()
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, flush_us=0.0, journal=journal, stats=stats,
+                      journal_every=1)
+    h1, h2, h3 = _hashes(40, 1), _hashes(50, 2), _hashes(60, 3)
+
+    async def main():
+        f1 = svc.submit(h1)
+        f2 = svc.submit(h2)
+        f3 = svc.submit(h3)
+        return await asyncio.gather(f1, f2, f3)
+
+    results = asyncio.run(main())
+    oracle = HostBisectFrontend(SERVERS, 10)
+    for h, (owners, gen) in zip((h1, h2, h3), results):
+        assert np.array_equal(owners, oracle.lookup_hashes(h))
+        assert gen == 0
+    # ONE flush carried all three requests
+    assert svc.telemetry.flushes_total == 1
+    assert svc.telemetry.requests_total == 3
+    assert svc.telemetry.keys_total == 150
+    assert stats.counts["ringpop.serve.flushes"] == 1
+    rec = journal.records[-1]
+    assert rec["kind"] == "serve" and rec["requests"] == 3 and rec["keys"] == 150
+    assert {"mean", "p50", "p90", "max"} <= set(rec["keys_per_flush"])
+    assert {"mean", "p50", "p90", "max"} <= set(rec["queue_wait_us"])
+
+
+def test_collector_size_trigger_flushes_immediately():
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, flush_us=10_000_000.0, max_batch=64)
+
+    async def main():
+        t0 = time.perf_counter()
+        f = svc.submit(_hashes(80))  # over max_batch: no waiting for the timer
+        out = await f
+        return out, time.perf_counter() - t0
+
+    (owners, gen), dt = asyncio.run(main())
+    assert len(owners) == 80 and dt < 5.0
+
+
+def test_collector_latency_trigger_fires():
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, flush_us=2000.0, max_batch=1 << 20)
+
+    async def main():
+        f = svc.submit(_hashes(16))
+        return await asyncio.wait_for(f, timeout=10)
+
+    owners, gen = asyncio.run(main())
+    assert len(owners) == 16
+
+
+def test_collector_groups_by_n():
+    store = RingStore(SERVERS[:8], replica_points=10)
+    svc = RingService(store, flush_us=0.0)
+    h = _hashes(32, seed=7)
+
+    async def main():
+        f1 = svc.submit(h, n=1)
+        f2 = svc.submit(h, n=3)
+        return await asyncio.gather(f1, f2)
+
+    (o1, g1), (o3, g3) = asyncio.run(main())
+    host = HashRing(replica_points=10)
+    host.add_remove_servers(SERVERS[:8], [])
+    slist = host.servers()
+    for i, hh in enumerate(h.tolist()):
+        want = [slist.index(s) for s in host._lookup_n_hash(hh, 3)]
+        assert list(np.asarray(o3).reshape(-1, 3)[i]) == want
+        assert o1[i] == want[0]
+    assert svc.telemetry.flushes_total == 1  # one flush, two dispatches
+
+
+def test_collector_rejects_bad_n():
+    store = RingStore(SERVERS[:4], replica_points=5)
+    svc = RingService(store)
+
+    async def main():
+        svc.submit(_hashes(4), n=0)
+
+    with pytest.raises(ValueError):
+        asyncio.run(main())
+
+
+def test_dispatch_direct_matches_collector_and_telemeters():
+    journal = _Journal()
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, journal=journal, journal_every=1)
+    got = {}
+    h = _hashes(8, seed=9)
+    svc.dispatch_direct(h, 1, lambda rows, gen: got.update(rows=rows, gen=gen))
+    oracle = HostBisectFrontend(SERVERS, 10).lookup_hashes(h)
+    assert np.array_equal(got["rows"], oracle) and got["gen"] == 0
+    assert journal.records[-1]["kind"] == "serve"
+    # n>1 rides the device preference-list program
+    svc.dispatch_direct(h, 2, lambda rows, gen: got.update(rows2=rows))
+    assert got["rows2"].shape == (8, 2)
+    assert np.array_equal(got["rows2"][:, 0], oracle)
+
+
+def test_ring_update_journal_and_stats():
+    journal = _Journal()
+    stats = _Stats()
+    store = RingStore(SERVERS[:4], replica_points=10)
+    svc = RingService(store, journal=journal, stats=stats)
+    store.update(add=["w:1"])
+    rec = journal.records[-1]
+    assert rec["kind"] == "ring_update" and rec["gen"] == 1
+    assert rec["n_servers"] == 5 and not rec["reallocated"]
+    assert stats.gauges["ringpop.serve.ring.servers"] == 5
+    assert stats.counts["ringpop.serve.ring.changed"] == 1
+
+
+# -- transports ---------------------------------------------------------------
+
+
+@pytest.fixture
+def service_thread():
+    store = RingStore(SERVERS, replica_points=10)
+    th = ServiceThread(store, flush_us=0.0, shm_slots=2, shm_key_cap=4096,
+                       shm_max_n=4)
+    th.start()
+    yield th
+    th.stop()
+
+
+def test_tcp_roundtrip_and_generation_fetch(service_thread):
+    th = service_thread
+
+    async def main():
+        from ringpop_tpu.net import TCPChannel
+
+        chan = TCPChannel(app="t")
+        client = ServeClient(chan, th.hostport)
+        h = _hashes(96, seed=11)
+        owners, gen = await client.lookup_hashes(h)
+        servers = await client.servers_at(gen)
+        o3, g3 = await client.lookup_hashes(h[:8], n=3)
+        resolved = await client.lookup(h[:4])
+        await chan.close()
+        return owners, gen, servers, o3, resolved
+
+    owners, gen, servers, o3, resolved = asyncio.run(main())
+    assert gen == 0 and servers == sorted(SERVERS)
+    oracle = HostBisectFrontend(SERVERS, 10)
+    h = _hashes(96, seed=11)
+    assert np.array_equal(owners, oracle.lookup_hashes(h))
+    assert o3.shape == (8, 3)
+    assert resolved == [sorted(SERVERS)[o] for o in oracle.lookup_hashes(h[:4])]
+
+
+def test_shm_roundtrip_small_and_large(service_thread):
+    """The shared-memory transport: small batches ride the degenerate fast
+    lane, large ones the collector — both must match the oracle, and n>1
+    must reshape correctly."""
+    from ringpop_tpu.serve.shm import ShmClient
+
+    th = service_thread
+    name, sock, slots, cap, max_n = th.shm_address()
+    out = {}
+
+    def client_run():
+        cl = ShmClient(name, sock, 0, slots=slots, key_cap=cap, max_n=max_n)
+        small = _hashes(8, seed=13)
+        big = _hashes(600, seed=14)
+        out["small"] = cl.lookup_hashes(small)
+        out["big"] = cl.lookup_hashes(big)
+        out["n3"] = cl.lookup_hashes(small, n=3)
+        with pytest.raises(ValueError):
+            cl.lookup_hashes(_hashes(cap + 1))
+        with pytest.raises(ValueError):
+            cl.lookup_hashes(small, n=max_n + 1)
+        cl.close()
+
+    t = threading.Thread(target=client_run)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    oracle = HostBisectFrontend(SERVERS, 10)
+    small, big = _hashes(8, seed=13), _hashes(600, seed=14)
+    o_small, g_small = out["small"]
+    o_big, g_big = out["big"]
+    assert np.array_equal(o_small, oracle.lookup_hashes(small))
+    assert np.array_equal(o_big, oracle.lookup_hashes(big))
+    assert g_small == g_big == 0
+    o3, _ = out["n3"]
+    assert o3.shape == (8, 3)
+    assert np.array_equal(o3[:, 0], oracle.lookup_hashes(small))
+
+
+def test_shm_sees_new_generation_after_update(service_thread):
+    from ringpop_tpu.serve.shm import ShmClient
+
+    th = service_thread
+    th.store.update(add=["gen:1"])
+    name, sock, slots, cap, max_n = th.shm_address()
+    out = {}
+
+    def client_run():
+        cl = ShmClient(name, sock, 1, slots=slots, key_cap=cap, max_n=max_n)
+        out["r"] = cl.lookup_hashes(_hashes(128, seed=15))
+        cl.close()
+
+    t = threading.Thread(target=client_run)
+    t.start()
+    t.join(timeout=60)
+    owners, gen = out["r"]
+    assert gen == 1
+    oracle = HostBisectFrontend(
+        th.store.servers_at(1), 10
+    ).lookup_hashes(_hashes(128, seed=15))
+    assert np.array_equal(owners, oracle)
+
+
+# -- DGRO placement -----------------------------------------------------------
+
+
+def test_dgro_movement_gate_and_zero_excess():
+    from ringpop_tpu.serve.placement import dgro_place
+
+    toks, owns, rep = dgro_place(SERVERS, 50, candidates=6, probes=1 << 13,
+                                 churn_frac=0.05, seed=2)
+    assert rep["movement_chosen"] <= rep["movement_random"] + 1e-9
+    assert all(e == 0.0 for e in rep["excess_movement"])
+    assert toks.shape == owns.shape == (len(SERVERS) * 50,)
+    assert list(toks) == sorted(toks)
+
+
+def test_dgro_sticky_replay_is_bit_identical():
+    from ringpop_tpu.serve.placement import dgro_place
+
+    toks, owns, rep = dgro_place(SERVERS[:8], 20, candidates=4, probes=1 << 12)
+    toks2, owns2, rep2 = dgro_place(SERVERS[:8], 20, fixed_salt=rep["salt"])
+    assert np.array_equal(toks, toks2) and np.array_equal(owns, owns2)
+    assert not rep2["rescored"]
+
+
+def test_dgro_store_serves_correctly_and_stays_sticky():
+    store = RingStore(SERVERS[:12], replica_points=20, placement="dgro",
+                      placement_kw=dict(probes=1 << 12, candidates=4))
+    salt = store._dgro_salt
+    probe = _hashes(256, seed=17)
+    ring, gen, _ = store.snapshot()
+    dev = np.asarray(serve_lookup(ring, jnp.asarray(probe))[0])
+    ht, ho, hg = store.snapshot_host()
+    idx = np.searchsorted(ht, probe, side="left")
+    assert np.array_equal(dev, ho[np.where(idx == ht.shape[0], 0, idx)])
+    # membership churn must replay the SAME candidate (sticky salt)
+    store.update(add=["sticky:1"])
+    assert store._dgro_salt == salt
+    ring2, gen2, _ = store.snapshot()
+    assert gen2 == 1
+
+
+def test_dgro_candidate_zero_is_default_placement():
+    """Salt 0 must reproduce the reference random-replica placement
+    exactly — the gate's baseline is the real baseline."""
+    from ringpop_tpu.serve.placement import dgro_place
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+
+    toks, owns, _rep = dgro_place(SERVERS[:6], 30, fixed_salt=0)
+    ref_t, ref_o = build_ring_tokens(sorted(SERVERS[:6]), 30)
+    assert np.array_equal(toks, np.asarray(ref_t))
+    assert np.array_equal(owns, np.asarray(ref_o))
+
+
+def test_key_movement_metric():
+    """Removing one server moves exactly its keys: moved_frac equals the
+    removed load share and excess_moved (consistent-hashing violations)
+    is zero."""
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+    from ringpop_tpu.serve.placement import key_movement
+
+    a = sorted(SERVERS[:10])
+    b = sorted(SERVERS[1:10])  # drop one
+    ta, oa = build_ring_tokens(a, 50)
+    tb, ob = build_ring_tokens(b, 50)
+    hashes = jnp.asarray(_hashes(1 << 14, seed=19))
+    rep = key_movement(ta, oa, a, tb, ob, b, hashes)
+    assert rep["excess_moved"] == 0
+    assert rep["moved_frac"] == rep["removed_load_frac"]
+    assert 0.02 < rep["moved_frac"] < 0.3
+
+
+# -- review-fix pins ----------------------------------------------------------
+
+
+def test_hashring_add_and_remove_same_server_one_batch():
+    """A server in BOTH lists of one batch (a flapping node in one SWIM
+    membership update) is a net no-op for the arrays — the incremental
+    path must not crash on it (regression: KeyError in the merge-insert)."""
+    ring = HashRing(replica_points=10)
+    ring.add_remove_servers(["a:1", "b:1"], [])
+    assert ring.add_remove_servers(["c:1"], ["c:1"])  # event still fires
+    oracle = HashRing(replica_points=10)
+    oracle.add_remove_servers(["a:1", "b:1"], [])
+    assert np.array_equal(ring._tokens, oracle._tokens)
+    assert np.array_equal(ring._owners, oracle._owners)
+    assert ring.checksum() == oracle.checksum()
+
+
+def test_snapshot_survives_one_concurrent_commit():
+    """The ping-pong donation contract: a DeviceRing snapshot taken before
+    a commit still answers (correctly, at ITS generation) after that
+    commit — commit N donates generation N-2's buffers, never N-1's."""
+    store = RingStore(SERVERS, replica_points=10)
+    old_ring, old_gen, _ = store.snapshot()
+    old_servers = store.servers_at(old_gen)
+    store.update(add=["race:1"])  # one concurrent commit
+    probe = _hashes(128, seed=21)
+    owners, gen = serve_lookup(old_ring, jnp.asarray(probe))
+    assert int(np.asarray(gen)[0]) == old_gen
+    oracle = HostBisectFrontend(old_servers, 10).lookup_hashes(probe)
+    assert np.array_equal(np.asarray(owners), oracle)
+    # ...and two commits later the OLD snapshot's buffers are donated
+    # (that tail is what the service's dispatch retry covers)
+    store.update(add=["race:2"])
+    new_ring, new_gen, _ = store.snapshot()
+    fresh = np.asarray(serve_lookup(new_ring, jnp.asarray(probe))[0])
+    oracle2 = HostBisectFrontend(store.servers_at(new_gen), 10).lookup_hashes(probe)
+    assert np.array_equal(fresh, oracle2)
+
+
+def test_flush_retries_on_retired_ring(monkeypatch):
+    """Double-commit-mid-dispatch tail: the first dispatch attempt hitting
+    a deleted donated buffer must refetch the newest snapshot and answer
+    from it — requests resolve, nothing strands."""
+    import ringpop_tpu.serve.service as svc_mod
+
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, flush_us=0.0)
+    real = svc_mod.serve_lookup_fused
+    calls = {"n": 0}
+
+    def flaky(ring, hashes):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("Array has been deleted with shape=uint32[6400]")
+        return real(ring, hashes)
+
+    monkeypatch.setattr(svc_mod, "serve_lookup_fused", flaky)
+    h = _hashes(32, seed=23)
+
+    async def main():
+        return await asyncio.wait_for(svc.submit(h), timeout=10)
+
+    owners, gen = asyncio.run(main())
+    assert calls["n"] == 2  # one retry
+    oracle = HostBisectFrontend(SERVERS, 10).lookup_hashes(h)
+    assert np.array_equal(owners, oracle)
+
+
+def test_flush_failure_fails_futures_not_hangs(monkeypatch):
+    """A non-retryable dispatch error must surface on the future (the TCP
+    client sees an error response), never strand it pending."""
+    import ringpop_tpu.serve.service as svc_mod
+
+    store = RingStore(SERVERS, replica_points=10)
+    svc = RingService(store, flush_us=0.0)
+
+    def broken(ring, hashes):
+        raise ValueError("boom")
+
+    monkeypatch.setattr(svc_mod, "serve_lookup_fused", broken)
+
+    async def main():
+        with pytest.raises(ValueError):
+            await asyncio.wait_for(svc.submit(_hashes(8)), timeout=10)
+
+    asyncio.run(main())
+
+
+def test_shm_slot_not_poisoned_by_dispatch_error(service_thread, monkeypatch):
+    """A dispatch exception answers STATUS_ERR (client raises) and frees
+    the slot — the NEXT request on the same slot must succeed."""
+    import ringpop_tpu.serve.service as svc_mod
+
+    from ringpop_tpu.serve.shm import ShmClient
+
+    th = service_thread
+    real = svc_mod.serve_lookup_fused
+    fail_once = {"armed": True}
+
+    def flaky(ring, hashes):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            raise ValueError("injected dispatch failure")
+        return real(ring, hashes)
+
+    monkeypatch.setattr(svc_mod, "serve_lookup_fused", flaky)
+    name, sock, slots, cap, max_n = th.shm_address()
+    out = {}
+
+    def client_run():
+        cl = ShmClient(name, sock, 0, slots=slots, key_cap=cap, max_n=max_n)
+        big = _hashes(600, seed=27)  # >64: rides the collector, hits flaky
+        try:
+            cl.lookup_hashes(big)
+            out["first"] = "ok"
+        except RuntimeError:
+            out["first"] = "error"
+        out["second"] = cl.lookup_hashes(big)  # slot must still work
+        cl.close()
+
+    t = threading.Thread(target=client_run)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert out["first"] == "error"
+    owners, gen = out["second"]
+    oracle = HostBisectFrontend(SERVERS, 10).lookup_hashes(_hashes(600, seed=27))
+    assert np.array_equal(owners, oracle)
+
+
+def test_service_chains_existing_on_update_hook():
+    """RingService must not silently replace a caller-installed
+    RingStore(on_update=...) hook — both must fire per generation."""
+    seen = []
+    store = RingStore(SERVERS[:4], replica_points=5, on_update=seen.append)
+    journal = _Journal()
+    RingService(store, journal=journal)
+    store.update(add=["hooked:1"])
+    assert len(seen) == 1 and seen[0]["gen"] == 1  # caller hook still fires
+    assert journal.records[-1]["kind"] == "ring_update"  # service journal too
