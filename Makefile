@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke multihost-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke multihost-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -51,6 +51,13 @@ mc-smoke:
 # asserted here (2-core CI container).
 serve-smoke:
 	$(PY) scripts/serve_smoke.py
+
+# multi-host DCN-fabric gate (r14): 2 coordinated OS processes through the
+# real jax.distributed bring-up — 1-proc vs 2-proc twin digests must equal
+# the in-process engine's, and a 2-proc block-sharded orbax save must
+# restore at 1 process and continue digest-equal to an unbroken run.
+multihost-smoke:
+	$(PY) scripts/multihost_smoke.py
 
 # AOT warm-start gate (util/aot.py): serialize the sharded (pipelined)
 # tick block, reload it through the front door in a fresh subprocess —

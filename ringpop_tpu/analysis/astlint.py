@@ -34,6 +34,15 @@ paired-run certificates (rule catalog with the full story: ANALYSIS.md):
   protocol-phase functions the r7 telemetry attribution depends on must
   carry a scope at all; a scope-less collective censuses as
   "(unattributed)", defeating the phase budget.
+* **RPA106 int32-flat-index** — a ``row * K (+ col)`` flat-index product
+  in jit-reachable code whose operands are an arange-derived index
+  vector and an array-extent (``.shape`` unpack / ``params.n``-style),
+  or an ``arange`` iota SIZED by a product of two extents, with no
+  explicit dtype widening.  Under disabled x64 the product lands in
+  int32 and silently wraps once N·K ≥ 2³¹ — 16M × 256 ≈ 4.1e9 is inside
+  the multi-host target (the r14 audit's hazard class).  Blessed forms:
+  keep (row, col) pairs, or route mod-2³² lanes through
+  ``packbits.flat_index_u32`` (explicit wrapping uint32).
 
 The linter is file-local by design: alias-aware name resolution plus a
 per-module call-graph closure from ``jax.jit`` roots.  Cross-module
@@ -62,6 +71,7 @@ RULES = {
     "RPA103": "host-sync-in-jit",
     "RPA104": "x64-promotion",
     "RPA105": "phase-scope",
+    "RPA106": "int32-flat-index",
 }
 
 # modules whose programs run (or may run) under a device mesh — the
@@ -148,6 +158,8 @@ def _rule_applies(rule: str, relpath: str) -> bool:
     if rule == "RPA104":
         return relpath.startswith(("ringpop_tpu/", "scripts/", "examples/"))
     if rule == "RPA105":
+        return relpath.startswith("ringpop_tpu/")
+    if rule == "RPA106":
         return relpath.startswith("ringpop_tpu/")
     return True  # RPA103: anywhere a jit root lives
 
@@ -308,6 +320,81 @@ def _is_static_cast_arg(node) -> bool:
             ):
                 return True
             root = root.value
+    return False
+
+
+# attribute leaves that read as array extents when assigned to a local
+# name or used directly in an index product (params.n, params.k, x.shape)
+_EXTENT_ATTRS = ("n", "k", "shape")
+
+# dtype-widening / wrapping constructors that mark an index product as
+# DELIBERATE (the flat_index_u32 helper's own spelling, host-numpy 64-bit
+# math, float accumulators) — RPA106 passes these through
+_WIDENING_CALLS = {
+    "uint32", "uint64", "int64", "float32", "float64", "asarray",
+}
+
+
+def _rpa106_sets(fn_node) -> tuple[set, set]:
+    """Per-function (extent_names, arange_names) for RPA106: names bound
+    from ``.shape`` unpacks / ``.shape[i]`` / ``params.n``-style attrs,
+    and names bound from ``jnp.arange(...)`` calls."""
+    extents: set[str] = set()
+    aranges: set[str] = set()
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        tgt, val = sub.targets[0], sub.value
+
+        def names_of(t):
+            if isinstance(t, ast.Name):
+                return [t.id]
+            if isinstance(t, ast.Tuple):
+                return [e.id for e in t.elts if isinstance(e, ast.Name)]
+            return []
+
+        def is_extent_value(v):
+            if isinstance(v, ast.Attribute) and v.attr in _EXTENT_ATTRS:
+                return True
+            if (
+                isinstance(v, ast.Subscript)
+                and isinstance(v.value, ast.Attribute)
+                and v.value.attr == "shape"
+            ):
+                return True
+            return False
+
+        if isinstance(val, ast.Tuple) and isinstance(tgt, ast.Tuple):
+            for t_el, v_el in zip(tgt.elts, val.elts):
+                if isinstance(t_el, ast.Name) and is_extent_value(v_el):
+                    extents.add(t_el.id)
+        elif is_extent_value(val):
+            extents.update(names_of(tgt))
+        elif isinstance(val, ast.Call):
+            fn = val.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "arange":
+                aranges.update(names_of(tgt))
+    return extents, aranges
+
+
+def _rpa106_is_widened(node) -> bool:
+    """True when an operand explicitly names its width: ``.astype(...)``,
+    a dtype-constructor call (jnp.uint32(...), np.int64(...)), or an
+    arange with an explicit ``dtype=``."""
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "astype":
+                return True
+            if node.func.attr in _WIDENING_CALLS:
+                return True
+            if node.func.attr == "arange" and any(
+                kw.arg == "dtype" for kw in node.keywords
+            ):
+                return True
+        if isinstance(node.func, ast.Name) and node.func.id in _WIDENING_CALLS:
+            return True
+    if isinstance(node, (ast.Subscript, ast.Attribute)):
+        return _rpa106_is_widened(node.value)
     return False
 
 
@@ -506,6 +593,70 @@ def lint_source(src: str, relpath: str) -> list[Finding]:
                     "function — 64-bit host dtypes do not exist on the "
                     "x64-disabled device; use 32-bit",
                 )
+
+    # RPA106: int32 flat-index products in jit-reachable code ------------
+    if _rule_applies("RPA106", relpath):
+        seen_rpa106: set[int] = set()
+        for fname, defs in mod.functions.items():
+            for fn_node, _qn in defs:
+                extents, aranges = _rpa106_sets(fn_node)
+
+                def extentish(e):
+                    if isinstance(e, ast.Name):
+                        return e.id in extents
+                    if isinstance(e, ast.Attribute):
+                        return e.attr in ("n", "k")
+                    return False
+
+                def arangeish(e):
+                    if isinstance(e, ast.Name):
+                        return e.id in aranges
+                    if isinstance(e, (ast.Subscript,)) and isinstance(e.value, ast.Name):
+                        return e.value.id in aranges
+                    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+                        return e.func.attr == "arange" and not _rpa106_is_widened(e)
+                    return False
+
+                for sub in ast.walk(fn_node):
+                    lineno = getattr(sub, "lineno", None)
+                    if lineno is None or lineno in seen_rpa106 or not mod.in_jit(lineno):
+                        continue
+                    if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mult):
+                        l, r = sub.left, sub.right
+                        pair = (
+                            (arangeish(l) and extentish(r))
+                            or (arangeish(r) and extentish(l))
+                        )
+                        if pair and not (_rpa106_is_widened(l) or _rpa106_is_widened(r)):
+                            seen_rpa106.add(sub.lineno)
+                            add(
+                                "RPA106", sub,
+                                "int32 flat-index product of a traced index "
+                                "vector and an array extent — wraps silently "
+                                "once the plane reaches N*K >= 2**31 (16M x "
+                                "256 is inside the multi-host target); keep "
+                                "(row, col) pairs, or use packbits."
+                                "flat_index_u32 for mod-2**32 digest lanes",
+                            )
+                    elif (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "arange"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.BinOp)
+                        and isinstance(sub.args[0].op, ast.Mult)
+                        and extentish(sub.args[0].left)
+                        and extentish(sub.args[0].right)
+                        and not any(kw.arg == "dtype" for kw in sub.keywords)
+                    ):
+                        seen_rpa106.add(sub.lineno)
+                        add(
+                            "RPA106", sub,
+                            "arange sized by a product of two traced extents "
+                            "builds an int32 iota that wraps past 2**31 — "
+                            "iterate (row, col) instead of a flat index, or "
+                            "state the wrapping intent with an explicit dtype",
+                        )
 
     # RPA105 (b): required protocol-phase functions carry a scope --------
     if _rule_applies("RPA105", relpath):

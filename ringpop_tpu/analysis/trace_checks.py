@@ -603,6 +603,24 @@ def build_entrypoints(mesh=None) -> dict:
         out["delta_step_seq_exchange"] = jax.make_jaxpr(
             lambda s, f: delta.step(sdparams, s, f)
         )(dstate, lfaults)
+
+        # r14: the PROCESS-SPANNING construction path, single-process
+        # traced — the same delta step bound to a mesh built by
+        # make_multihost_mesh (the DCN granule layout) with shardings
+        # from the canonical partition table.  At lint time one process
+        # owns all 8 virtual devices, so the traced program is the exact
+        # program every rank of a real multi-host job traces; RPJ201/202/
+        # 203 pin it 32-bit, callback-free and phase-confined.
+        from ringpop_tpu.parallel.mesh import with_exchange_mesh
+        from ringpop_tpu.parallel.multihost import make_multihost_mesh
+
+        mh_mesh = make_multihost_mesh()
+        mh_params = with_exchange_mesh(
+            delta.DeltaParams(n=_N, k=_K, rng="counter"), mh_mesh
+        )
+        out["multihost_step"] = jax.make_jaxpr(
+            lambda s, f: delta.step(mh_params, s, f)
+        )(dstate, lfaults)
     return out
 
 

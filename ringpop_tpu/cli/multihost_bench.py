@@ -1,0 +1,169 @@
+"""One rank of a multi-process delta certification run.
+
+Spawned by ``scripts/multihost_launch.py`` (simbench ``multihost16m``,
+``make multihost-smoke``, the test suite): reads the standard
+``jax.distributed`` env contract, brings up the runtime
+(``init_distributed``), builds the host-bridged DCN fabric, and runs one
+of the certification legs, emitting JSONL records to ``MULTIHOST_JSONL``.
+
+Legs::
+
+    twin              — step a seeded scenario T ticks; emit the global
+                        state digest (the 1/2/4-process bit-identity twin)
+    converge          — run delta convergence through the fabric with a
+                        per-block journal; emit ticks/digest/peak-RSS/
+                        fabric-bytes
+    snapshot-save     — step T ticks, write the block-sharded orbax
+                        checkpoint, emit the digest at save
+    snapshot-restore  — restore the checkpoint AT THIS PROCESS COUNT
+                        (need not match the saver's), continue E ticks,
+                        emit the digest (the cross-process-count
+                        continuation certificate)
+
+Works single-process too (no coordinator env → plain local run), which is
+what makes the P=1 twin the SAME code path as P=2/4.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+
+def _emit(rec: dict) -> None:
+    path = os.environ.get("MULTIHOST_JSONL")
+    line = json.dumps(rec)
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    print(line, flush=True)
+
+
+def _peak_rss_mb() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="multihost_bench", description=__doc__)
+    p.add_argument("leg", choices=["twin", "converge", "snapshot-save", "snapshot-restore"])
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--k", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ticks", type=int, default=24)
+    p.add_argument("--extra-ticks", type=int, default=8)
+    p.add_argument("--max-ticks", type=int, default=4096)
+    p.add_argument("--journal-every", type=int, default=64)
+    p.add_argument("--victims", type=int, default=0)
+    p.add_argument("--drop", type=float, default=0.0)
+    p.add_argument("--path", default=None, help="orbax checkpoint dir (snapshot legs)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ringpop_tpu.parallel.fabric import DistributedKV, Fabric, LocalKV
+    from ringpop_tpu.parallel.multihost import init_distributed
+
+    distributed = init_distributed()
+    nprocs = jax.process_count() if distributed else 1
+    rank = jax.process_index() if distributed else 0
+    kv = DistributedKV() if distributed else LocalKV()
+    fabric = Fabric(rank, nprocs, kv, namespace=f"mhb-{args.leg}")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=args.n, k=args.k, rng="counter")
+    faults = None
+    if args.victims or args.drop:
+        kw = {}
+        if args.victims:
+            rng = np.random.default_rng(args.seed + 999)
+            up = np.ones(args.n, bool)
+            up[rng.choice(args.n, size=args.victims, replace=False)] = False
+            kw["up"] = jnp.asarray(up)
+        if args.drop:
+            kw["drop_rate"] = jnp.float32(args.drop)
+        faults = DeltaFaults(**kw)
+
+    t0 = time.perf_counter()
+    if args.leg == "twin":
+        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        for _ in range(args.ticks):
+            mh.step()
+        _emit(
+            {
+                "kind": "twin",
+                **mh.journal_record(),
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "peak_rss_mb": _peak_rss_mb(),
+            }
+        )
+    elif args.leg == "converge":
+        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        sink = (lambda rec: _emit({"kind": "block", **rec}))
+        ticks, ok = mh.run_until_converged(
+            max_ticks=args.max_ticks, sink=sink, journal_every=args.journal_every
+        )
+        wall = time.perf_counter() - t0
+        _emit(
+            {
+                "kind": "result",
+                "ticks": ticks,
+                "converged": ok,
+                "digest": mh.state_digest(),
+                "wall_s": round(wall, 3),
+                "ms_per_tick": round(1000.0 * wall / max(ticks, 1), 3),
+                "peak_rss_mb": _peak_rss_mb(),
+                "fabric_bytes_sent": fabric.bytes_sent,
+                "fabric_bytes_recv": fabric.bytes_recv,
+                "fabric_mb_per_tick": round(
+                    fabric.bytes_sent / max(ticks, 1) / 1e6, 3
+                ),
+                "process_count": nprocs,
+                "process_id": rank,
+                "n": args.n,
+                "k": args.k,
+            }
+        )
+    elif args.leg == "snapshot-save":
+        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        for _ in range(args.ticks):
+            mh.step()
+        mh.save_snapshot(args.path)
+        _emit(
+            {
+                "kind": "saved",
+                "tick": mh.tick,
+                "digest": mh.state_digest(),
+                "process_count": nprocs,
+                "peak_rss_mb": _peak_rss_mb(),
+            }
+        )
+    elif args.leg == "snapshot-restore":
+        mh = MultihostDelta.restore_snapshot(args.path, params, fabric, faults=faults)
+        restored_digest = mh.state_digest()
+        for _ in range(args.extra_ticks):
+            mh.step()
+        _emit(
+            {
+                "kind": "restored",
+                "tick": mh.tick,
+                "digest_at_restore": restored_digest,
+                "digest": mh.state_digest(),
+                "process_count": nprocs,
+                "peak_rss_mb": _peak_rss_mb(),
+            }
+        )
+    fabric.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

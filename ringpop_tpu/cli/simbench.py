@@ -1534,6 +1534,176 @@ def bench_asym_partition(seed: int, full: bool) -> dict:
                                seed=seed)
 
 
+def bench_multihost16m(seed: int, full: bool) -> dict:
+    """Multi-host DCN scale-out certificate (r14): the same seeded delta
+    scenario at 1/2/4 REAL OS processes through ``jax.distributed``
+    bring-up + ``make_multihost_mesh`` + the canonical partition table,
+    with the exchange legs bridged at host level
+    (``sim/delta_multihost``) because this backend cannot execute
+    cross-process XLA programs — on a pod the identical arithmetic runs
+    as the one jitted step (certified sharded==unsharded by
+    ``sharded100k``; the fabric twins certify the PROCESS axis).
+
+    Three legs, all recorded:
+
+    1. **twin** — paired 1/2/4-process runs of one seeded scenario
+       (victims + loss): every process count must produce THE SAME
+       global state digest, equal to the in-process engine's (the DCN
+       analog of the 4x2 virtual-mesh twins).
+    2. **snapshot** — 2-process block-sharded orbax save restored at 4
+       processes and continued: digest must equal an unbroken engine
+       run's.
+    3. **scale** — delta convergence at 16M nodes (full; 1M on the CPU
+       smoke tier) at P=1 and P=2: bit-identical digests, per-process
+       peak RSS (the sharding-actually-shards evidence), and measured
+       fabric MB/tick per host.
+    """
+    import os as _os
+    import sys as _sys
+
+    import numpy as np
+
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))), "scripts"))
+    from multihost_launch import launch
+
+    base = ["-m", "ringpop_tpu.cli.multihost_bench"]
+
+    # -- leg 1: the 1/2/4-process twin ---------------------------------------
+    tn, tk, tticks, victims, drop = 65536, 64, 24, 64, 0.05
+    common = ["--n", str(tn), "--k", str(tk), "--seed", str(seed),
+              "--victims", str(victims), "--drop", str(drop)]
+    twin = {}
+    for nprocs in (1, 2, 4):
+        t0 = time.perf_counter()
+        ranks = launch(nprocs, base + ["twin", *common, "--ticks", str(tticks)],
+                       timeout_s=900)
+        recs = [r["records"][-1] for r in ranks]
+        # a rank disagreement must land in the RECORD as a failed
+        # certificate (with every rank's digest visible), not abort the
+        # scenario — same discipline as the snapshot leg below
+        twin[str(nprocs)] = {
+            "digest": recs[0]["digest"],
+            "ranks_agree": len({r["digest"] for r in recs}) == 1,
+            "rank_digests": [r["digest"] for r in recs],
+            "peak_rss_mb": [r["peak_rss_mb"] for r in recs],
+            "wall_s": round(time.perf_counter() - t0, 2),
+        }
+    # engine anchor, in-process
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    tparams = DeltaParams(n=tn, k=tk, rng="counter")
+    rng = np.random.default_rng(seed + 999)
+    up = np.ones(tn, bool)
+    up[rng.choice(tn, size=victims, replace=False)] = False
+    tfaults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(drop))
+    st = init_state(tparams, seed=seed)
+    stp = jax.jit(functools.partial(step, tparams))
+    for _ in range(tticks):
+        st = stp(st, tfaults)
+    engine_digest = int(tree_digest(st))
+    twin_certified = all(
+        v["ranks_agree"] and v["digest"] == engine_digest for v in twin.values()
+    )
+
+    # -- leg 2: cross-process-count snapshot ---------------------------------
+    import shutil
+    import tempfile
+
+    ckpt = tempfile.mkdtemp(prefix="mh16m_ckpt_")
+    shutil.rmtree(ckpt)
+    t1, t2 = 16, 8
+    snap_common = ["--n", str(tn), "--k", str(tk), "--seed", str(seed),
+                   "--victims", str(victims)]
+    try:
+        ranks = launch(2, base + ["snapshot-save", *snap_common,
+                                  "--ticks", str(t1), "--path", ckpt], timeout_s=900)
+        saved_digest = ranks[0]["records"][-1]["digest"]
+        ranks = launch(4, base + ["snapshot-restore", *snap_common,
+                                  "--extra-ticks", str(t2), "--path", ckpt],
+                       timeout_s=900)
+        rest = [r["records"][-1] for r in ranks]
+        st2 = init_state(tparams, seed=seed)
+        f2 = DeltaFaults(up=jnp.asarray(up))
+        for _ in range(t1 + t2):
+            st2 = stp(st2, f2)
+        unbroken = int(tree_digest(st2))
+        snapshot = {
+            "save_procs": 2,
+            "restore_procs": 4,
+            "digest_at_save": saved_digest,
+            "digest_at_restore": rest[0]["digest_at_restore"],
+            "digest_continued": rest[0]["digest"],
+            "digest_unbroken_reference": unbroken,
+            "certified": bool(
+                rest[0]["digest_at_restore"] == saved_digest
+                and rest[0]["digest"] == unbroken
+                and len({r["digest"] for r in rest}) == 1
+            ),
+        }
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+    # -- leg 3: the scale axis — 16M through the DCN fabric ------------------
+    sn = 16_000_000 if full else 1_000_000
+    sk = 64
+    scale = {}
+    scale_common = ["--n", str(sn), "--k", str(sk), "--seed", str(seed),
+                    "--max-ticks", "4096", "--journal-every", "64"]
+    for nprocs in (1, 2):
+        t0 = time.perf_counter()
+        ranks = launch(nprocs, base + ["converge", *scale_common],
+                       timeout_s=3600, env_extra={"MULTIHOST_TIMEOUT": "3600"})
+        results = [
+            next(rec for rec in reversed(r["records"]) if rec["kind"] == "result")
+            for r in ranks
+        ]
+        scale[str(nprocs)] = {
+            "ticks": results[0]["ticks"],
+            "converged": results[0]["converged"],
+            "digest": results[0]["digest"],
+            "ranks_agree": len({r["digest"] for r in results}) == 1,
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "ms_per_tick": results[0]["ms_per_tick"],
+            "peak_rss_mb": [r["peak_rss_mb"] for r in results],
+            "fabric_mb_per_tick": [r["fabric_mb_per_tick"] for r in results],
+        }
+    scale_certified = (
+        scale["1"]["digest"] == scale["2"]["digest"]
+        and scale["1"]["ranks_agree"]
+        and scale["2"]["ranks_agree"]
+        and scale["1"]["converged"]
+        and scale["2"]["converged"]
+    )
+    rss_1p = max(scale["1"]["peak_rss_mb"])
+    rss_2p = max(scale["2"]["peak_rss_mb"])
+
+    return {
+        "metric": f"multihost_dcn_{sn // 1_000_000}m",
+        # headline: per-process peak RSS at 2 processes as a fraction of
+        # the single-process footprint for the SAME converged run
+        "value": round(rss_2p / rss_1p, 3),
+        "unit": "rss_frac_2proc_over_1proc",
+        "certified": bool(twin_certified and snapshot["certified"] and scale_certified),
+        "engine_digest": engine_digest,
+        "twin_certified": twin_certified,
+        "twin": twin,
+        "snapshot": snapshot,
+        "scale": scale,
+        "scale_certified": scale_certified,
+        "exchange_path": "host-bridged fabric (backend cannot run "
+        "cross-process XLA; mesh path certified by sharded100k)",
+        "n_nodes": sn,
+        "n_rumors": sk,
+    }
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
@@ -1550,6 +1720,7 @@ BENCHES = {
     "partition_lc": bench_partition_lifecycle,
     "sharded100k": bench_sharded100k,
     "delta16m": bench_delta16m,
+    "multihost16m": bench_multihost16m,
     "churn100k": bench_churn100k,
     "flap1k": bench_flap1k,
     "asym_partition": bench_asym_partition,

@@ -67,14 +67,13 @@ def make_mesh(n_devices: Optional[int] = None, shape: Optional[tuple[int, int]] 
 
 
 def delta_shardings(mesh: Mesh) -> DeltaState:
-    """PartitionSpecs for each DeltaState leaf."""
-    return DeltaState(
-        learned=NamedSharding(mesh, P("node", "rumor")),
-        pcount=NamedSharding(mesh, P("node", "rumor")),
-        ride_ok=NamedSharding(mesh, P("node", "rumor")),
-        tick=NamedSharding(mesh, P()),
-        key=NamedSharding(mesh, P()),
-    )
+    """NamedShardings for each DeltaState leaf — derived from the ONE
+    canonical per-leaf rule table (``parallel.partition.PARTITION_RULES``);
+    this wrapper only fixes the pytree type."""
+    from ringpop_tpu.parallel.partition import named_shardings
+
+    skeleton = DeltaState(learned=0, pcount=0, ride_ok=0, tick=0, key=0)
+    return named_shardings(skeleton, mesh)
 
 
 def shard_delta_state(state: DeltaState, mesh: Mesh) -> DeltaState:

@@ -28,6 +28,23 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def distributed_initialized() -> bool:
+    """Is the jax.distributed runtime up?  ``jax.distributed.is_initialized``
+    where the build has it (>= 0.5); on older builds (this container's
+    0.4.37) fall back to probing the internal global-state client — the
+    exact condition ``initialize`` itself checks before refusing a second
+    call, so the idempotence contract is identical either way."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -42,7 +59,7 @@ def init_distributed(
     single-process with no coordinator configured — single-process callers
     can then fall back to :func:`ringpop_tpu.parallel.mesh.make_mesh`.
     """
-    if jax.distributed.is_initialized():  # already up
+    if distributed_initialized():  # already up
         return True
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None:

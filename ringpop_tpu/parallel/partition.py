@@ -1,0 +1,323 @@
+"""Canonical per-leaf partition rules for every sim-plane pytree.
+
+The multi-host scale-out (ROADMAP "16M on real meshes") needs one answer,
+written down once, to "where does this leaf live on a mesh?".  Before this
+module each caller placed state ad hoc (``mesh.delta_shardings``,
+``lifecycle.state_shardings``, ``montecarlo.fleet_state_shardings`` — three
+hand-maintained tables that agreed only by review).  This is the one
+canonical table, in the match-partition-rules style of the pjit
+shard/gather-fn helpers (SNIPPETS.md [2][3]): an ordered list of
+``(leaf-name regex, PartitionSpec)`` rules applied to the tree-path name of
+every leaf.  The legacy per-engine helpers now DERIVE from this table (and
+a test pins the derivation), so a layout change edits exactly one list.
+
+Layout (PERF.md "Multi-host (DCN) design"): the **node axis** shards nodes
+(its per-tick collectives are nearest-neighbor exchange permutes — DCN
+crosses only at slice edges), the **rumor axis** shards rumor slots/words
+(its gathers ride ICI inside a host), per-node vectors are node-sharded,
+the rumor table is rumor-sharded, and everything else — scalars, PRNG
+keys, the tiny ``reach[G, G]`` matrix, the placement vectors — replicates.
+
+Placement/gather (the multi-host half):
+
+* :func:`shard_put` builds each GLOBAL array from every process's LOCAL
+  block via ``jax.make_array_from_single_device_arrays`` — no host ever
+  materializes a cross-process plane, which is what lets a 16M-node state
+  (1.3 GB at k=64) spread over hosts that could not hold it alone.
+* :func:`host_gather` is the inverse: the locally-addressable rows of each
+  leaf, as one contiguous host block per process.
+* :func:`process_block` is the node-axis ownership rule — contiguous
+  equal blocks in process order, the same split GSPMD produces for the
+  meshes built by ``make_multihost_mesh`` (pinned by test against
+  ``Sharding.devices_indices_map``).
+
+Digest partials (the certification half): :func:`leaf_partial_sums` /
+:func:`combine_leaf_partials` split ``telemetry.tree_digest`` into
+per-process partial sums over each process's rows AT GLOBAL flat indices.
+Because the digest's inner per-leaf accumulation is a wrapping uint32 SUM,
+partials over disjoint row blocks add to exactly the single-host value —
+so "sharded == unsharded" certifies across OS processes by exchanging one
+uint32 per leaf instead of gathering planes.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# -- the table ----------------------------------------------------------------
+
+# Ordered (regex, spec) rules matched against "/"-joined tree-path names
+# (first match wins; a leaf no rule matches REPLICATES — scalars and
+# whatever new small leaf lands next).  Names cover, today: DeltaState,
+# LifecycleState, TelemetryState, DeltaFaults, chaos.FaultPlan, and any
+# dict/NamedTuple nesting of them.
+PARTITION_RULES: list[tuple[str, P]] = [
+    # big per-(node, rumor) planes — packed planes shard WORDS, unpacked
+    # planes shard SLOTS (packbits.check_rumor_shardable is the k rule)
+    (r"(^|/)(learned|pcount|ride_ok|piggybacked|expired)$", P("node", "rumor")),
+    # per-node vectors (engine state, telemetry masks, fault legs)
+    (
+        r"(^|/)(base_status|base_inc|base_present|base_pending|base_deadline"
+        r"|self_inc|pings|ping_reqs|probes_failed|incarnation_bumps"
+        r"|base_timer_fires|up|base_up|group|drop_node|crash_tick"
+        r"|restart_tick|flap_period|flap_phase|flap_down)$",
+        P("node"),
+    ),
+    # rumor-table vectors
+    (r"(^|/)(r_subject|r_inc|r_status|r_deadline|timer_fires)$", P("rumor")),
+    # everything else replicates: tick/key scalars, decl_* placement
+    # vectors ([M] = alloc budget, replicated post-merge), heal_attempts,
+    # drop_rate, part_from/part_until, the tiny reach[G, G] matrix
+]
+
+
+def _path_name(path) -> str:
+    parts = []
+    for k in path:
+        name = getattr(k, "name", None)
+        if name is None:
+            name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "idx", None)
+        if name is None:
+            name = getattr(k, "key_idx", None)  # FlattenedIndexKey
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def spec_for(name: str) -> P:
+    """The canonical PartitionSpec for a leaf path name (first rule wins;
+    no match = replicated)."""
+    for pattern, spec in PARTITION_RULES:
+        if re.search(pattern, name):
+            return spec
+    return P()
+
+
+def partition_spec(tree, batch_axes: int = 0):
+    """Pytree of PartitionSpec, one per leaf, from the canonical table.
+
+    ``batch_axes`` prepends that many unsharded (None) axes to EVERY leaf
+    spec — the Monte-Carlo fleet's ``[B, ...]`` replica batch (scenarios
+    are independent; the batch axis replicates, and scalar leaves like
+    ``tick``/``key`` are batched to [B]/[B, 2] too, so they get the None
+    prefix as well — the ``montecarlo.fleet_state_shardings`` convention).
+    """
+
+    def one(path, leaf):
+        spec = spec_for(_path_name(path))
+        if batch_axes:
+            spec = P(*([None] * batch_axes), *spec)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def named_shardings(tree, mesh: Mesh, batch_axes: int = 0):
+    """Pytree of NamedSharding over ``mesh`` from :func:`partition_spec`.
+    ``tree`` may hold arrays OR ShapeDtypeStructs — only structure and
+    leaf names are read."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), partition_spec(tree, batch_axes=batch_axes)
+    )
+
+
+# -- process-block ownership --------------------------------------------------
+
+
+def process_block(n: int, rank: int, nprocs: int) -> tuple[int, int]:
+    """Node rows [lo, hi) owned by ``rank`` of ``nprocs``: contiguous equal
+    blocks in process order — the split the hybrid meshes built by
+    ``make_multihost_mesh`` produce for a node-sharded leaf (processes
+    multiply the OUTER node-axis factor, so each process's devices cover a
+    contiguous row range; pinned against ``devices_indices_map`` by test).
+    ``n`` must divide evenly (the same rigidity GSPMD imposes)."""
+    if n % nprocs:
+        raise ValueError(
+            f"n={n} must divide over {nprocs} processes (pad n or change the "
+            f"process count; GSPMD imposes the same divisibility on the mesh path)"
+        )
+    block = n // nprocs
+    if not 0 <= rank < nprocs:
+        raise ValueError(f"rank {rank} outside [0, {nprocs})")
+    return rank * block, (rank + 1) * block
+
+
+# -- placement: local blocks -> global sharded arrays -------------------------
+
+
+def shard_put(local_tree, mesh: Mesh, global_n: int, batch_axes: int = 0):
+    """Build GLOBAL sharded arrays from this process's LOCAL node-blocks.
+
+    ``local_tree`` holds, per leaf, ONLY the rows this process owns
+    (node-sharded leaves: the ``process_block`` slice; replicated /
+    rumor-sharded leaves: the full (small) array).  Each leaf is placed
+    via ``jax.make_array_from_single_device_arrays`` — every process
+    device_puts exactly its own shards, so no host ever materializes a
+    global plane.  ``global_n`` is the global node count (the local
+    block's node axis is ``global_n / process_count``).
+
+    Works single-process too (the virtual-mesh tests), where "local" is
+    simply "all".
+    """
+    specs = partition_spec(local_tree, batch_axes=batch_axes)
+    nprocs = jax.process_count()
+    lo, _hi = process_block(global_n, jax.process_index(), nprocs) if nprocs > 1 else (0, global_n)
+
+    def place(leaf, spec):
+        arr = np.asarray(leaf)
+        node_axis = _node_axis(spec)
+        sharding = NamedSharding(mesh, spec)
+        if node_axis is None:
+            # replicated or rumor-only sharded: every process holds the
+            # full (small) array; put each local device's shard
+            gshape = arr.shape
+            row_base = 0
+        else:
+            gshape = arr.shape[:node_axis] + (global_n,) + arr.shape[node_axis + 1 :]
+            row_base = lo
+        dmap = sharding.devices_indices_map(gshape)
+        pieces = []
+        for d in jax.local_devices():
+            idx = list(dmap[d])
+            if node_axis is not None:
+                s = idx[node_axis]
+                start = (0 if s.start is None else s.start) - row_base
+                stop = (gshape[node_axis] if s.stop is None else s.stop) - row_base
+                if start < 0 or stop > arr.shape[node_axis]:
+                    raise ValueError(
+                        "mesh places non-local rows on a local device — the "
+                        "mesh's node axis does not follow process_block order "
+                        "(build it with make_multihost_mesh)"
+                    )
+                idx[node_axis] = slice(start, stop)
+            pieces.append(jax.device_put(arr[tuple(idx)], d))
+        return jax.make_array_from_single_device_arrays(gshape, sharding, pieces)
+
+    return jax.tree.map(place, local_tree, specs)
+
+
+def _node_axis(spec: P) -> Optional[int]:
+    for i, ax in enumerate(spec):
+        if ax == "node" or (isinstance(ax, tuple) and "node" in ax):
+            return i
+    return None
+
+
+def host_gather(tree, batch_axes: int = 0):
+    """The inverse of :func:`shard_put`: per leaf, one contiguous host
+    array of the LOCALLY-ADDRESSABLE rows (node-sharded leaves: this
+    process's block; others: the full array).  At one process this is the
+    whole global array — the SNIPPETS [2][3] gather-fn analog.  Never
+    touches another process's shards."""
+    specs = partition_spec(tree, batch_axes=batch_axes)
+
+    def gather(leaf, spec):
+        if not isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        node_axis = _node_axis(spec)
+        shards = list(leaf.addressable_shards)
+        if node_axis is None:
+            return np.asarray(shards[0].data) if shards else np.asarray(leaf)
+        # order shards by their global row start; de-dup replicas (the
+        # rumor axis may replicate a row block across local devices)
+        by_start = {}
+        for sh in shards:
+            s = sh.index[node_axis]
+            start = 0 if s.start is None else s.start
+            cols = tuple(
+                (0 if c.start is None else c.start)
+                for i, c in enumerate(sh.index)
+                if i != node_axis
+            )
+            by_start.setdefault(start, {})[cols] = np.asarray(sh.data)
+        rows = []
+        for start in sorted(by_start):
+            pieces = by_start[start]
+            if len(pieces) == 1:
+                rows.append(next(iter(pieces.values())))
+            else:
+                # multiple column blocks (rumor-sharded): stitch along the
+                # non-node axes in column order
+                ordered = [pieces[c] for c in sorted(pieces)]
+                rows.append(np.concatenate(ordered, axis=-1))
+        return np.concatenate(rows, axis=node_axis) if len(rows) > 1 else rows[0]
+
+    return jax.tree.map(gather, tree, specs)
+
+
+# -- digest partials ----------------------------------------------------------
+
+
+def leaf_partial_sums(tree, lo: int = 0, include_replicated: bool = True):
+    """Per-leaf partial digest sums (uint32[L]) of a LOCAL block whose
+    node-sharded rows sit at global offset ``lo``.
+
+    ``telemetry.tree_digest`` is, per leaf, a wrapping-uint32 sum of
+    ``mix32(value ^ mix32(global_flat_index))`` — so partials over
+    disjoint row blocks ADD EXACTLY.  Node-sharded leaves contribute
+    their rows at global indices; replicated/rumor leaves contribute only
+    where ``include_replicated`` (rank 0), so summing every rank's vector
+    and applying the outer mix (:func:`combine_leaf_partials`) reproduces
+    the single-host ``tree_digest`` bit-for-bit.  The [L] layout follows
+    ``jax.tree.leaves`` order — identical on every rank by construction
+    (same pytree structure).
+    """
+    from ringpop_tpu.sim.telemetry import leaf_digest_sum
+
+    specs = jax.tree.leaves(
+        partition_spec(tree), is_leaf=lambda x: isinstance(x, P)
+    )
+    leaves = jax.tree.leaves(tree)
+    out = []
+    for leaf, spec in zip(leaves, specs):
+        sharded = _node_axis(spec) == 0
+        if not sharded and not include_replicated:
+            out.append(jnp.uint32(0))
+            continue
+        row_elems = int(math.prod(np.shape(leaf)[1:])) if np.ndim(leaf) else 0
+        offset = np.uint32((np.uint64(lo) * np.uint64(row_elems)) & np.uint64(0xFFFFFFFF)) if sharded else np.uint32(0)
+        out.append(leaf_digest_sum(leaf, offset=offset))
+    return jnp.stack(out)
+
+
+def combine_leaf_partials(partials: Sequence[np.ndarray]) -> int:
+    """Fold per-rank partial vectors (each uint32[L]) into the global
+    ``tree_digest`` value: per-leaf wrapping sum across ranks, then the
+    digest's outer per-leaf mix and accumulate.  Pure host numpy — the
+    combine runs after a fabric allgather of L words per rank."""
+    from ringpop_tpu.sim.packbits import mix32 as _mix32_dev  # noqa: F401 (doc pointer)
+
+    with np.errstate(over="ignore"):  # wrapping uint32 sums BY DESIGN
+        acc = np.uint32(0)
+        total = np.zeros_like(np.asarray(partials[0], np.uint32))
+        for p in partials:
+            total = (total + np.asarray(p, np.uint32)).astype(np.uint32)
+        for li, leaf_sum in enumerate(total):
+            acc = (
+                acc
+                + _np_mix32(
+                    np.uint32(leaf_sum) ^ np.uint32((li * 0x9E37_79B9) & 0xFFFFFFFF)
+                )
+            ).astype(np.uint32)
+    return int(acc)
+
+
+def _np_mix32(x: np.uint32) -> np.uint32:
+    """Host-numpy murmur3 fmix32 — the same constants as packbits.mix32
+    (digest combines run host-side after the fabric allgather)."""
+    with np.errstate(over="ignore"):
+        x = np.uint32(x)
+        x ^= x >> np.uint32(16)
+        x = np.uint32(x * np.uint32(0x85EB_CA6B))
+        x ^= x >> np.uint32(13)
+        x = np.uint32(x * np.uint32(0xC2B2_AE35))
+        x ^= x >> np.uint32(16)
+    return x

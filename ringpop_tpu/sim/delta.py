@@ -157,9 +157,19 @@ class DeltaFaults:
     reach: Optional[jax.Array] = None  # bool[G, G] directed group reachability
 
 
-jax.tree_util.register_pytree_node(
+# registered WITH keys so path-aware tree walks (the canonical partition
+# table in parallel/partition.py matches leaves by name) see field names
+# instead of flat indices; flatten order and aux are unchanged, so every
+# existing tree_map/vmap treatment is identical
+jax.tree_util.register_pytree_with_keys(
     DeltaFaults,
-    lambda f: ((f.up, f.group, f.drop_rate, f.drop_node, f.reach), None),
+    lambda f: (
+        tuple(
+            (jax.tree_util.GetAttrKey(n), getattr(f, n))
+            for n in ("up", "group", "drop_rate", "drop_node", "reach")
+        ),
+        None,
+    ),
     lambda aux, c: DeltaFaults(
         up=c[0], group=c[1], drop_rate=c[2], drop_node=c[3], reach=c[4]
     ),

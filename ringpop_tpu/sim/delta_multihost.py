@@ -1,0 +1,511 @@
+"""Process-spanning delta engine: the SAME tick at any process count.
+
+The delta step's data motion is mesh-shaped (PERF.md "Multi-host (DCN)
+design"): two cyclic row-window exchange legs plus two [W]-word row
+reduces per tick.  On a real pod the partitioner drives all of it from the
+one jitted ``delta.step`` over a ``make_multihost_mesh`` mesh.  This
+module runs the IDENTICAL arithmetic when cross-process XLA execution is
+unavailable (the multi-process CPU fabric): each process owns the
+contiguous node-block ``partition.process_block`` assigns it, steps it
+with shard-local jitted kernels, and bridges exactly the exchange legs and
+reduce words over ``parallel.fabric``.
+
+Bit-identity with the single-host ``delta.step`` is by construction, and
+certified end-to-end by the 1/2/4-process twins (``simbench
+multihost16m``, ``make multihost-smoke``):
+
+* every random quantity is the partition-invariant counter stream
+  (``sim/prng``): value = f(seed, tick, site, GLOBAL lane) — identical on
+  any rank layout, zero communication;
+* the exchange legs move the same rows the traced roll moves;
+* the row reduces are bitwise OR/AND — reassociation-exact, so
+  block-partial-then-combine equals the single-host halving tree;
+* state digests combine from per-rank partial sums at GLOBAL flat indices
+  (``partition.leaf_partial_sums``), so a multi-process digest IS the
+  single-host ``telemetry.tree_digest`` value.
+
+Scope: ``exchange="shift"`` + ``rng="counter"`` (the sharded-caller
+defaults), faults ``None`` or ``up``/scalar ``drop_rate`` (the
+convergence-certification models).  Anything else raises — the mesh path
+handles the full fault surface; this bridge certifies the DCN layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ringpop_tpu.parallel.fabric import Fabric, plan_window
+from ringpop_tpu.parallel.partition import (
+    combine_leaf_partials,
+    leaf_partial_sums,
+    process_block,
+)
+from ringpop_tpu.sim import prng as _prng
+from ringpop_tpu.sim.delta import (
+    DeltaFaults,
+    DeltaParams,
+    DeltaState,
+    clamped_max_p,
+)
+from ringpop_tpu.sim.packbits import (
+    and_reduce_rows,
+    n_words,
+    or_reduce_rows,
+    pack_bool,
+    row_mask,
+    unpack_bits,
+)
+
+# low-byte leg ids; the wire tag is ``tick << 8 | leg`` (mod 2^32, see
+# _tag) so a message from a diverged rank schedule fails the fabric's
+# tag check loudly instead of being consumed as a later tick's payload
+_TAG_LEG1 = 0x10
+_TAG_LEG2 = 0x20
+_TAG_REDUCE = 0x30
+_TAG_DIGEST = 0x40
+_TAG_COVER = 0x50
+
+
+def _tag(tick: int, leg: int) -> int:
+    return ((tick << 8) | leg) & 0xFFFFFFFF
+
+
+def _check_supported(params: DeltaParams, faults) -> None:
+    if params.exchange != "shift" or params.rng != "counter":
+        raise NotImplementedError(
+            "multihost delta bridge supports the sharded-caller defaults "
+            "only (exchange='shift', rng='counter')"
+        )
+    if faults is not None and (
+        getattr(faults, "group", None) is not None
+        or getattr(faults, "drop_node", None) is not None
+        or getattr(faults, "reach", None) is not None
+        or hasattr(faults, "at_tick")
+    ):
+        raise NotImplementedError(
+            "multihost delta bridge supports faults=None or up/drop_rate "
+            "legs; group/reach/drop_node/FaultPlan run on the mesh path"
+        )
+
+
+# -- shard-local kernels ------------------------------------------------------
+# Each is jitted once per (params, flags); ``lo`` rides as a traced scalar
+# so every rank shares one compilation of the same program.
+
+
+@functools.partial(jax.jit, static_argnames=("params", "block"))
+def _k_init(params: DeltaParams, lo, seed, *, block: int):
+    """Rows [lo, lo+block) of ``delta.init_state`` — elementwise equality
+    against the source row (rumor j seeds at node j mod n), bit-identical
+    to the scatter form (duplicate sources land identically)."""
+    n, k = params.n, params.k
+    g = lo + jnp.arange(block, dtype=jnp.int32)
+    src = (jnp.arange(k, dtype=jnp.int32) % n)[None, :]
+    learned_b = g[:, None] == src
+    pcount = jnp.zeros((block, k), jnp.int8)
+    return (
+        pack_bool(learned_b),
+        pcount,
+        pack_bool(pcount < jnp.int8(clamped_max_p(params))),
+        jax.random.PRNGKey(seed),
+    )
+
+
+def _conn_rows(params, cseed, ctick, g, s, up, has_up: bool, has_drop: bool, drop_rate):
+    """Connectivity verdict for the (g -> g+s) legs of GLOBAL rows ``g`` —
+    pure in (seed, tick, lane), so any rank can evaluate any row's verdict
+    without communication (the receiver recomputes the sender's coin)."""
+    n = params.n
+    conn = jnp.ones(g.shape, dtype=bool)
+    if has_up:
+        conn &= up[g] & up[(g + s) % n]
+    if has_drop:
+        u = _prng.draw_uniform(cseed, ctick, _prng.D_DROP, g)
+        conn &= u >= drop_rate
+    return conn
+
+
+@functools.partial(jax.jit, static_argnames=("params", "has_up", "has_drop"))
+def _k_sent(params, learned_l, ride_ok_l, key, tick, lo, up, drop_rate, *, has_up, has_drop):
+    """Kernel A: the request-leg plane this block contributes."""
+    b = learned_l.shape[0]
+    cseed = _prng.fold_key(key)
+    s = _prng.draw_randint(cseed, tick, _prng.D_SHIFT, 0, 1, params.n)
+    g = lo + jnp.arange(b, dtype=jnp.int32)
+    conn = _conn_rows(params, cseed, tick, g, s, up, has_up, has_drop, drop_rate)
+    riding = learned_l & ride_ok_l
+    sent = riding & row_mask(conn)
+    return sent, conn, riding, s
+
+
+@functools.partial(jax.jit, static_argnames=("params", "has_up", "has_drop"))
+def _k_merge(params, learned_l, ride_ok_l, inbound_l, key, tick, lo, s, up, drop_rate, *, has_up, has_drop):
+    """Kernel B: merge the request leg; derive the response-leg plane.
+    ``got_pinged`` is recomputed locally from the lane-pure connectivity
+    verdict of the SENDER rows (g - s) — no second window transfer."""
+    b = learned_l.shape[0]
+    cseed = _prng.fold_key(key)
+    g = lo + jnp.arange(b, dtype=jnp.int32)
+    src = (g - s) % params.n
+    got_pinged = _conn_rows(params, cseed, tick, src, s, up, has_up, has_drop, drop_rate)
+    learned1 = learned_l | inbound_l
+    answerable = learned1 & ride_ok_l
+    return learned1, answerable, got_pinged
+
+
+@functools.partial(jax.jit, static_argnames=("params", "has_up"))
+def _k_counters(params, learned_l, learned1_l, resp_src_l, conn_l, got_pinged_l, riding_l, pcount_l, up_l, *, has_up):
+    """Kernel C: response merge + piggyback counters + this block's
+    partial words of the two global row reduces."""
+    k = params.k
+    max_p = jnp.int8(clamped_max_p(params))
+    resp = resp_src_l & row_mask(conn_l)
+    learned2 = learned1_l | resp
+    riding_bit = unpack_bits(riding_l, k)
+    bump = riding_bit.astype(jnp.int8) * (
+        conn_l.astype(jnp.int8) + got_pinged_l.astype(jnp.int8)
+    )[:, None]
+    newly = unpack_bits(learned2 & ~learned_l, k)
+    pcount_mid = jnp.minimum(pcount_l + bump, max_p)
+    pcount_mid = jnp.where(newly, jnp.int8(0), pcount_mid)
+    mid_ride = pack_bool(pcount_mid < max_p)
+    if has_up:
+        dead_mask = row_mask(~up_l)
+        up_mask = row_mask(up_l)
+    else:
+        dead_mask = jnp.uint32(0)
+        up_mask = jnp.uint32(0xFFFFFFFF)
+    part_and = and_reduce_rows(learned2 | dead_mask)
+    part_or = or_reduce_rows(learned2 & up_mask & mid_ride)
+    return learned2, pcount_mid, mid_ride, part_and, part_or
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _k_finish(params, learned2_l, pcount_mid_l, mid_ride_l, fully_w, riding_any_w):
+    """Kernel D: apply the full-sync stuck-rumor reset with the GLOBAL
+    reduce words; report convergence (free — ``fully`` is the converged
+    plane's AND)."""
+    k = params.k
+    fully = unpack_bits(fully_w, k)
+    stuck = ~unpack_bits(riding_any_w, k) & ~fully
+    stuck_w = pack_bool(stuck)
+    reset = learned2_l & stuck_w[None, :]
+    pcount = jnp.where(unpack_bits(reset, k), jnp.int8(0), pcount_mid_l)
+    ride_ok = mid_ride_l | reset
+    return pcount, ride_ok, fully.all()
+
+
+@functools.partial(jax.jit, static_argnames=("g",))
+def _k_coverage_bits(learned_l, *, g: int):
+    """Exact learned-bit count of a block as ``g`` uint32 chunk sums
+    (r14 int32-headroom audit: a single flat popcount sum wraps at
+    N·K ≥ 2³² — each chunk here covers block/g rows × 32·W bits, kept
+    well inside uint32 by the caller's chunk choice; the host folds the
+    [g] vector in int64)."""
+    per_row = jax.lax.population_count(learned_l).sum(axis=1, dtype=jnp.uint32)
+    return per_row.reshape(g, -1).sum(axis=1, dtype=jnp.uint32)
+
+
+class MultihostDelta:
+    """One rank's half^P of a delta run over the host-bridged DCN fabric.
+
+    The same class runs single-process (``nprocs=1``, fabric legs become
+    local slices) — that degenerate instance is pinned bit-identical to
+    ``delta.step``, and the 2/4-process instances are pinned digest-equal
+    to IT, which closes the chain to the single-host engine.
+    """
+
+    def __init__(
+        self,
+        params: DeltaParams,
+        fabric: Fabric,
+        seed: int = 0,
+        faults: Optional[DeltaFaults] = None,
+    ):
+        _check_supported(params, faults)
+        self.params, self.fabric = params, fabric
+        self.rank, self.nprocs = fabric.rank, fabric.nprocs
+        self.lo, self.hi = process_block(params.n, self.rank, self.nprocs)
+        self.block = self.hi - self.lo
+        self.has_up = faults is not None and faults.up is not None
+        self.has_drop = faults is not None and faults.drop_rate is not None
+        # ``up`` is replicated per process (1 bit/node — 2 MB at 16M);
+        # the big O(N*K) planes are what sharding is for
+        self.up = (
+            jnp.asarray(faults.up, bool) if self.has_up else jnp.zeros((1,), bool)
+        )
+        self.up_l = self.up[self.lo : self.hi] if self.has_up else jnp.zeros((1,), bool)
+        self.drop_rate = (
+            jnp.float32(faults.drop_rate) if self.has_drop else jnp.float32(0)
+        )
+        learned, pcount, ride_ok, key = _k_init(
+            params, jnp.asarray(self.lo, jnp.int32), seed, block=self.block
+        )
+        self.learned, self.pcount, self.ride_ok, self.key = learned, pcount, ride_ok, key
+        self.tick = 0
+        self.converged = None  # unknown until a tick reports the AND plane
+        # coverage chunking: block/g rows per chunk, each chunk's bit count
+        # bounded by (block/g)·K — keep it under 2^26 bits per chunk
+        from ringpop_tpu.sim.packbits import block_count
+
+        g = 1
+        while (self.block // g) * params.k > (1 << 26) and g < self.block:
+            g *= 2
+        self._cover_g = block_count(self.block, g)
+
+    # -- the exchange legs ----------------------------------------------------
+
+    def _exchange_window(self, plane_dev, rel_shift: int, tag: int):
+        """All ranks exchange so each assembles its own window
+        ``[lo + rel_shift, lo + rel_shift + B) mod n`` of the globally
+        node-sharded ``plane``.  ``rel_shift`` is the same on every rank
+        (leg 1: -s; leg 2: +s), which makes the schedule deterministic."""
+        n, b = self.params.n, self.block
+        if self.nprocs == 1:
+            idx = (self.lo + rel_shift + np.arange(b)) % n
+            return jnp.asarray(np.asarray(plane_dev)[idx])
+        host_plane = np.asarray(plane_dev)
+        # build sends: for every other rank, the pieces of MY rows their
+        # window needs, concatenated in THEIR window order
+        sends: dict[int, list[np.ndarray]] = {}
+        for r in range(self.nprocs):
+            if r == self.rank:
+                continue
+            r_lo = process_block(n, r, self.nprocs)[0]
+            plan = plan_window((r_lo + rel_shift) % n, b, n, self.nprocs)
+            mine = [
+                host_plane[glo - self.lo : glo - self.lo + glen]
+                for owner, glo, glen, _ in plan
+                if owner == self.rank
+            ]
+            if mine:
+                sends[r] = [np.ascontiguousarray(np.concatenate(mine, axis=0))]
+        # my own assembly plan
+        my_plan = plan_window((self.lo + rel_shift) % n, b, n, self.nprocs)
+        recv_from = sorted({owner for owner, *_ in my_plan if owner != self.rank})
+        got = self.fabric.exchange(tag, sends, recv_from)
+        out = np.empty((b,) + host_plane.shape[1:], host_plane.dtype)
+        used: dict[int, int] = {r: 0 for r in recv_from}
+        for owner, glo, glen, woff in my_plan:
+            if owner == self.rank:
+                out[woff : woff + glen] = host_plane[glo - self.lo : glo - self.lo + glen]
+            else:
+                buf = got[owner][0]
+                off = used[owner]
+                out[woff : woff + glen] = buf[off : off + glen]
+                used[owner] = off + glen
+        return jnp.asarray(out)
+
+    # -- one protocol period --------------------------------------------------
+
+    def step(self) -> None:
+        p = self.params
+        t = jnp.asarray(self.tick, jnp.int32)
+        lo = jnp.asarray(self.lo, jnp.int32)
+        sent, conn, riding, s_dev = _k_sent(
+            p, self.learned, self.ride_ok, self.key, t, lo, self.up,
+            self.drop_rate, has_up=self.has_up, has_drop=self.has_drop,
+        )
+        s = int(s_dev)
+        inbound = self._exchange_window(sent, -s, _tag(self.tick, _TAG_LEG1))
+        learned1, answerable, got_pinged = _k_merge(
+            p, self.learned, self.ride_ok, inbound, self.key, t, lo, s_dev,
+            self.up, self.drop_rate, has_up=self.has_up, has_drop=self.has_drop,
+        )
+        resp_src = self._exchange_window(answerable, +s, _tag(self.tick, _TAG_LEG2))
+        learned2, pcount_mid, mid_ride, part_and, part_or = _k_counters(
+            p, self.learned, learned1, resp_src, conn, got_pinged, riding,
+            self.pcount, self.up_l, has_up=self.has_up,
+        )
+        if self.nprocs > 1:
+            partials = self.fabric.allgather(
+                _tag(self.tick, _TAG_REDUCE),
+                np.stack([np.asarray(part_and), np.asarray(part_or)]),
+            )
+            fully_w = functools.reduce(np.bitwise_and, [pp[0] for pp in partials])
+            riding_any_w = functools.reduce(np.bitwise_or, [pp[1] for pp in partials])
+            fully_w, riding_any_w = jnp.asarray(fully_w), jnp.asarray(riding_any_w)
+        else:
+            fully_w, riding_any_w = part_and, part_or
+        self.pcount, self.ride_ok, conv = _k_finish(
+            p, learned2, pcount_mid, mid_ride, fully_w, riding_any_w
+        )
+        self.learned = learned2
+        self.converged = bool(conv)
+        self.tick += 1
+
+    # -- certification surface ------------------------------------------------
+
+    def _as_block_state(self) -> DeltaState:
+        return DeltaState(
+            learned=self.learned,
+            pcount=self.pcount,
+            ride_ok=self.ride_ok,
+            tick=jnp.asarray(self.tick, jnp.int32),
+            key=self.key,
+        )
+
+    def state_digest(self) -> int:
+        """The GLOBAL ``telemetry.tree_digest`` of the full DeltaState —
+        per-rank partial leaf sums at global flat indices, one uint32[L]
+        allgather, host combine.  Equal to the single-host digest of the
+        same trajectory bit-for-bit."""
+        part = np.asarray(
+            leaf_partial_sums(
+                self._as_block_state(), lo=self.lo, include_replicated=self.rank == 0
+            )
+        )
+        parts = (
+            self.fabric.allgather(_tag(self.tick, _TAG_DIGEST), part)
+            if self.nprocs > 1
+            else [part]
+        )
+        return combine_leaf_partials(parts)
+
+    def coverage(self) -> float:
+        """Exact global learned-bit fraction over ALL rows (uint chunk
+        partials summed in int64 on host — deterministic at ANY process
+        count, unlike a float32 reduction whose value depends on the
+        reduction tree; NOTE ``delta.converged_fraction`` divides by LIVE
+        rows instead, so under an ``up`` mask the two gauges differ by
+        the dead-row denominator — the journal pairing compares digests,
+        not this gauge)."""
+        mine = np.asarray(_k_coverage_bits(self.learned, g=self._cover_g)).astype(np.int64).sum()
+        counts = (
+            [
+                int(c[0])
+                for c in self.fabric.allgather(
+                    _tag(self.tick, _TAG_COVER), np.asarray([mine])
+                )
+            ]
+            if self.nprocs > 1
+            else [int(mine)]
+        )
+        return float(sum(counts)) / float(self.params.n * self.params.k)
+
+    def journal_record(self) -> dict:
+        rec = {
+            "tick": self.tick,
+            "coverage": round(self.coverage(), 6),
+            "digest": self.state_digest(),
+            "process_count": self.nprocs,
+            "process_id": self.rank,
+            "fabric_bytes_sent": self.fabric.bytes_sent,
+            "fabric_bytes_recv": self.fabric.bytes_recv,
+        }
+        return rec
+
+    # -- block-sharded snapshot / restore -------------------------------------
+
+    def _snapshot_mesh(self):
+        """One node-axis mesh over every device in the job (rumor axis 1:
+        snapshot placement wants row-contiguous device blocks).  Built by
+        the same ``make_multihost_mesh`` the jitted-mesh path uses, so
+        process blocks land exactly where ``partition.process_block``
+        says."""
+        from ringpop_tpu.parallel.multihost import make_multihost_mesh
+
+        return make_multihost_mesh(rumor_shards=1)
+
+    def save_snapshot(self, path: str) -> None:
+        """Block-sharded orbax checkpoint: every process places its LOCAL
+        block as the global array's shards (``partition.shard_put`` — no
+        host materializes the global state) and writes only those shards
+        (OCDBT path).  Collective: every rank must call."""
+        import jax as _jax
+
+        from ringpop_tpu.parallel.partition import shard_put
+        from ringpop_tpu.sim.snapshot import save_state_orbax
+
+        if self.nprocs > 1 and _jax.process_count() != self.nprocs:
+            raise RuntimeError(
+                "block-sharded snapshots need the jax.distributed runtime "
+                f"up at the fabric's process count ({self.nprocs}); "
+                f"jax.process_count()={_jax.process_count()}"
+            )
+        state = shard_put(
+            jax.tree.map(np.asarray, self._as_block_state()),
+            self._snapshot_mesh(),
+            global_n=self.params.n,
+        )
+        save_state_orbax(path, state, wait=True)
+        self.fabric.barrier(f"snapshot-done-{self.tick}")
+
+    @classmethod
+    def restore_snapshot(
+        cls,
+        path: str,
+        params: DeltaParams,
+        fabric: Fabric,
+        faults: Optional[DeltaFaults] = None,
+    ) -> "MultihostDelta":
+        """Restore a block-sharded checkpoint onto THIS fabric's process
+        count — which need not match the count that saved it (the 2-proc
+        save → 4-proc restore certificate): the partition table names the
+        target layout, orbax re-chunks the reads, and each process gathers
+        back exactly its rows."""
+        import jax as _jax
+
+        from ringpop_tpu.parallel.partition import host_gather, named_shardings
+        from ringpop_tpu.sim.snapshot import load_state_orbax
+
+        if fabric.nprocs > 1 and _jax.process_count() != fabric.nprocs:
+            raise RuntimeError(
+                "block-sharded restore needs the jax.distributed runtime up "
+                f"at the fabric's process count ({fabric.nprocs}); "
+                f"jax.process_count()={_jax.process_count()}"
+            )
+        self = cls(params, fabric, seed=0, faults=faults)
+        n, k = params.n, params.k
+        w = n_words(k)
+        example = DeltaState(
+            learned=_jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            pcount=_jax.ShapeDtypeStruct((n, k), jnp.int8),
+            ride_ok=_jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            tick=_jax.ShapeDtypeStruct((), jnp.int32),
+            key=_jax.ShapeDtypeStruct((2,), jnp.uint32),
+        )
+        shardings = named_shardings(example, self._snapshot_mesh())
+        gstate = load_state_orbax(path, example, shardings=shardings)
+        local = host_gather(gstate)
+        self.learned = jnp.asarray(local.learned)
+        self.pcount = jnp.asarray(local.pcount)
+        self.ride_ok = jnp.asarray(local.ride_ok)
+        self.key = jnp.asarray(local.key)
+        self.tick = int(np.asarray(local.tick))
+        self.converged = None
+        self.fabric.barrier(f"restore-done-{self.tick}")
+        return self
+
+    def run_until_converged(self, max_ticks: int = 10_000, sink=None, journal_every: int = 0):
+        """Step until the global AND plane reports convergence (checked
+        every tick — the reduce words already cross the fabric, so the
+        check is free).  Returns (ticks_used, converged).
+
+        ``journal_every > 0`` builds a journal record every that-many
+        ticks plus one at exit.  Record building is COLLECTIVE (digest and
+        coverage allgather across the fabric), so every rank must pass the
+        same ``journal_every`` — ranks without a ``sink`` still take part
+        in the combine and simply drop the record."""
+        start = self.tick
+        emitted_at = None
+        while self.tick - start < max_ticks:
+            self.step()
+            done = bool(self.converged)
+            if journal_every and (((self.tick - start) % journal_every == 0) or done):
+                rec = self.journal_record()  # collective on every rank
+                emitted_at = self.tick
+                if sink is not None:
+                    sink(rec)
+            if done:
+                break
+        if journal_every and emitted_at != self.tick:
+            rec = self.journal_record()  # tail record, still collective
+            if sink is not None:
+                sink(rec)
+        return self.tick - start, bool(self.converged)

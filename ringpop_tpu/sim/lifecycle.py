@@ -1072,31 +1072,16 @@ def state_shardings(mesh, k: Optional[int] = None) -> LifecycleState:
     driver entry (``__graft_entry__``), the sharded-at-scale bench
     (``cli/simbench bench_sharded100k``), and the sharding tests — a
     layout change edits exactly this function."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ringpop_tpu.parallel.partition import named_shardings
 
     if k is not None:
         check_rumor_shardable(k, mesh.shape.get("rumor", 1))
 
-    def sh(spec):
-        return NamedSharding(mesh, spec)
-
-    return LifecycleState(
-        r_subject=sh(P("rumor")),
-        r_inc=sh(P("rumor")),
-        r_status=sh(P("rumor")),
-        r_deadline=sh(P("rumor")),
-        learned=sh(P("node", "rumor")),
-        pcount=sh(P("node", "rumor")),
-        ride_ok=sh(P("node", "rumor")),
-        base_status=sh(P("node")),
-        base_inc=sh(P("node")),
-        base_present=sh(P("node")),
-        base_pending=sh(P("node")),
-        base_deadline=sh(P("node")),
-        self_inc=sh(P("node")),
-        tick=sh(P()),
-        key=sh(P()),
-    )
+    # derived from the ONE canonical per-leaf rule table
+    # (parallel.partition.PARTITION_RULES) — this wrapper only fixes the
+    # pytree type and validates k against the mesh
+    skeleton = LifecycleState(**{f: 0 for f in LifecycleState._fields})
+    return named_shardings(skeleton, mesh)
 
 
 # -- membership operations ---------------------------------------------------

@@ -127,15 +127,17 @@ def fleet_state_shardings(mesh, k=None):
     sharding it would be trivial-parallel, not a partitioning exercise)
     and every underlying state axis keeps the canonical
     ``lifecycle.state_shardings`` layout.  Used by the sharded mc_chaos
-    ksweep section and the jaxlint fleet entry point."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    ksweep section and the jaxlint fleet entry point.  Derived from the
+    ONE canonical rule table (``parallel.partition``) with a one-deep
+    batch prefix."""
+    from ringpop_tpu.parallel.partition import named_shardings
+    from ringpop_tpu.sim.lifecycle import LifecycleState
+    from ringpop_tpu.sim.packbits import check_rumor_shardable
 
-    from ringpop_tpu.sim.lifecycle import state_shardings
-
-    return jax.tree.map(
-        lambda s: NamedSharding(mesh, P(None, *s.spec)),
-        state_shardings(mesh, k=k),
-    )
+    if k is not None:
+        check_rumor_shardable(k, mesh.shape.get("rumor", 1))
+    skeleton = LifecycleState(**{f: 0 for f in LifecycleState._fields})
+    return named_shardings(skeleton, mesh, batch_axes=1)
 
 
 def _index_faults(faults, b: int):

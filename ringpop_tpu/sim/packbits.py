@@ -54,6 +54,24 @@ def mix32(x: jax.Array) -> jax.Array:
     return x
 
 
+def flat_index_u32(row, ncols: int, col) -> jax.Array:
+    """Global flat index ``row * ncols + col`` in WRAPPING uint32
+    arithmetic — the blessed spelling for digest/mixing lanes, where the
+    value is consumed mod 2³² by design (``mix32`` eats the whole word).
+
+    A flat-plane index computed in int32 silently overflows once
+    N·K ≥ 2³¹ (16M × 256 ≈ 4.1e9 — inside the multi-host target scale);
+    jaxlint RPA106 flags raw ``row * K + col`` products of traced extents
+    so the overflow can't land unaudited.  Routes that genuinely need the
+    NUMERIC flat index past 2³¹ (none in the engines today) must
+    restructure to (row, col) pairs instead — there is no 64-bit integer
+    lane under the repo's x64-off discipline (RPA104)."""
+    return (
+        jnp.asarray(row).astype(jnp.uint32) * jnp.uint32(ncols & 0xFFFF_FFFF)
+        + jnp.asarray(col).astype(jnp.uint32)
+    )
+
+
 def pack_bool(x: jax.Array) -> jax.Array:
     """bool[..., K] -> uint32[..., W] (LSB-first within each word)."""
     k = x.shape[-1]

@@ -131,6 +131,32 @@ def load_state(path: str, cls: Type[T], params=None) -> T:
 # -- orbax backend (optional): async, non-blocking saves ---------------------
 
 
+def _orbax_mp_options() -> dict:
+    """Checkpointer kwargs that make orbax's save/restore barriers work on
+    EVERY jax.distributed fabric, not just ones whose backend can run
+    cross-process XLA programs.
+
+    Orbax's default multiprocess sync is ``multihost_utils
+    .sync_global_devices`` — an XLA psum, which this container's CPU
+    backend refuses ("Multiprocess computations aren't implemented").
+    Passing an explicit ``active_processes`` set routes every barrier
+    through the coordination-service client instead
+    (``client.wait_at_barrier`` — plain gRPC), which is also what a
+    real pod wants: checkpoint barriers should not occupy the accelerator
+    stream.  No-op single-process."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return {}
+    import orbax.checkpoint as ocp
+
+    return {
+        "multiprocessing_options": ocp.options.MultiprocessingOptions(
+            active_processes=set(range(jax.process_count()))
+        )
+    }
+
+
 def save_state_orbax(path: str, state, wait: bool = False, checkpointer=None):
     """Checkpoint via orbax's AsyncCheckpointer: the device→host transfer
     happens synchronously but serialization/IO proceed in a background
@@ -143,14 +169,22 @@ def save_state_orbax(path: str, state, wait: bool = False, checkpointer=None):
     the write completes and the checkpointer closes before returning
     (returns None); otherwise the returned checkpointer is the caller's to
     ``.wait_until_finished()`` and ``.close()``.  Construction never leaks
-    on failure.  ``path`` must be a directory path (orbax layout)."""
+    on failure.  ``path`` must be a directory path (orbax layout).
+
+    BLOCK-SHARDED (r14): state leaves may be process-spanning sharded
+    ``jax.Array``s — each process transfers and writes ONLY its
+    addressable shards (orbax OCDBT/tensorstore layout), so a 16M-node
+    state checkpoints without any host ever materializing a global plane;
+    barriers ride the coordination service (:func:`_orbax_mp_options`).
+    Restore with :func:`load_state_orbax` ``shardings=`` onto ANY process
+    count — the chunked store reads back under a different partition."""
     import os
 
     import orbax.checkpoint as ocp
 
     own = checkpointer is None
     ckptr = checkpointer if checkpointer is not None else ocp.AsyncCheckpointer(
-        ocp.StandardCheckpointHandler()
+        ocp.StandardCheckpointHandler(), **_orbax_mp_options()
     )
     try:
         ckptr.save(
@@ -170,12 +204,21 @@ def save_state_orbax(path: str, state, wait: bool = False, checkpointer=None):
     return ckptr
 
 
-def load_state_orbax(path: str, example: T) -> T:
+def load_state_orbax(path: str, example: T, shardings=None) -> T:
     """Restore a :func:`save_state_orbax` checkpoint into ``type(example)``,
-    using ``example`` (any state of the right shapes/dtypes, e.g. a fresh
-    ``init_state``) as the abstract restore target.  Validation is
-    structural: the stored tree must match the example's field names (orbax
-    raises) and each array's shape/dtype (checked explicitly below)."""
+    using ``example`` (any state of the right shapes/dtypes — arrays or
+    ``jax.ShapeDtypeStruct``s, e.g. a fresh ``init_state``) as the
+    abstract restore target.  Validation is structural: the stored tree
+    must match the example's field names (orbax raises) and each array's
+    shape/dtype (checked explicitly below).
+
+    ``shardings`` (optional): a matching pytree of ``NamedSharding`` —
+    each leaf restores as a sharded ``jax.Array`` with every process
+    reading ONLY its own shards from the chunked store.  Because the
+    target sharding is independent of the sharding at save time, this is
+    how a 2-process checkpoint restores onto 4 processes (and vice
+    versa): the partition table (``parallel.partition``) names the
+    layout, orbax re-chunks the reads."""
     import os
 
     import jax
@@ -183,11 +226,14 @@ def load_state_orbax(path: str, example: T) -> T:
     import orbax.checkpoint as ocp
 
     cls = type(example)
+    sh = dict(zip(example._fields, shardings)) if shardings is not None else {}
     target = {
-        f: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+        f: jax.ShapeDtypeStruct(np.shape(v), v.dtype, sharding=sh.get(f))
         for f, v in zip(example._fields, example)
     }
-    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+    with ocp.Checkpointer(
+        ocp.StandardCheckpointHandler(), **_orbax_mp_options()
+    ) as ckptr:
         data = ckptr.restore(os.path.abspath(path), args=ocp.args.StandardRestore(target))
     # NOT dead code: this orbax version's StandardRestore was observed to
     # restore a checkpoint whose shapes differ from the target without
@@ -201,8 +247,16 @@ def load_state_orbax(path: str, example: T) -> T:
                 f"expected {want.shape}/{want.dtype} — wrong engine config?"
             )
     # orbax restores sharding-less targets as np.ndarray; convert so the
-    # result behaves like every other state (e.g. .at[] updates)
-    return cls(**{f: jnp.asarray(v) for f, v in data.items()})
+    # result behaves like every other state (e.g. .at[] updates).  Sharded
+    # restores already ARE jax.Arrays — converting one would gather a
+    # process-spanning plane onto every host, exactly what the sharded
+    # path exists to avoid.
+    return cls(
+        **{
+            f: (v if isinstance(v, jax.Array) else jnp.asarray(v))
+            for f, v in data.items()
+        }
+    )
 
 
 # -- host-plane membership export/import -------------------------------------

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ringpop_tpu.sim.delta import DeltaFaults, converged_fraction, resolve_faults
-from ringpop_tpu.sim.packbits import mix32, n_words
+from ringpop_tpu.sim.packbits import flat_index_u32, mix32, n_words
 from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -213,15 +213,25 @@ def fetch(
     f32 = jnp.float32
     record = {
         "ticks": tel.ticks,
-        "ping_send": tel.pings.sum(dtype=jnp.int32),
-        "ping_req_send": tel.ping_reqs.sum(dtype=jnp.int32),
-        "ping_timeout": tel.probes_failed.sum(dtype=jnp.int32),
-        "refuted": tel.incarnation_bumps.sum(dtype=jnp.int32),
+        # float32 sums for every N·T-scaling reduce (r14 int32-headroom
+        # audit): a per-node counter holds up to T per block, so its sum
+        # over N reaches N·T — 4.1e9 > 2³¹−1 at 16M nodes × 256-tick
+        # blocks, where an int32 sum wraps silently.  Counts, not
+        # invariants (exact to 2^24, ~1e-7 relative beyond — same
+        # tradeoff the packed-plane sums below always made).
+        "ping_send": tel.pings.sum(dtype=f32),
+        "ping_req_send": tel.ping_reqs.sum(dtype=f32),
+        "ping_timeout": tel.probes_failed.sum(dtype=f32),
+        "refuted": tel.incarnation_bumps.sum(dtype=f32),
         # float32 sums: counts, not invariants (see module docstring)
         "rumors_piggybacked": tel.piggybacked.sum(dtype=f32),
         "rumors_expired": tel.expired.sum(dtype=f32),
-        "timer_fired": tel.timer_fires.sum(dtype=jnp.int32)
-        + tel.base_timer_fires.sum(dtype=jnp.int32),
+        # timer_fires is [K] (sum ≤ K·T, int32-safe); base_timer_fires is
+        # [N] — the N·T term that forces the float32 promotion
+        "timer_fired": tel.timer_fires.sum(dtype=f32)
+        + tel.base_timer_fires.sum(dtype=f32),
+        # [M] placement vectors: sums ≤ M·T (M = alloc budget ≤ 64) —
+        # int32-safe at any committed scale
         "decl_alive": tel.decl_alive.sum(dtype=jnp.int32),
         "decl_suspect": tel.decl_suspect.sum(dtype=jnp.int32),
         "decl_faulty": tel.decl_faulty.sum(dtype=jnp.int32),
@@ -263,21 +273,54 @@ def split_batched(record: dict, extra: Optional[dict] = None) -> list[dict]:
 _mix32 = mix32
 
 
+def leaf_digest_sum(leaf, offset=np.uint32(0)) -> jax.Array:
+    """uint32 scalar: one leaf's inner digest sum — wrapping-uint32 sum of
+    ``mix32(value ^ mix32(global_flat_index))`` over every element, where
+    the flat index starts at ``offset``.
+
+    Two properties the multi-host certificates lean on:
+
+    * **int32/iota headroom** (the r14 audit): the index lanes are built
+      2-D via ``packbits.flat_index_u32`` (wrapping ``row·rowlen + col``),
+      never as a flat 1-D iota — the old ``arange(N·K)`` form needed a
+      > 2³¹-element iota at 16M × 256.  Values are bit-identical at every
+      scale where the old form was well-defined (the product wraps mod
+      2³² exactly like a uint32 arange would).
+    * **block partiality**: because the combine is a wrapping SUM, the sum
+      over a node-block at its global ``offset`` is an exact partial of
+      the full-plane sum — ``parallel.partition.leaf_partial_sums`` is
+      built on this.
+    """
+    v = jnp.asarray(leaf)
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.uint32)
+    if v.ndim <= 1:
+        flat = v.reshape(-1).astype(jnp.uint32)
+        idx = jnp.uint32(offset) + jnp.arange(flat.shape[0], dtype=jnp.uint32)
+        return _mix32(flat ^ _mix32(idx)).sum(dtype=jnp.uint32)
+    rows, rowlen = v.shape[0], int(np.prod(v.shape[1:], dtype=np.int64))
+    m = v.reshape(rows, rowlen).astype(jnp.uint32)
+    idx = jnp.uint32(offset) + flat_index_u32(
+        jnp.arange(rows, dtype=jnp.uint32)[:, None],
+        rowlen,
+        jnp.arange(rowlen, dtype=jnp.uint32)[None, :],
+    )
+    return _mix32(m ^ _mix32(idx)).sum(dtype=jnp.uint32)
+
+
 def tree_digest(tree) -> jax.Array:
     """uint32 scalar, on-device: a position-sensitive digest of every leaf
     of an integer/bool pytree (both sim engines' states qualify).  Two
     states digest equal iff every leaf is bit-equal (up to hash
     collision) — the cheap pairing check the run journal carries so a
     telemetry-on run can be certified against its telemetry-off twin
-    without shipping full planes to the host."""
+    without shipping full planes to the host.  Built on
+    :func:`leaf_digest_sum`, whose wrapping-sum partiality is also what
+    lets ``parallel.partition`` certify multi-process runs leaf-sum by
+    leaf-sum."""
     acc = jnp.uint32(0)
     for li, leaf in enumerate(jax.tree.leaves(tree)):
-        v = jnp.asarray(leaf)
-        if v.dtype == jnp.bool_:
-            v = v.astype(jnp.uint32)
-        flat = v.reshape(-1).astype(jnp.uint32)
-        idx = jnp.arange(flat.shape[0], dtype=jnp.uint32)
-        leaf_sum = _mix32(flat ^ _mix32(idx)).sum(dtype=jnp.uint32)
+        leaf_sum = leaf_digest_sum(leaf)
         acc = acc + _mix32(leaf_sum ^ jnp.uint32((li * 0x9E37_79B9) & 0xFFFF_FFFF))
     return acc
 
@@ -363,6 +406,14 @@ class TelemetryJournal:
         # (e.g. simbench step1m).
         from ringpop_tpu.util.accel import cache_status
 
+        # process_count/process_id (r14): a journal names which rank of
+        # which job size wrote it — 1/1 single-controller, else the
+        # jax.distributed coordinates.  Multi-process runs produce one
+        # journal PER RANK; the pairing tools group them by these keys.
+        try:
+            pc, pid = jax.process_count(), jax.process_index()
+        except Exception:  # backend not initialized yet — header still valid
+            pc, pid = 1, 0
         self._write(
             {
                 "kind": "header",
@@ -372,6 +423,8 @@ class TelemetryJournal:
                 "toolchain": toolchain_fingerprint(),
                 "mesh_budget": mesh_budget_fingerprint(),
                 "compile_cache": cache_status(),
+                "process_count": pc,
+                "process_id": pid,
             }
         )
 

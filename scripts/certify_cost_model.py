@@ -198,6 +198,35 @@ def main() -> int:
         )
     elif "error" in se:
         verdicts.append(("sharded exchange legs", None, se["error"]))
+    # r14 multihost_tick: the process-spanning mesh step.  The DCN legs
+    # are slice-edge ppermutes — latency, not volume — so the per-tick
+    # median must stay inside the (generous) 4x sharded-tick bracket, and
+    # the MEASURED per-chip collective volume (compiled-HLO census, same
+    # parser as the budget ratchet) must fit the committed 42.5
+    # MB/chip/tick budget — a multi-host lowering that added traffic
+    # classes shows up as census bytes and refutes.
+    mh = cap.get("multihost_tick") or {}
+    if mh.get("ms_per_tick_median") is not None:
+        ms = mh["ms_per_tick_median"]
+        lo, hi = MULTICHIP_SHARDED_MS_PER_TICK
+        hi_dcn = hi * 4.0  # DCN latency allowance over the ICI bracket
+        census_mb = mh.get("census_mb_per_chip_tick")
+        budget_ok = census_mb is not None and census_mb <= 42.5 + 1e-6
+        verdicts.append(
+            (
+                f"multihost tick ({mh.get('process_count')} processes, "
+                f"{mh.get('n_devices')} chips)",
+                (lo <= ms <= hi_dcn) and budget_ok,
+                f"{ms} ms/tick vs DCN bracket [{lo}, {hi_dcn}]; censused "
+                f"{census_mb} MB/chip/tick "
+                f"({'<=' if budget_ok else 'EXCEEDS or missing'} the "
+                f"42.5 MB/chip/tick budget"
+                + (f"; census_error: {mh['census_error']}" if "census_error" in mh else "")
+                + ")",
+            )
+        )
+    elif "error" in mh:
+        verdicts.append(("multihost tick", None, mh["error"]))
     # the r11 pipelined-exchange A/B: census-identical traffic, so the
     # pipelined legs must be bit-equal and no slower than sequential —
     # faster is the overlap window actually cashing out on real ICI

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Tiny multi-process launcher for the DCN-fabric certification runs.
+
+Forks N CPU worker processes wired into one ``jax.distributed`` job — the
+standard env contract (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+/ ``JAX_PROCESS_ID``) exported per rank, a free localhost port for the
+coordinator, ``JAX_PLATFORMS=cpu`` pinned, and one JSONL journal per rank
+(``MULTIHOST_JSONL``) collected after exit.  Reused by ``simbench
+multihost16m``, ``make multihost-smoke`` and the test suite — one spawn
+path, so every certificate runs through the same bring-up the launcher
+documentation shows a real pod operator.
+
+Importable: :func:`launch`.  CLI::
+
+    python scripts/multihost_launch.py --nprocs 2 -- \
+        -m ringpop_tpu.cli.multihost_bench twin --n 4096 --k 64 --ticks 24
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(
+    nprocs: int,
+    argv: Sequence[str],
+    devices_per_proc: int = 1,
+    timeout_s: float = 900.0,
+    env_extra: Optional[dict] = None,
+) -> list[dict]:
+    """Run ``python <argv>`` as ``nprocs`` coordinated ranks; return one
+    record per rank: ``{"rank", "rc", "records" (parsed JSONL),
+    "stdout", "stderr"}``.  Raises on nonzero exit so a dead worker can't
+    read as an empty-but-green run."""
+    port = _free_port()
+    tmp = tempfile.mkdtemp(prefix="multihost_")
+    procs, logs = [], []
+    for rank in range(nprocs):
+        jsonl = os.path.join(tmp, f"rank{rank}.jsonl")
+        logs.append(jsonl)
+        env = dict(os.environ)
+        env.pop("BENCH_PIN", None)
+        env.update(
+            {
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": str(nprocs),
+                "JAX_PROCESS_ID": str(rank),
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    env.get("XLA_FLAGS", "").replace(
+                        "--xla_force_host_platform_device_count=8", ""
+                    )
+                    + f" --xla_force_host_platform_device_count={devices_per_proc}"
+                ).strip(),
+                "MULTIHOST_JSONL": jsonl,
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        env.update(env_extra or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, *argv],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=REPO,
+            )
+        )
+    out = []
+    failure = None
+    for rank, p in enumerate(procs):
+        try:
+            stdout, stderr = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            stdout, stderr = p.communicate()
+            failure = failure or f"rank {rank} timed out after {timeout_s}s"
+        records = []
+        if os.path.exists(logs[rank]):
+            with open(logs[rank]) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        records.append(json.loads(line))
+        out.append(
+            {
+                "rank": rank,
+                "rc": p.returncode,
+                "records": records,
+                "stdout": stdout,
+                "stderr": stderr,
+            }
+        )
+        if p.returncode != 0 and failure is None:
+            failure = (
+                f"rank {rank} rc={p.returncode}\nstdout: {stdout[-800:]}\n"
+                f"stderr: {stderr[-2000:]}"
+            )
+    if failure:
+        raise RuntimeError(f"multihost launch failed: {failure}")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nprocs", type=int, default=2)
+    p.add_argument("--devices-per-proc", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker argv after '--' (passed to python)")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        p.error("worker command required after --")
+    ranks = launch(args.nprocs, cmd, devices_per_proc=args.devices_per_proc,
+                   timeout_s=args.timeout)
+    for r in ranks:
+        for rec in r["records"]:
+            print(json.dumps({"rank": r["rank"], **rec}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Multi-host DCN-fabric CI gate (< 60 s, 2-core container).
+
+Certifies, at small n through the REAL ``jax.distributed`` bring-up
+(``scripts/multihost_launch.py`` forks coordinated OS processes):
+
+1. **Sharded == unsharded digests** — the same seeded delta scenario
+   (victims + loss) stepped at 1 and 2 processes must produce the same
+   global state digest, and that digest must equal the in-process
+   ``delta.step`` engine's ``telemetry.tree_digest`` (the single-host
+   anchor of the chain).
+2. **Cross-process-count snapshot round-trip** — a 2-process block-sharded
+   orbax save restored at 1 process continues digest-equal to an unbroken
+   reference run.
+
+The heavier 4-process twin and the 4-way restore live in the slow-marked
+``tests/test_multihost.py``; the artifact-scale run is ``simbench
+multihost16m``.  Exit 0 = certified; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _SCRIPTS)  # multihost_launch
+sys.path.insert(0, os.path.dirname(_SCRIPTS))  # ringpop_tpu package root
+
+T0 = time.perf_counter()
+N, K, SEED, TICKS, EXTRA = 2048, 64, 11, 12, 6
+VICTIMS, DROP = 16, 0.05
+
+
+def main() -> int:
+    from multihost_launch import launch
+
+    base = ["-m", "ringpop_tpu.cli.multihost_bench"]
+    common = [
+        "--n", str(N), "--k", str(K), "--seed", str(SEED),
+        "--victims", str(VICTIMS), "--drop", str(DROP),
+    ]
+
+    # -- leg 1: 1-proc vs 2-proc twin ----------------------------------------
+    digests = {}
+    for nprocs in (1, 2):
+        ranks = launch(nprocs, base + ["twin", *common, "--ticks", str(TICKS)],
+                       timeout_s=240)
+        recs = [r["records"][-1] for r in ranks]
+        ds = {r["digest"] for r in recs}
+        assert len(ds) == 1, f"ranks disagree at P={nprocs}: {ds}"
+        digests[nprocs] = ds.pop()
+    assert digests[1] == digests[2], f"P=1 vs P=2 digest mismatch: {digests}"
+
+    # the single-host engine anchor (in this process, plain jit)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    params = DeltaParams(n=N, k=K, rng="counter")
+    rng = np.random.default_rng(SEED + 999)
+    up = np.ones(N, bool)
+    up[rng.choice(N, size=VICTIMS, replace=False)] = False
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(DROP))
+    st = init_state(params, seed=SEED)
+    stp = jax.jit(functools.partial(step, params))
+    for _ in range(TICKS):
+        st = stp(st, faults)
+    anchor = int(tree_digest(st))
+    assert anchor == digests[1], (
+        f"fabric digest {digests[1]} != engine digest {anchor}"
+    )
+    print(f"twin OK: P=1 == P=2 == engine digest {anchor}")
+
+    # -- leg 2: 2-proc save -> 1-proc restore -> digest-equal continue -------
+    ckpt = tempfile.mkdtemp(prefix="mh_smoke_ckpt_")
+    shutil.rmtree(ckpt)  # orbax wants to create it
+    try:
+        ranks = launch(
+            2, base + ["snapshot-save", *common, "--ticks", str(TICKS), "--path", ckpt],
+            timeout_s=240,
+        )
+        saved = ranks[0]["records"][-1]
+        assert saved["digest"] == anchor, "digest at save != engine digest"
+        ranks = launch(
+            1,
+            base + ["snapshot-restore", *common, "--extra-ticks", str(EXTRA), "--path", ckpt],
+            timeout_s=240,
+        )
+        rest = ranks[0]["records"][-1]
+        assert rest["digest_at_restore"] == anchor, "restore broke the state"
+        for _ in range(EXTRA):
+            st = stp(st, faults)
+        ref = int(tree_digest(st))
+        assert rest["digest"] == ref, (
+            f"continued run diverged: {rest['digest']} != unbroken {ref}"
+        )
+        print(f"snapshot OK: 2-proc save -> 1-proc restore -> +{EXTRA} ticks == unbroken {ref}")
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+    wall = time.perf_counter() - T0
+    print(f"multihost-smoke PASS in {wall:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"multihost-smoke FAIL: {e}", file=sys.stderr)
+        sys.exit(1)

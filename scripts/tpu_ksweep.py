@@ -229,6 +229,108 @@ def main() -> None:
             out["sharded_tick"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         flush()
 
+        # -- 1b2: multihost_tick (r14) — the SAME jitted delta step over the
+        # process-spanning mesh (init_distributed + make_multihost_mesh +
+        # the canonical partition table), measured per tick.  Only a real
+        # multi-process job prices the DCN legs — a single-process run of
+        # this section measures ICI again, so it records the reason and
+        # moves on.  certify_cost_model judges ms/tick against the
+        # sharded-tick bracket (DCN adds slice-edge latency, not volume:
+        # the exchange's crossing sends are the only DCN class) and the
+        # CENSUSED per-chip MB/tick of the compiled program against the
+        # committed 42.5 MB/chip/tick budget.
+        try:
+            import jax as _jx
+
+            if _jx.process_count() > 1:
+                import functools as _ft
+
+                from ringpop_tpu.parallel.mesh import with_exchange_mesh
+                from ringpop_tpu.parallel.multihost import make_multihost_mesh
+                from ringpop_tpu.parallel.partition import named_shardings
+                from ringpop_tpu.sim.delta import DeltaParams as _DP
+                from ringpop_tpu.sim.delta import init_state as _dinit
+                from ringpop_tpu.sim.delta import step as _dstep
+
+                k = 64
+                mh_mesh = make_multihost_mesh()
+                mh_params = with_exchange_mesh(
+                    _DP(n=n, k=k, rng="counter"), mh_mesh
+                )
+                sh = named_shardings(_dinit(_DP(n=8, k=k), seed=0), mh_mesh)
+                mstate = jax.jit(
+                    lambda: _dinit(mh_params, seed=0), out_shardings=sh
+                )()
+                mstep = jax.jit(
+                    _ft.partial(_dstep, mh_params), in_shardings=(sh, None),
+                    out_shardings=sh,
+                )
+                t0 = time.perf_counter()
+                mstate = mstep(mstate, DeltaFaults())
+                jax.block_until_ready(mstate.learned)
+                compile_s = time.perf_counter() - t0
+                per_rep = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    for _t in range(block):
+                        mstate = mstep(mstate, DeltaFaults())
+                    jax.block_until_ready(mstate.learned)
+                    per_rep.append(time.perf_counter() - t0)
+                chips_per_host = len(jax.local_devices())
+                # MEASURED per-tick collective volume: census the COMPILED
+                # program's collective ops (the same parser the budget
+                # ratchet uses) — this is what certify_cost_model judges
+                # against the 42.5 MB/chip budget, so a multi-host
+                # lowering that added traffic classes shows up as bytes,
+                # not as a derived constant agreeing with itself.
+                census_row = {}
+                try:
+                    from ringpop_tpu.analysis.hlo_census import summarize
+                    from ringpop_tpu.analysis.trace_checks import census_of_text
+
+                    compiled = jax.jit(
+                        _ft.partial(_dstep, mh_params),
+                        in_shardings=(sh, None), out_shardings=sh,
+                    ).lower(mstate, DeltaFaults()).compile()
+                    by_kind = summarize(census_of_text(compiled.as_text()))
+                    total_mb = sum(v["bytes"] for v in by_kind.values()) / 1e6
+                    census_row = {
+                        "census_mb_per_tick_total": round(total_mb, 2),
+                        "census_mb_per_chip_tick": round(
+                            total_mb / max(len(jax.devices()), 1), 2
+                        ),
+                        "census_by_kind": {
+                            k: {"count": v["count"], "mb": round(v["bytes"] / 1e6, 2)}
+                            for k, v in by_kind.items()
+                        },
+                    }
+                except Exception as ce:
+                    census_row = {"census_error": f"{type(ce).__name__}: {ce}"[:200]}
+                out["multihost_tick"] = {
+                    **census_row,
+                    "n": n,
+                    "k": k,
+                    "process_count": _jx.process_count(),
+                    "n_devices": len(jax.devices()),
+                    "chips_per_host": chips_per_host,
+                    "mesh": "x".join(map(str, mh_mesh.devices.shape))
+                    + " (node x rumor, DCN on node)",
+                    "compile_plus_first_tick_s": round(compile_s, 3),
+                    "block_ticks": block,
+                    "block_s_reps": [round(r, 4) for r in per_rep],
+                    "ms_per_tick_median": round(
+                        sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+                    ),
+                }
+            else:
+                out["multihost_tick"] = {
+                    "error": "single-process job: DCN legs not exercised "
+                    "(launch via scripts/multihost_launch.py on a pod slice)"
+                }
+        except Exception as e:  # pragma: no cover - hardware-dependent
+            out["multihost_tick"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        flush()
+
         # -- 1c: the r8 exchange-leg A/B — shard_map crossing-block ppermutes
         # vs the partitioner-inferred roll gathers, same counter RNG on both
         # sides so ONLY the exchange lowering differs.  The r8 budget says

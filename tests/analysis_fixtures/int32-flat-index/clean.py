@@ -1,0 +1,30 @@
+"""RPA106 clean: the blessed flat-index spellings.
+
+Digest/mixing lanes that are consumed mod 2**32 route through
+``packbits.flat_index_u32`` (explicit WRAPPING uint32 — no bare product
+in sight); anything needing the numeric index keeps (row, col) pairs; a
+deliberate in-range product states its dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ringpop_tpu.sim.packbits import flat_index_u32
+
+
+@jax.jit
+def digest_lanes(p):
+    n, w = p.shape
+    rows = jnp.arange(n, dtype=jnp.uint32)
+    cols = jnp.arange(w, dtype=jnp.uint32)
+    # wrapping-uint32 helper: the mod-2**32 lane form, stated explicitly
+    return flat_index_u32(rows[:, None], w, cols[None, :])
+
+
+@jax.jit
+def row_col_pairs(p):
+    n, w = p.shape
+    # no flat index at all: 2-D indexing keeps every coordinate < 2**31
+    rows = jnp.arange(n, dtype=jnp.int32)
+    cols = jnp.arange(w, dtype=jnp.int32)
+    return p[rows[:, None], cols[None, :]]

@@ -1,0 +1,24 @@
+"""RPA106 trip: flat-index products that silently wrap in int32.
+
+``rows * w + col`` with default-dtype operands lands in int32 under
+disabled x64 — at N·K >= 2**31 (16M x 256) the product wraps and the
+"flat index" addresses the wrong element with no error anywhere.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def flat_offsets(p):
+    n, w = p.shape
+    rows = jnp.arange(n)
+    # RPA106: arange-derived index vector x array extent, no widening
+    return rows * w + 3
+
+
+@jax.jit
+def flat_iota(p):
+    n, w = p.shape
+    # RPA106: an iota SIZED by a product of two extents (int32 values wrap)
+    return jnp.arange(n * w)

@@ -1,16 +1,33 @@
-"""Worker process for the multi-host mesh test (see test_multihost.py).
+"""Worker process for the multi-host tests (see test_multihost.py).
 
-One rank of a 2-process jax.distributed job: 4 virtual CPU devices per
-process form a global 8-device ("node" x "rumor") mesh; runs one sharded
-delta step and one sharded lifecycle step over cross-process (gloo)
-collectives.  Argv: <process_id> <coordinator_port>.
+One rank of an N-process ``jax.distributed`` job, exercising the r14
+multi-host layer end to end:
+
+1. bring-up — ``init_distributed`` from the standard env contract, global
+   device enumeration, ``make_multihost_mesh`` granule layout (the rumor
+   axis must not cross processes);
+2. placement — ``partition.shard_put`` builds the global DeltaState from
+   this rank's LOCAL block (no host materializes the global state) and
+   ``host_gather`` reads back exactly the local rows, round-trip exact;
+3. the process-spanning step — ``MultihostDelta`` over the host-bridged
+   DCN fabric, whose global state digest must equal the digest the
+   single-host engine produces for the same seeded scenario (the value is
+   handed in by the test via env so the worker cannot re-derive it from
+   the code under test);
+4. block-sharded snapshot — save at this process count, restore, digest
+   unchanged.
+
+Argv: ``<ticks>``.  Env: the ``JAX_*`` distributed contract (set by the
+test), ``MULTIHOST_EXPECT_DIGEST`` (optional engine anchor).
 """
 
-import functools
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# TWO virtual devices per process (asserted below as 2 * nprocs global):
+# the granule checks need a >1-device rumor row inside each process, and
+# shard_put must split a process block across its local devices
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
@@ -19,82 +36,73 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:
-    pid, port = int(sys.argv[1]), sys.argv[2]
-    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-    os.environ["JAX_NUM_PROCESSES"] = "2"
-    os.environ["JAX_PROCESS_ID"] = str(pid)
-
+    ticks = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     from ringpop_tpu.parallel.multihost import init_distributed, make_multihost_mesh
 
-    assert init_distributed(), "coordinator env vars set above"
-    assert len(jax.devices()) == 8, jax.devices()
+    assert init_distributed(), "distributed env vars not set?"
+    nprocs = jax.process_count()
+    rank = jax.process_index()
+    assert len(jax.devices()) == 2 * nprocs, jax.devices()
 
     mesh = make_multihost_mesh()
-    assert mesh.shape == {"node": 4, "rumor": 2}, mesh.shape
-    # the rumor axis must not cross DCN: both devices in each rumor row
-    # belong to one process
+    # the rumor axis must never cross a process (DCN granule rule)
     for row in mesh.devices:
         assert len({d.process_index for d in row}) == 1, "rumor axis crossed hosts"
 
-    from ringpop_tpu.parallel.mesh import delta_shardings
-    from ringpop_tpu.sim.delta import DeltaParams, init_state, step
-
-    # k=64 -> the packed learned plane is uint32[N, 2] words: one word per
-    # rumor-axis shard
-    params = DeltaParams(n=64, k=64)
-    sh = delta_shardings(mesh)
-    state = jax.jit(lambda: init_state(params, seed=0), out_shardings=sh)()
-    out = jax.jit(functools.partial(step, params), in_shardings=(sh,), out_shardings=sh)(state)
-    jax.block_until_ready(out)
-    assert int(out.tick) == 1
-    # dissemination progressed globally (the exchange crossed processes);
-    # popcount, not sum — the packed words are not a bit count
-    def bits(s):
-        return int(jax.lax.population_count(s.learned).sum())
-
-    assert bits(out) > bits(state)
-
-    # the FLAGSHIP engine over the same cross-process mesh: a sharded
-    # lifecycle state and the headline detect path (blocks + on-device
-    # predicate + early exit) — the exact program the driver bench runs,
-    # with its collectives crossing the process boundary.  Fault masks and
-    # subjects are baked in as traced constants (host-local committed
-    # arrays are not addressable across a multi-process mesh).
     import numpy as np
     import jax.numpy as jnp
 
-    from ringpop_tpu.sim import lifecycle
-    from ringpop_tpu.sim.delta import DeltaFaults
+    from ringpop_tpu.parallel.fabric import DistributedKV, Fabric
+    from ringpop_tpu.parallel.partition import host_gather, process_block, shard_put
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
 
-    lp = lifecycle.LifecycleParams(n=64, k=64, suspect_ticks=4)
-    lsh = lifecycle.state_shardings(mesh, k=lp.k)
-    lstate = jax.jit(lambda: lifecycle.init_state(lp, seed=0), out_shardings=lsh)()
-    up = np.ones(lp.n, bool)
-    up[lp.n // 2] = False
+    n, k = 256, 64
+    params = DeltaParams(n=n, k=k, rng="counter")
 
-    @jax.jit
-    def detect(s):
-        return lifecycle._run_until_detected_device(
-            lp,
-            s,
-            DeltaFaults(up=jnp.asarray(up)),
-            jnp.asarray([lp.n // 2], jnp.int32),
-            min_status=lifecycle.FAULTY,
-            block_ticks=4,
-            max_blocks=jnp.int32(16),
-        )
+    # -- placement round-trip: local block -> global sharded -> local ----
+    lo, hi = process_block(n, rank, nprocs)
+    rng = np.random.default_rng(1234)  # same on every rank
+    full_learned = rng.integers(0, 2**32, (n, 2), dtype=np.uint32)
+    from ringpop_tpu.sim.delta import DeltaState
 
-    lout, blocks, done = detect(lstate)
-    jax.block_until_ready(lout.learned)
-    # the point is the PRODUCT outcome over the cross-process mesh: the
-    # victim must actually be detected faulty by every live observer, via
-    # the on-device predicate, with the early exit stopping short of the
-    # 16-block budget
-    assert bool(done), "victim not detected over the multi-host mesh"
-    assert int(lout.tick) == int(blocks) * 4
-    assert 1 <= int(blocks) < 16, int(blocks)
+    local = DeltaState(
+        learned=full_learned[lo:hi],
+        pcount=rng.integers(0, 100, (n, k)).astype(np.int8)[lo:hi],
+        ride_ok=rng.integers(0, 2**32, (n, 2), dtype=np.uint32)[lo:hi],
+        tick=np.int32(5),
+        key=np.zeros(2, np.uint32),
+    )
+    gmesh = make_multihost_mesh(rumor_shards=1)
+    gstate = shard_put(local, gmesh, global_n=n)
+    assert gstate.learned.shape == (n, 2), gstate.learned.shape
+    back = host_gather(gstate)
+    for a, b in zip(jax.tree.leaves(local), jax.tree.leaves(back)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), "round-trip diverged"
 
-    print(f"rank {pid} OK", flush=True)
+    # -- the process-spanning step + digest anchor -----------------------
+    up = np.ones(n, bool)
+    up[::16] = False
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(0.05))
+    fabric = Fabric(rank, nprocs, DistributedKV(), namespace="mh-test")
+    mh = MultihostDelta(params, fabric, seed=9, faults=faults)
+    for _ in range(ticks):
+        mh.step()
+    digest = mh.state_digest()
+    expect = os.environ.get("MULTIHOST_EXPECT_DIGEST")
+    if expect:
+        assert digest == int(expect), f"digest {digest} != engine anchor {expect}"
+
+    # -- block-sharded snapshot at THIS process count --------------------
+    path = os.environ.get("MULTIHOST_CKPT")
+    if path:
+        mh.save_snapshot(path)
+        mh2 = MultihostDelta.restore_snapshot(path, params, fabric, faults=faults)
+        assert mh2.tick == mh.tick
+        assert mh2.state_digest() == digest, "snapshot round-trip changed the state"
+
+    fabric.close()
+    print(f"rank {rank}/{nprocs} OK digest={digest}", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,180 @@
+"""The r14 int32-headroom audit: every promoted/restructured form is
+exercised at (or provably equivalent to) the N·K ≥ 2³¹ boundary.
+
+The repo runs x64-disabled (RPA104), so there is no 64-bit traced-integer
+escape hatch — the audit's fixes are structural: digest index lanes moved
+to explicit wrapping-uint32 row/col form (``packbits.flat_index_u32``),
+N·T-scaling telemetry reduces promoted to float32, coverage popcounts
+chunked under uint32 with an int64 host fold.  Tier-1 proves the promoted
+forms with a forced index-offset shim (small arrays whose GLOBAL offsets
+sit just above 2³¹ and across the 2³² wrap); the slow-marked direct unit
+digests a real > 2³¹-element plane.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import telemetry
+from ringpop_tpu.sim.packbits import flat_index_u32, mix32
+
+
+def _old_leaf_sum(leaf, offset=0):
+    """The pre-r14 flat-arange digest formula, in numpy uint64-mod-2^32
+    arithmetic — the value contract the restructured form must keep."""
+    v = np.asarray(leaf)
+    if v.dtype == bool:
+        v = v.astype(np.uint32)
+    flat = v.reshape(-1).astype(np.uint64) & 0xFFFFFFFF
+    idx = (np.uint64(offset) + np.arange(flat.size, dtype=np.uint64)) & np.uint64(
+        0xFFFFFFFF
+    )
+
+    def np_mix(x):
+        x = x.astype(np.uint32)
+        with np.errstate(over="ignore"):
+            x ^= x >> np.uint32(16)
+            x = (x * np.uint32(0x85EB_CA6B)).astype(np.uint32)
+            x ^= x >> np.uint32(13)
+            x = (x * np.uint32(0xC2B2_AE35)).astype(np.uint32)
+            x ^= x >> np.uint32(16)
+        return x
+
+    with np.errstate(over="ignore"):
+        mixed = np_mix(flat.astype(np.uint32) ^ np_mix(idx.astype(np.uint32)))
+        return int(mixed.astype(np.uint64).sum() & np.uint64(0xFFFFFFFF))
+
+
+@pytest.mark.parametrize(
+    "shape,dtype",
+    [
+        ((17, 5), np.int32),
+        ((8, 3, 2), np.uint32),
+        ((13,), np.int8),
+        ((), np.int32),
+        ((6, 32), bool),
+    ],
+)
+def test_leaf_digest_sum_matches_flat_formula(shape, dtype):
+    rng = np.random.default_rng(0)
+    if dtype is bool:
+        leaf = rng.random(shape) > 0.5
+    else:
+        leaf = rng.integers(0, np.iinfo(dtype).max, shape, dtype=dtype)
+    assert int(telemetry.leaf_digest_sum(leaf)) == _old_leaf_sum(leaf)
+
+
+@pytest.mark.parametrize(
+    "offset",
+    [
+        0,
+        2**31 - 8,  # lanes cross the int32 sign boundary
+        2**31 + 3,  # entirely above int32
+        2**32 - 8,  # lanes WRAP mod 2^32 mid-leaf
+    ],
+)
+def test_leaf_digest_sum_offset_shim(offset):
+    """The forced index-offset shim: a small leaf whose GLOBAL flat
+    indices sit at the hazardous boundaries must digest exactly as the
+    uint64-mod-2^32 reference — i.e. the promoted row/col form computes
+    the same lanes an (impossible) overflow-free flat iota would."""
+    rng = np.random.default_rng(1)
+    leaf = rng.integers(0, 2**32, (4, 8), dtype=np.uint32)
+    got = int(telemetry.leaf_digest_sum(leaf, offset=np.uint32(offset & 0xFFFFFFFF)))
+    assert got == _old_leaf_sum(leaf, offset=offset)
+
+
+def test_flat_index_u32_wraps_exactly():
+    rows = jnp.asarray([0, 1, 2**20, 2**24 - 1], jnp.uint32)
+    ncols = 256
+    cols = jnp.asarray([0, 255, 7, 255], jnp.uint32)
+    got = np.asarray(flat_index_u32(rows, ncols, cols)).astype(np.uint64)
+    want = (
+        np.asarray(rows).astype(np.uint64) * ncols + np.asarray(cols).astype(np.uint64)
+    ) & np.uint64(0xFFFFFFFF)
+    assert np.array_equal(got, want)
+    # 2^24 * 256 == 2^32: the product wraps to exactly 0 — stated, not UB
+    assert int(flat_index_u32(jnp.uint32(1 << 24), 256, jnp.uint32(0))) == 0
+
+
+def test_digest_partials_compose_across_wrap_boundary():
+    """Two blocks whose flat-index ranges straddle 2^32 still compose to
+    the whole-plane digest — the multi-process digest certificate keeps
+    working at 16M x 256 (where the SECOND half of the plane lives past
+    the uint32 wrap)."""
+    rng = np.random.default_rng(2)
+    plane = rng.integers(0, 2**32, (8, 16), dtype=np.uint32)
+    # pretend the plane's rows start at global row 2^28-2 of a K=16 plane:
+    # flat offsets cross 2^32 inside block 2
+    base_row = (1 << 28) - 2
+    whole = _old_leaf_sum(plane, offset=(base_row * 16) & 0xFFFFFFFF)
+
+    def part(rows, row0):
+        return int(
+            telemetry.leaf_digest_sum(
+                rows, offset=np.uint32(((base_row + row0) * 16) & 0xFFFFFFFF)
+            )
+        )
+
+    combined = (part(plane[:4], 0) + part(plane[4:], 4)) & 0xFFFFFFFF
+    assert combined == whole
+
+
+def test_fetch_counter_sums_survive_int32_overflow():
+    """The N·T-scaling telemetry reduces: per-node int32 counters whose
+    SUM exceeds 2³¹ must fetch as the (float32) count, not an int32 wrap
+    to negative."""
+    from ringpop_tpu.sim.delta import DeltaFaults
+    from ringpop_tpu.sim.lifecycle import LifecycleParams, init_state
+
+    params = LifecycleParams(n=8, k=32)
+    tel = telemetry.zeros(params)
+    big = np.full(8, 2**29, np.int32)  # sums to 2^32 > int32 max
+    tel = tel._replace(
+        pings=jnp.asarray(big),
+        ping_reqs=jnp.asarray(big),
+        probes_failed=jnp.asarray(big),
+        incarnation_bumps=jnp.asarray(big),
+        base_timer_fires=jnp.asarray(big),
+    )
+    rec, _ = telemetry.fetch(tel, init_state(params, seed=0), DeltaFaults())
+    for key in ("ping_send", "ping_req_send", "ping_timeout", "refuted", "timer_fired"):
+        v = float(rec[key])
+        assert v == pytest.approx(2**32, rel=1e-6), (key, v)
+        assert v > 0, f"{key} wrapped negative"
+
+
+def test_coverage_chunks_stay_in_uint32():
+    from ringpop_tpu.sim.delta_multihost import _k_coverage_bits
+
+    plane = jnp.asarray(
+        np.random.default_rng(3).integers(0, 2**32, (64, 4), dtype=np.uint32)
+    )
+    direct = int(
+        np.asarray(jax.lax.population_count(plane)).astype(np.int64).sum()
+    )
+    for g in (1, 4, 16, 64):
+        chunks = np.asarray(_k_coverage_bits(plane, g=g)).astype(np.int64)
+        assert chunks.shape == (g,)
+        assert int(chunks.sum()) == direct
+
+
+@pytest.mark.slow
+def test_direct_digest_above_2_31_elements():
+    """The direct unit at N·K just above 2³¹: a real > 2³¹-element int8
+    plane digests without a flat iota (the old form would need a
+    2.1-billion-element arange) and bit-equal to the block-composed
+    partials — exercising the promoted product where it actually
+    overflows int32."""
+    n, k = 2**16 + 8, 2**15  # (65544 * 32768) = 2^31 + 2^18 elements
+    leaf = jnp.zeros((n, k), jnp.int8)  # content-free: the INDEX lanes are the test
+    whole = int(telemetry.leaf_digest_sum(leaf))
+    half = n // 2
+    a = int(telemetry.leaf_digest_sum(leaf[:half], offset=np.uint32(0)))
+    b = int(
+        telemetry.leaf_digest_sum(
+            leaf[half:], offset=np.uint32((half * k) & 0xFFFFFFFF)
+        )
+    )
+    assert (a + b) & 0xFFFFFFFF == whole

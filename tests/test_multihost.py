@@ -1,37 +1,58 @@
-"""Multi-host mesh path: the same jitted sim step over a mesh spanning OS
-processes, with real cross-process collectives.
+"""Multi-host scale-out (r14): partition/gather placement, the
+process-spanning fabric step, and block-sharded snapshots — certified at
+1/2/4 REAL OS processes through the actual ``jax.distributed`` bring-up.
 
-The reference's multi-machine story is N TChannel processes over TCP
-(SURVEY §2.8, ``test/run-integration-tests``); the sim plane's is one
-global mesh over ``jax.distributed``.  A real pod isn't available here, so
-the strongest honest proof is two actual OS processes, each owning 4
-virtual CPU devices, joined through the distributed runtime — the exact
-code path (init_distributed → make_multihost_mesh → sharded step) a
-multi-host TPU job runs, with the collectives crossing a process boundary
-for real (gloo instead of DCN).
+This container's CPU backend cannot EXECUTE cross-process XLA programs
+("Multiprocess computations aren't implemented"), so the certificates run
+the host-bridged DCN fabric (``parallel/fabric`` +
+``sim/delta_multihost``): shard-local jitted kernels, exchange windows
+over TCP, reduce words allgathered — bit-identical to the single-host
+``delta.step`` by construction and pinned so here.  The placement tier
+(``partition.shard_put``/``host_gather``) and block-sharded orbax
+checkpoints run for real across processes either way (no cross-process
+computation involved).
+
+Fast tier-1 tests drive the SAME fabric code in-process (LocalKV +
+threads); the OS-process twins are slow-marked.
 """
 
+import functools
 import os
 import socket
 import subprocess
 import sys
+import threading
 
 import jax
+import numpy as np
 import pytest
 
 from ringpop_tpu.parallel.multihost import make_multihost_mesh
 
 WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
-# the two-process bring-up path (init_distributed in each worker) probes
-# jax.distributed.is_initialized, which this container's jax 0.4.37
-# lacks — the workers would die with AttributeError before any collective
-# runs, so the test can only certify anything on a newer jax.  Skip with
-# the reason instead of failing pre-existing (ISSUE 7 satellite).
+# version guard (kept per ISSUE 9): some jax builds can neither report
+# distributed state (no jax.distributed.is_initialized) nor expose the
+# internal global-state fallback — there the bring-up path cannot run at
+# all and the process-spanning tests skip with the reason.  This
+# container's 0.4.37 lacks is_initialized but HAS the fallback, so the
+# tests run (the r12-era skip was about the hard is_initialized call the
+# old init_distributed made; distributed_initialized removed it).
+def _bringup_available() -> bool:
+    if hasattr(jax.distributed, "is_initialized"):
+        return True
+    try:
+        from jax._src import distributed  # noqa: F401
+
+        return hasattr(distributed, "global_state")
+    except Exception:
+        return False
+
+
 requires_distributed_api = pytest.mark.skipif(
-    not hasattr(jax.distributed, "is_initialized"),
-    reason="jax.distributed.is_initialized unavailable (jax "
-    f"{jax.__version__} < 0.5): multihost bring-up cannot initialize",
+    not _bringup_available(),
+    reason="jax.distributed state is unqueryable on this jax build "
+    f"({jax.__version__}): no is_initialized and no global_state fallback",
 )
 
 
@@ -49,26 +70,177 @@ def test_single_host_mesh_shape():
     assert mesh.axis_names == ("node", "rumor")
 
 
-@pytest.mark.slow
-@requires_distributed_api
-def test_two_process_mesh_runs_sharded_step():
-    port = _free_port()
-    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(WORKER)))
-    env.pop("JAX_PLATFORMS", None)  # worker pins its own
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, str(rank), str(port)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
+# -- fast in-process fabric twins (tier-1) ------------------------------------
+
+
+def _engine_digest(params, faults, seed, ticks):
+    import jax.numpy as jnp  # noqa: F401
+
+    from ringpop_tpu.sim.delta import init_state, step
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    st = init_state(params, seed=seed)
+    stp = jax.jit(functools.partial(step, params))
+    for _ in range(ticks):
+        st = stp(st, faults)
+    return int(tree_digest(st)), st
+
+
+def _fabric_digests(params, faults, seed, ticks, nprocs, ns):
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    kv = LocalKV()
+    out = [None] * nprocs
+    errs = []
+
+    def run(rank):
+        try:
+            with Fabric(rank, nprocs, kv, namespace=ns) as fab:
+                mh = MultihostDelta(params, fab, seed=seed, faults=faults)
+                for _ in range(ticks):
+                    mh.step()
+                out[rank] = (mh.state_digest(), mh.coverage(), mh.converged)
+        except BaseException as e:  # surfaced below
+            errs.append(e)
+
+    # daemon: a rank wedged in a socket read must fail the assertion,
+    # not block interpreter shutdown afterwards
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(nprocs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    if errs:
+        raise errs[0]
+    assert all(o is not None for o in out), "a rank hung"
+    return out
+
+
+@pytest.mark.parametrize("nprocs", [1, 2, 4])
+def test_fabric_step_bit_identical_to_engine(nprocs):
+    """The process-spanning step at P processes == delta.step, digest-
+    exact, under the full supported fault surface (victims + loss)."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+
+    params = DeltaParams(n=128, k=64, rng="counter")
+    up = np.ones(128, bool)
+    up[::9] = False
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(0.1))
+    ref, _ = _engine_digest(params, faults, seed=4, ticks=10)
+    out = _fabric_digests(params, faults, 4, 10, nprocs, f"tw{nprocs}")
+    assert {o[0] for o in out} == {ref}
+    # coverage is the exact popcount fraction — identical on every rank
+    assert len({o[1] for o in out}) == 1
+
+
+def test_fabric_convergence_matches_engine():
+    """run_until_converged through the fabric stops at the same tick with
+    the same final digest as the engine's run_until_converged."""
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, run_until_converged
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    params = DeltaParams(n=128, k=64, rng="counter")
+    st = init_state(params, seed=2)
+    # engine checks every tick too (check_every=1) so tick counts compare
+    st, ticks, ok = run_until_converged(params, st, DeltaFaults(), max_ticks=512, check_every=1)
+    assert ok
+    ref = int(tree_digest(st))
+
+    kv = LocalKV()
+    out = [None, None]
+
+    def run(rank):
+        with Fabric(rank, 2, kv, namespace="conv") as fab:
+            mh = MultihostDelta(params, fab, seed=2)
+            t, c = mh.run_until_converged(max_ticks=512)
+            out[rank] = (t, c, mh.state_digest())
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    assert out[0] == out[1] and out[0] is not None
+    assert out[0][0] == ticks and out[0][1] and out[0][2] == ref
+
+
+def test_fabric_rejects_unsupported_faults():
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=64, k=64, rng="counter")
+    fab = Fabric(0, 1, LocalKV())
+    with pytest.raises(NotImplementedError):
+        MultihostDelta(
+            params, fab, faults=DeltaFaults(group=np.zeros(64, np.int32))
         )
-        for rank in range(2)
-    ]
+    with pytest.raises(NotImplementedError):
+        MultihostDelta(
+            params,
+            Fabric(0, 1, LocalKV()),
+            faults=DeltaFaults(drop_node=np.zeros(64, np.float32)),
+        )
+    # threefry params: the counter stream is what makes ranks agree
+    with pytest.raises(NotImplementedError):
+        MultihostDelta(DeltaParams(n=64, k=64), Fabric(0, 1, LocalKV()))
+
+
+def test_plan_window_covers_and_orders():
+    from ringpop_tpu.parallel.fabric import plan_window, window_pieces
+
+    n, nprocs = 96, 4
+    b = n // nprocs
+    for start in (0, 1, 23, 24, 95, 71):
+        pieces = window_pieces(start, b, n)
+        assert sum(l for _, l in pieces) == b
+        plan = plan_window(start, b, n, nprocs)
+        # the plan tiles the window exactly: offsets 0..b-1 each covered once
+        covered = sorted(
+            (woff + i, (glo + i) % n)
+            for _, glo, glen, woff in plan
+            for i in range(glen)
+        )
+        assert [c[0] for c in covered] == list(range(b))
+        # and each window slot maps to the right global row
+        for woff, grow in covered:
+            assert grow == (start + woff) % n
+
+
+# -- OS-process twins (slow) --------------------------------------------------
+
+
+def _run_workers(nprocs: int, ticks: int, env_extra=None):
+    port = _free_port()
+    procs = []
+    for rank in range(nprocs):
+        env = dict(
+            os.environ,
+            PYTHONPATH=os.path.dirname(os.path.dirname(WORKER)),
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES=str(nprocs),
+            JAX_PROCESS_ID=str(rank),
+        )
+        env.pop("JAX_PLATFORMS", None)  # worker pins its own
+        env.update(env_extra or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER, str(ticks)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=180)
+            out, err = p.communicate(timeout=300)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -77,3 +249,89 @@ def test_two_process_mesh_runs_sharded_step():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
         assert "OK" in out
+    return outs
+
+
+def _worker_anchor(ticks: int) -> int:
+    """The engine digest for the worker's fixed scenario (n=256, k=64,
+    seed 9, every-16th node down, 5% loss) — computed here so the worker
+    is checked against an INDEPENDENT run of the reference engine."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+
+    up = np.ones(256, bool)
+    up[::16] = False
+    params = DeltaParams(n=256, k=64, rng="counter")
+    d, _ = _engine_digest(
+        params, DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(0.05)), 9, ticks
+    )
+    return d
+
+
+@pytest.mark.slow
+@requires_distributed_api
+def test_two_process_partition_fabric_snapshot(tmp_path):
+    anchor = _worker_anchor(8)
+    _run_workers(
+        2,
+        8,
+        env_extra={
+            "MULTIHOST_EXPECT_DIGEST": str(anchor),
+            "MULTIHOST_CKPT": str(tmp_path / "ckpt2"),
+        },
+    )
+
+
+@pytest.mark.slow
+@requires_distributed_api
+def test_four_process_partition_fabric_snapshot(tmp_path):
+    anchor = _worker_anchor(8)
+    _run_workers(
+        4,
+        8,
+        env_extra={
+            "MULTIHOST_EXPECT_DIGEST": str(anchor),
+            "MULTIHOST_CKPT": str(tmp_path / "ckpt4"),
+        },
+    )
+
+
+@pytest.mark.slow
+@requires_distributed_api
+def test_cross_process_count_snapshot_restore(tmp_path):
+    """2-process save -> 4-process restore -> continue, digest-equal to an
+    unbroken engine run (the acceptance-criteria certificate, at test
+    scale; simbench multihost16m records it at artifact scale)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(WORKER)), "scripts"))
+    from multihost_launch import launch
+
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+
+    n, k, seed, t1, t2 = 512, 64, 21, 10, 6
+    ckpt = str(tmp_path / "xckpt")
+    base = ["-m", "ringpop_tpu.cli.multihost_bench"]
+    common = ["--n", str(n), "--k", str(k), "--seed", str(seed), "--victims", "8"]
+    ranks = launch(
+        2, base + ["snapshot-save", *common, "--ticks", str(t1), "--path", ckpt],
+        timeout_s=240,
+    )
+    saved = ranks[0]["records"][-1]
+    ranks = launch(
+        4,
+        base + ["snapshot-restore", *common, "--extra-ticks", str(t2), "--path", ckpt],
+        timeout_s=240,
+    )
+    rest = [r["records"][-1] for r in ranks]
+    assert len({r["digest"] for r in rest}) == 1
+    assert rest[0]["digest_at_restore"] == saved["digest"]
+
+    # unbroken reference
+    params = DeltaParams(n=n, k=k, rng="counter")
+    rng = np.random.default_rng(seed + 999)
+    up = np.ones(n, bool)
+    up[rng.choice(n, size=8, replace=False)] = False
+    ref, _ = _engine_digest(params, DeltaFaults(up=jnp.asarray(up)), seed, t1 + t2)
+    assert rest[0]["digest"] == ref

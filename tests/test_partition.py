@@ -1,0 +1,156 @@
+"""The canonical per-leaf partition table (parallel/partition, r14).
+
+Pins: (1) the legacy per-engine sharding helpers DERIVE from the one rule
+table (bit-for-bit the shardings they always produced); (2) the
+node-block ownership rule matches where the multihost meshes actually
+place rows; (3) shard_put/host_gather round-trip exactly; (4) the digest
+partial sums compose to ``telemetry.tree_digest`` at any block split —
+the property every multi-process certificate rides on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ringpop_tpu.parallel import partition
+from ringpop_tpu.parallel.mesh import delta_shardings, make_mesh
+from ringpop_tpu.parallel.multihost import make_multihost_mesh
+from ringpop_tpu.sim import lifecycle, telemetry
+from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, DeltaState, init_state
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def test_delta_shardings_derive_from_table(mesh):
+    ds = delta_shardings(mesh)
+    want = dict(
+        learned=P("node", "rumor"), pcount=P("node", "rumor"),
+        ride_ok=P("node", "rumor"), tick=P(), key=P(),
+    )
+    for f, spec in want.items():
+        assert getattr(ds, f) == NamedSharding(mesh, spec), f
+
+
+def test_lifecycle_shardings_derive_from_table(mesh):
+    ls = lifecycle.state_shardings(mesh, k=64)
+    want = dict(
+        r_subject=P("rumor"), r_inc=P("rumor"), r_status=P("rumor"),
+        r_deadline=P("rumor"), learned=P("node", "rumor"),
+        pcount=P("node", "rumor"), ride_ok=P("node", "rumor"),
+        base_status=P("node"), base_inc=P("node"), base_present=P("node"),
+        base_pending=P("node"), base_deadline=P("node"), self_inc=P("node"),
+        tick=P(), key=P(),
+    )
+    for f, spec in want.items():
+        assert getattr(ls, f) == NamedSharding(mesh, spec), f
+
+
+def test_fleet_shardings_prepend_batch_axis(mesh):
+    from ringpop_tpu.sim.montecarlo import fleet_state_shardings
+
+    fs = fleet_state_shardings(mesh, k=64)
+    ls = lifecycle.state_shardings(mesh, k=64)
+    for f in lifecycle.LifecycleState._fields:
+        assert getattr(fs, f) == NamedSharding(
+            mesh, P(None, *getattr(ls, f).spec)
+        ), f
+
+
+def test_fault_and_plan_and_telemetry_leaves_match_table():
+    from ringpop_tpu.sim import chaos
+
+    f = DeltaFaults(
+        up=np.ones(8, bool), group=np.zeros(8, np.int32),
+        drop_rate=np.float32(0.1), drop_node=np.zeros(8, np.float32),
+        reach=np.ones((2, 2), bool),
+    )
+    sp = partition.partition_spec(f)
+    assert sp.up == P("node") and sp.group == P("node") and sp.drop_node == P("node")
+    assert sp.drop_rate == P() and sp.reach == P()  # tiny / scalar: replicated
+
+    plan = chaos.FaultPlan(
+        base_up=np.ones(8, bool), crash_tick=np.zeros(8, np.int32),
+        flap_period=np.zeros(8, np.int32), part_from=np.int32(0),
+    )
+    ps = partition.partition_spec(plan)
+    assert ps.base_up == P("node") and ps.crash_tick == P("node")
+    assert ps.flap_period == P("node") and ps.part_from == P()
+
+    tel = telemetry.zeros(lifecycle.LifecycleParams(n=64, k=64))
+    ts = partition.partition_spec(tel)
+    assert ts.pings == P("node") and ts.piggybacked == P("node", "rumor")
+    assert ts.timer_fires == P("rumor") and ts.base_timer_fires == P("node")
+    assert ts.decl_alive == P() and ts.heal_attempts == P() and ts.ticks == P()
+
+
+def test_process_block_matches_mesh_placement():
+    """The contiguous-equal-block ownership rule == where a
+    make_multihost_mesh node axis actually places rows (single-process
+    here, so every device belongs to rank 0 — the per-device row ranges
+    must tile process_block(n, 0, 1) in device order, and the block
+    arithmetic must agree with devices_indices_map splits)."""
+    mesh = make_multihost_mesh(rumor_shards=1)
+    n = 64
+    sh = NamedSharding(mesh, P("node"))
+    dmap = sh.devices_indices_map((n,))
+    starts = sorted(
+        (0 if s[0].start is None else s[0].start) for s in dmap.values()
+    )
+    node_shards = mesh.shape["node"]
+    assert starts == [i * (n // node_shards) for i in range(node_shards)]
+    # the process-level rule is the same split at process granularity
+    assert partition.process_block(n, 0, 1) == (0, n)
+    assert partition.process_block(n, 1, 4) == (16, 32)
+    with pytest.raises(ValueError):
+        partition.process_block(10, 0, 4)  # divisibility is the contract
+
+
+def test_shard_put_host_gather_round_trip():
+    params = DeltaParams(n=64, k=64, rng="counter")
+    state = init_state(params, seed=3)
+    host = jax.tree.map(np.asarray, state)
+    mesh = make_multihost_mesh()  # 4x2 over the virtual 8 devices
+    g = partition.shard_put(host, mesh, global_n=params.n)
+    assert g.learned.sharding == NamedSharding(mesh, P("node", "rumor"))
+    assert g.tick.sharding.is_fully_replicated
+    back = partition.host_gather(g)
+    for f, a, b in zip(state._fields, jax.tree.leaves(host), jax.tree.leaves(back)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f
+    # the placed state is USABLE: one sharded step runs on it
+    from ringpop_tpu.parallel.mesh import sharded_delta_step
+
+    out = sharded_delta_step(params, mesh)(g)
+    assert int(out.tick) == int(state.tick) + 1
+
+
+@pytest.mark.parametrize("nblocks", [2, 4])
+def test_leaf_partials_compose_to_tree_digest(nblocks):
+    params = DeltaParams(n=64, k=64, rng="counter")
+    state = init_state(params, seed=7)
+    full = int(telemetry.tree_digest(state))
+    b = params.n // nblocks
+    parts = []
+    for r in range(nblocks):
+        lo = r * b
+        blk = state._replace(
+            learned=state.learned[lo : lo + b],
+            pcount=state.pcount[lo : lo + b],
+            ride_ok=state.ride_ok[lo : lo + b],
+        )
+        parts.append(
+            np.asarray(
+                partition.leaf_partial_sums(blk, lo=lo, include_replicated=r == 0)
+            )
+        )
+    assert partition.combine_leaf_partials(parts) == full
+
+
+def test_unknown_leaf_replicates():
+    # a leaf no rule names must land replicated, not crash or mis-shard
+    tree = {"brand_new_gauge": np.zeros((4, 4), np.int32)}
+    assert partition.partition_spec(tree)["brand_new_gauge"] == P()
