@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke multihost-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke multihost-smoke dcn-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke multihost-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke multihost-smoke dcn-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -58,6 +58,14 @@ serve-smoke:
 # restore at 1 process and continue digest-equal to an unbroken run.
 multihost-smoke:
 	$(PY) scripts/multihost_smoke.py
+
+# DCN wire-codec gate (r15): tiny 2-rank codec A/B over the fabric —
+# codec-on digests == codec-off == engine, wire bytes strictly lower on
+# every dissemination tick, the measured RAW fallback exercised, and
+# exchange-leg device→host transfer pinned under the pre-r15
+# full-plane-per-leg floor (pieces-only).
+dcn-smoke:
+	$(PY) scripts/dcn_smoke.py
 
 # AOT warm-start gate (util/aot.py): serialize the sharded (pipelined)
 # tick block, reload it through the front door in a fresh subprocess —

@@ -552,6 +552,28 @@ def build_entrypoints(mesh=None) -> dict:
         lambda t, o, c, h: ring_ops._lookup_n_window_padded(t, o, c, h, 3, 16)
     )(sring.tokens, sring.owners, sring.count[0], jnp.asarray(shashes))
 
+    # the r15 multihost device-side window programs: the P=1 full-window
+    # gather and the per-leg nonzero-row summary + compaction
+    # (sim/delta_multihost._k_window_all / _k_plane_summary).  They run
+    # PER PROCESS, outside any mesh — dense-only entry points, and the
+    # compiled census must show ZERO collectives (run_hlo_checks pins the
+    # collective-free RPJ206 flavor); RPJ201/202/203 here keep them
+    # 32-bit, callback-free, phase-scoped.
+    if mesh is None:
+        from ringpop_tpu.sim import delta_multihost
+        from ringpop_tpu.sim.packbits import n_words as _n_words
+
+        mh_plane = jnp.zeros((_N, _n_words(_K)), jnp.uint32)
+        out["mh_window_slice"] = jax.make_jaxpr(
+            lambda pl, s: delta_multihost._k_window_all(pl, s)
+        )(mh_plane, jnp.int32(7))
+        out["mh_window_summary"] = jax.make_jaxpr(
+            lambda pl: delta_multihost._k_plane_nzbits(pl)
+        )(mh_plane)
+        out["mh_rows_gather"] = jax.make_jaxpr(
+            lambda pl, ix: delta_multihost._k_rows_gather(pl, ix)
+        )(mh_plane, jnp.arange(16, dtype=jnp.int32))
+
     # the chaos-enabled steps: the same engines driven by a time-varying
     # FaultPlan with every leg populated — fault evaluation (the
     # fault-plan phase) must stay collective-free (RPJ203/RPJ206) and the
@@ -807,6 +829,33 @@ def run_hlo_checks() -> list[Finding]:
             .as_text()
         )
     findings += check_hlo_collective_free("serve_lookup[hlo,dense]", serve_text)
+
+    # r15: the multihost device-side window programs compiled dense —
+    # they run per-process OUTSIDE the mesh, so their census must show
+    # zero collectives of any kind (same flavor as the serve lookup)
+    from ringpop_tpu.sim import delta_multihost
+    from ringpop_tpu.sim.packbits import n_words as _n_words
+
+    mh_plane = jnp.zeros((_HLO_N, _n_words(_K)), jnp.uint32)
+    with _no_compile_cache():
+        slice_text = (
+            delta_multihost._k_window_all.lower(mh_plane, jnp.int32(7))
+            .compile()
+            .as_text()
+        )
+        summary_text = (
+            delta_multihost._k_plane_nzbits.lower(mh_plane).compile().as_text()
+        )
+        gather_text = (
+            delta_multihost._k_rows_gather.lower(
+                mh_plane, jnp.arange(64, dtype=jnp.int32)
+            )
+            .compile()
+            .as_text()
+        )
+    findings += check_hlo_collective_free("mh_window_slice[hlo,dense]", slice_text)
+    findings += check_hlo_collective_free("mh_window_summary[hlo,dense]", summary_text)
+    findings += check_hlo_collective_free("mh_rows_gather[hlo,dense]", gather_text)
     return findings
 
 

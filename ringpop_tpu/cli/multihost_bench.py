@@ -60,6 +60,18 @@ def main(argv=None) -> int:
     p.add_argument("--victims", type=int, default=0)
     p.add_argument("--drop", type=float, default=0.0)
     p.add_argument("--path", default=None, help="orbax checkpoint dir (snapshot legs)")
+    p.add_argument(
+        "--journal-light", action="store_true",
+        help="periodic converge-leg journal records skip the state digest "
+        "(the per-tick wire-wave mode — at 16M a per-tick digest costs "
+        "more than the tick); the exit record is always full",
+    )
+    p.add_argument(
+        "--codec", choices=["on", "off"], default="on",
+        help="r15 wire codec (zero-row/run suppression + XOR-delta); "
+        "'off' ships raw frames — the A/B baseline the dcn_wire scenario "
+        "certifies against",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -71,7 +83,9 @@ def main(argv=None) -> int:
     nprocs = jax.process_count() if distributed else 1
     rank = jax.process_index() if distributed else 0
     kv = DistributedKV() if distributed else LocalKV()
-    fabric = Fabric(rank, nprocs, kv, namespace=f"mhb-{args.leg}")
+    fabric = Fabric(
+        rank, nprocs, kv, namespace=f"mhb-{args.leg}", codec=args.codec == "on"
+    )
 
     import jax.numpy as jnp
     import numpy as np
@@ -109,9 +123,11 @@ def main(argv=None) -> int:
         mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
         sink = (lambda rec: _emit({"kind": "block", **rec}))
         ticks, ok = mh.run_until_converged(
-            max_ticks=args.max_ticks, sink=sink, journal_every=args.journal_every
+            max_ticks=args.max_ticks, sink=sink, journal_every=args.journal_every,
+            journal_light=args.journal_light,
         )
         wall = time.perf_counter() - t0
+        ws = fabric.wire_stats()
         _emit(
             {
                 "kind": "result",
@@ -121,11 +137,21 @@ def main(argv=None) -> int:
                 "wall_s": round(wall, 3),
                 "ms_per_tick": round(1000.0 * wall / max(ticks, 1), 3),
                 "peak_rss_mb": _peak_rss_mb(),
-                "fabric_bytes_sent": fabric.bytes_sent,
-                "fabric_bytes_recv": fabric.bytes_recv,
+                "fabric_bytes_sent": ws["bytes_sent"],
+                "fabric_bytes_recv": ws["bytes_recv"],
+                "fabric_raw_sent": ws["raw_bytes_sent"],
                 "fabric_mb_per_tick": round(
-                    fabric.bytes_sent / max(ticks, 1) / 1e6, 3
+                    ws["bytes_sent"] / max(ticks, 1) / 1e6, 3
                 ),
+                "fabric_raw_mb_per_tick": round(
+                    ws["raw_bytes_sent"] / max(ticks, 1) / 1e6, 3
+                ),
+                "fabric_codec_ratio": round(
+                    ws["raw_bytes_sent"] / ws["bytes_sent"], 4
+                ) if ws["bytes_sent"] else 1.0,
+                "fabric_codec_counts": ws["codec_counts"],
+                "d2h_bytes": mh.d2h_bytes,
+                "codec": args.codec,
                 "process_count": nprocs,
                 "process_id": rank,
                 "n": args.n,

@@ -1704,6 +1704,167 @@ def bench_multihost16m(seed: int, full: bool) -> dict:
     }
 
 
+def bench_dcn_wire(seed: int, full: bool) -> dict:
+    """r15: the sparsity-aware wire codec A/B over the host-bridged DCN
+    fabric (``parallel/fabric`` ROWS/RUNS/XOR codec + device-side window
+    slicing in ``sim/delta_multihost``).  Unlike the ICI items this is
+    NOT behind the TPU gate: fabric bytes and wall-clock are measured at
+    host level on this container.
+
+    Two legs, both recorded:
+
+    1. **twin** — the r14 twin scenario (65536 nodes, victims + loss) at
+       P=2 with the codec ON and OFF: both digests must equal the
+       in-process engine's (the codec is bit-transparent by
+       construction; this certifies it at artifact scale).
+    2. **scale A/B** — delta convergence at 16M nodes (full; 1M on the
+       CPU smoke tier) at P=2, codec-on vs codec-off, per-tick journals:
+       wire MB/tick/host must be >= 2x lower with the codec averaged
+       over the run (the dissemination wave far more — the per-tick
+       deltas are in the artifact), end-to-end wall-clock no slower than
+       raw, digests bit-identical.  ``certify_cost_model``'s ``dcn_wire``
+       judge refutes on any violation.
+    """
+    import functools
+    import os as _os
+    import sys as _sys
+
+    import numpy as np
+
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))), "scripts"))
+    from multihost_launch import launch
+
+    base = ["-m", "ringpop_tpu.cli.multihost_bench"]
+
+    # -- leg 1: engine-anchored twin, codec on vs off ------------------------
+    tn, tk, tticks, victims, drop = 65536, 64, 24, 64, 0.05
+    common = ["--n", str(tn), "--k", str(tk), "--seed", str(seed),
+              "--victims", str(victims), "--drop", str(drop)]
+    twin = {}
+    for codec in ("on", "off"):
+        ranks = launch(
+            2, base + ["twin", *common, "--ticks", str(tticks), "--codec", codec],
+            timeout_s=900,
+        )
+        recs = [r["records"][-1] for r in ranks]
+        twin[codec] = {
+            "digest": recs[0]["digest"],
+            "ranks_agree": len({r["digest"] for r in recs}) == 1,
+        }
+    import jax
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    tparams = DeltaParams(n=tn, k=tk, rng="counter")
+    rng = np.random.default_rng(seed + 999)
+    up = np.ones(tn, bool)
+    up[rng.choice(tn, size=victims, replace=False)] = False
+    st = init_state(tparams, seed=seed)
+    stp = jax.jit(functools.partial(step, tparams))
+    tfaults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(drop))
+    for _ in range(tticks):
+        st = stp(st, tfaults)
+    engine_digest = int(tree_digest(st))
+    twin_certified = all(
+        v["ranks_agree"] and v["digest"] == engine_digest for v in twin.values()
+    )
+
+    # -- leg 2: the scale A/B ------------------------------------------------
+    sn = 16_000_000 if full else 1_000_000
+    sk = 64
+    scale_common = ["--n", str(sn), "--k", str(sk), "--seed", str(seed),
+                    "--max-ticks", "4096", "--journal-every", "1",
+                    "--journal-light"]
+    scale = {}
+    for codec in ("on", "off"):
+        t0 = time.perf_counter()
+        ranks = launch(2, base + ["converge", *scale_common, "--codec", codec],
+                       timeout_s=3600)
+        results = [
+            next(rec for rec in reversed(r["records"]) if rec["kind"] == "result")
+            for r in ranks
+        ]
+        r0 = results[0]
+        scale[codec] = {
+            "ticks": r0["ticks"],
+            "converged": r0["converged"],
+            "digest": r0["digest"],
+            "ranks_agree": len({r["digest"] for r in results}) == 1,
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "worker_wall_s": [r["wall_s"] for r in results],
+            "ms_per_tick": r0["ms_per_tick"],
+            "wire_mb_per_tick": [r["fabric_mb_per_tick"] for r in results],
+            "raw_mb_per_tick": [r["fabric_raw_mb_per_tick"] for r in results],
+            "codec_ratio": r0["fabric_codec_ratio"],
+            "codec_counts": r0["fabric_codec_counts"],
+            "d2h_mb": [round(r["d2h_bytes"] / 1e6, 2) for r in results],
+            "peak_rss_mb": [r["peak_rss_mb"] for r in results],
+        }
+        # the dissemination wave: rank 0's per-tick wire/raw deltas (the
+        # codec run's wave is the PERF.md "ratio by phase" evidence)
+        blocks = [rec for rec in ranks[0]["records"] if rec["kind"] == "block"]
+        scale[codec]["per_tick"] = [
+            {
+                "tick": b["tick"],
+                "coverage": b["coverage"],
+                "wire_mb": round(b["fabric_wire_sent_delta"] / 1e6, 3),
+                "raw_mb": round(b["fabric_raw_sent_delta"] / 1e6, 3),
+                "ratio": b["fabric_codec_ratio"],
+            }
+            for b in blocks
+        ]
+    digests_equal = bool(
+        scale["on"]["digest"] == scale["off"]["digest"]
+        and scale["on"]["ranks_agree"] and scale["off"]["ranks_agree"]
+        and scale["on"]["converged"] and scale["off"]["converged"]
+        and scale["on"]["ticks"] == scale["off"]["ticks"]
+    )
+    wire_on = max(scale["on"]["wire_mb_per_tick"])
+    wire_off = max(scale["off"]["wire_mb_per_tick"])
+    wire_ratio = round(wire_off / wire_on, 3) if wire_on else None
+    # the ratio the codec run measures against ITSELF (raw accounting of
+    # the same messages) — cross-checks the two-run ratio without noise
+    inline_ratio = scale["on"]["codec_ratio"]
+    # worker wall (convergence loop only) — launcher wall adds the
+    # coordinator bring-up, identical both sides but noisier
+    wall_on = max(scale["on"]["worker_wall_s"])
+    wall_off = max(scale["off"]["worker_wall_s"])
+    wall_ratio = round(wall_on / wall_off, 3) if wall_off else None
+    dissem = [p for p in scale["on"]["per_tick"] if p["coverage"] < 0.999]
+    dissem_ratio = (
+        round(sum(p["raw_mb"] for p in dissem) / max(sum(p["wire_mb"] for p in dissem), 1e-9), 2)
+        if dissem else None
+    )
+
+    return {
+        "metric": f"dcn_wire_{sn // 1_000_000}m",
+        # headline: measured wire MB/tick/host compression, codec vs raw
+        "value": wire_ratio,
+        "unit": "wire_compression_x",
+        "certified": bool(
+            twin_certified and digests_equal
+            and wire_ratio is not None and wire_ratio >= 2.0
+            and wall_ratio is not None and wall_ratio <= 1.05
+        ),
+        "engine_digest": engine_digest,
+        "twin": twin,
+        "twin_certified": twin_certified,
+        "scale": scale,
+        "digests_equal": digests_equal,
+        "wire_mb_per_tick_on": wire_on,
+        "wire_mb_per_tick_off": wire_off,
+        "wire_ratio": wire_ratio,
+        "inline_codec_ratio": inline_ratio,
+        "dissemination_ratio": dissem_ratio,
+        "wall_ratio_on_over_off": wall_ratio,
+        "n_nodes": sn,
+        "n_rumors": sk,
+    }
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
@@ -1721,6 +1882,7 @@ BENCHES = {
     "sharded100k": bench_sharded100k,
     "delta16m": bench_delta16m,
     "multihost16m": bench_multihost16m,
+    "dcn_wire": bench_dcn_wire,
     "churn100k": bench_churn100k,
     "flap1k": bench_flap1k,
     "asym_partition": bench_asym_partition,

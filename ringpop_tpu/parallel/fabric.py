@@ -26,11 +26,26 @@ Layering:
 * collectives — ``allgather`` of per-rank partial words implements the
   OR/AND row reduces and digest combines (bitwise ops reassociate
   exactly, so partial-then-combine is bit-identical to the single-host
-  tree — the property every certificate leans on).
+  tree — the property every certificate leans on);
+* codec (r15) — every array on the wire carries a one-byte
+  self-describing codec: zero-row suppression (``ROWS``: bitmap of
+  nonzero rows + packed payload — the dominant win for the ride-masked
+  exchange legs, whose ``sent``/``answerable`` planes are mostly zero
+  rows outside the dissemination wave), zero-word run suppression
+  (``RUNS``: dense-but-patchy planes), an optional previous-payload
+  XOR-delta (``XOR``: explicit epoch word, reset on snapshot restore /
+  peer-count change so restore-onto-a-different-P stays certified), and
+  a MEASURED raw fallback — an encoding that does not strictly shrink
+  the payload is never sent.  Encode decisions are send-side local and
+  decode is exact, so digests are bit-identical by construction,
+  codec-on vs codec-off.
 
-Byte accounting is first-class: ``bytes_sent``/``bytes_recv`` accumulate
-per rank so the simbench/ksweep records can state per-host MB/tick
-against the committed 42.5 MB/chip/tick mesh budget.
+Byte accounting is first-class and split (r15): ``bytes_sent``/
+``bytes_recv`` are the actual WIRE bytes; ``raw_bytes_sent``/
+``raw_bytes_recv`` are what the same messages would have cost with the
+codec off, so the simbench/ksweep records can state both the per-host
+MB/tick on the wire and the compression ratio against the committed
+42.5 MB/chip/tick mesh budget.
 """
 
 from __future__ import annotations
@@ -40,12 +55,257 @@ import socket
 import struct
 import threading
 import time
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence, Union
 
 import numpy as np
 
 _HDR = struct.Struct(">IIQ")  # tag, n_arrays, total payload bytes
-_AHDR = struct.Struct(">III")  # dtype-str len, ndim, nbytes (shape follows)
+# per-array header: codec byte, dtype-str len, ndim, ENCODED payload bytes
+# (dtype str + ">u8" shape words follow; then the encoded payload)
+_AHDR = struct.Struct(">BIIQ")
+
+# -- wire codec ---------------------------------------------------------------
+
+CODEC_RAW = 0  # payload = a.tobytes()
+CODEC_ROWS = 1  # ">I" nnz-rows + LSB-first row bitmap + nonzero rows packed
+CODEC_RUNS = 2  # ">I" n-runs + "<u4" starts + "<u4" lens + nonzero u32 words
+CODEC_XOR = 3  # ">II" epoch, inner codec + inner payload of prev-XOR diff
+
+CODEC_NAMES = {CODEC_RAW: "raw", CODEC_ROWS: "rows",
+               CODEC_RUNS: "runs", CODEC_XOR: "xor"}
+
+
+class FabricError(RuntimeError):
+    """Any fabric-layer failure with rank/peer context attached."""
+
+
+class FabricPeerLost(FabricError):
+    """A peer's socket closed mid-run — the peer process died (or shut
+    its fabric down) while this rank still expected messages from it."""
+
+
+class FabricTimeout(FabricError):
+    """A live but SILENT peer: nothing arrived (or a send could not
+    drain) within ``timeout_ms``.  Distinct from a tag desync — the
+    schedule may still agree; the peer is wedged or partitioned."""
+
+
+class FabricDesync(FabricError):
+    """A message arrived with the WRONG tag: the peers' deterministic
+    schedules disagree (a leg skipped or reordered).  Both endpoints are
+    alive — that is what distinguishes this from the two above."""
+
+
+class Encoded(NamedTuple):
+    """A pre-encoded wire array (codec already applied).  Callers that
+    hold send-side structure the encoder would otherwise recompute — the
+    multihost engine's DEVICE-computed nonzero-row summaries — hand the
+    fabric one of these instead of an ndarray; ``decode_array`` cannot
+    tell the difference."""
+
+    codec: int
+    dtype: np.dtype
+    shape: tuple
+    payload: bytes
+    raw_nbytes: int
+
+
+def _bitmap_pack(mask: np.ndarray) -> bytes:
+    """bool[rows] -> ceil(rows/8) LSB-first bytes (bit i of byte j is
+    row 8j+i) — the byte order ``packbits.pack_bool``'s little-endian
+    uint32 word view produces, so device-packed masks are wire-identical
+    to host-packed ones."""
+    return np.packbits(mask, bitorder="little").tobytes()
+
+
+def _bitmap_unpack(buf: bytes, rows: int) -> np.ndarray:
+    return np.unpackbits(
+        np.frombuffer(buf, np.uint8), count=rows, bitorder="little"
+    ).astype(bool)
+
+
+def rows_wire_size(rows: int, nnz: int, row_nbytes: int) -> int:
+    """Encoded-payload size of a ROWS encoding — callers with a
+    device-side nonzero count use this to decide BEFORE transferring."""
+    return 4 + (rows + 7) // 8 + nnz * row_nbytes
+
+
+def encode_rows(
+    mask: np.ndarray, rows_payload: np.ndarray, shape: tuple, dtype
+) -> Encoded:
+    """Build a ROWS encoding from an externally computed nonzero-row
+    mask + already-compacted nonzero rows (the device-sliced hot path).
+    The caller is responsible for having checked ``rows_wire_size``
+    against the raw size — this constructor encodes unconditionally."""
+    dtype = np.dtype(dtype)
+    raw_nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    nnz = int(rows_payload.shape[0])
+    payload = (
+        struct.pack(">I", nnz)
+        + _bitmap_pack(np.asarray(mask, bool))
+        + np.ascontiguousarray(rows_payload).tobytes()
+    )
+    return Encoded(CODEC_ROWS, dtype, tuple(shape), payload, raw_nbytes)
+
+
+def _rows_encode(a: np.ndarray) -> Optional[bytes]:
+    """Zero-row suppression; None when it would not strictly shrink.
+    The row mask tests the BYTE view, not values — float -0.0 is
+    value-equal to zero but bit-distinct, and the decode contract is
+    bit-exactness (``a`` is contiguous: encode_array guarantees it)."""
+    if a.ndim < 2 or a.shape[0] < 2 or a.size == 0:
+        return None
+    flat = a.reshape(a.shape[0], -1)
+    mask = (flat.view(np.uint8) != 0).any(axis=1)
+    nnz = int(mask.sum())
+    row_nbytes = a.nbytes // a.shape[0]
+    if rows_wire_size(a.shape[0], nnz, row_nbytes) >= a.nbytes:
+        return None
+    return struct.pack(">I", nnz) + _bitmap_pack(mask) + flat[mask].tobytes()
+
+
+def _rows_decode(payload: bytes, shape: tuple, dtype: np.dtype) -> np.ndarray:
+    (nnz,) = struct.unpack_from(">I", payload, 0)
+    nb = 4 + (shape[0] + 7) // 8
+    mask = _bitmap_unpack(payload[4:nb], shape[0])
+    if int(mask.sum()) != nnz:
+        raise FabricError(
+            f"ROWS bitmap popcount {int(mask.sum())} != header nnz {nnz} — "
+            "corrupt frame"
+        )
+    out = np.zeros(shape, dtype)
+    out[mask] = np.frombuffer(payload, dtype, offset=nb).reshape(
+        (nnz,) + tuple(shape[1:])
+    )
+    return out
+
+
+def _runs_encode(a: np.ndarray) -> Optional[bytes]:
+    """Zero-WORD run suppression over the uint32 view; None when the
+    dtype does not view as whole words or it would not strictly shrink.
+    ``a`` must be C-contiguous (``encode_array`` guarantees it) — the
+    word view and the cheap-reject count are copy-free, so a dense plane
+    costs ONE pass here, not the full run detection."""
+    if a.nbytes % 4 or a.nbytes == 0:
+        return None
+    w = a.reshape(-1).view(np.uint32)
+    nz = w != 0
+    nnz_words = int(np.count_nonzero(nz))
+    if 4 + 8 + 4 * nnz_words >= a.nbytes:
+        return None  # even a single run cannot shrink this payload
+    edges = np.flatnonzero(np.diff(np.concatenate(([False], nz, [False]))))
+    starts, ends = edges[0::2], edges[1::2]
+    size = 4 + 8 * len(starts) + 4 * nnz_words
+    if size >= a.nbytes:
+        return None
+    return (
+        struct.pack(">I", len(starts))
+        + starts.astype("<u4").tobytes()
+        + (ends - starts).astype("<u4").tobytes()
+        + w[nz].tobytes()
+    )
+
+
+def _runs_decode(payload: bytes, nbytes: int) -> np.ndarray:
+    """-> the flat uint32 word view (caller reshapes/reviews)."""
+    (nruns,) = struct.unpack_from(">I", payload, 0)
+    starts = np.frombuffer(payload, "<u4", count=nruns, offset=4).astype(np.int64)
+    lens = np.frombuffer(payload, "<u4", count=nruns, offset=4 + 4 * nruns).astype(
+        np.int64
+    )
+    words = np.frombuffer(payload, np.uint32, offset=4 + 8 * nruns)
+    out = np.zeros(nbytes // 4, np.uint32)
+    if nruns:
+        tot = int(lens.sum())
+        off = np.arange(tot, dtype=np.int64) - np.repeat(
+            np.cumsum(lens) - lens, lens
+        )
+        out[np.repeat(starts, lens) + off] = words
+    return out
+
+
+def encode_array(
+    a: np.ndarray,
+    prev: Optional[bytes] = None,
+    epoch: int = 0,
+    rows: bool = True,
+) -> Encoded:
+    """Best strictly-smaller encoding of ``a`` — RAW when nothing pays
+    (the measured fallback).  ``prev`` (the previous payload bytes on
+    this stream, same shape/dtype — the caller guarantees it was
+    recorded under ``epoch``) additionally offers the XOR-delta.
+    ``rows=False`` skips the ROWS attempt — for callers that already
+    know the nonzero-row count (the engine's device-side summary) and
+    would otherwise pay a redundant full host scan per dense piece."""
+    a = np.ascontiguousarray(a)
+    cands: list[tuple[int, int, bytes]] = [(a.nbytes, CODEC_RAW, b"")]
+    rows_payload = _rows_encode(a) if rows else None
+    if rows_payload is not None:
+        cands.append((len(rows_payload), CODEC_ROWS, rows_payload))
+    runs = _runs_encode(a)
+    if runs is not None:
+        cands.append((len(runs), CODEC_RUNS, runs))
+    if prev is not None and len(prev) == a.nbytes and a.nbytes:
+        diff = np.bitwise_xor(
+            a.reshape(-1).view(np.uint8),
+            np.frombuffer(prev, np.uint8),
+        )
+        # only a RUNS-compressed diff can undercut raw (an inner-RAW
+        # XOR payload is raw + 8 header bytes by construction), so no
+        # RUNS win means no XOR candidate; decode_array still accepts
+        # an inner-RAW frame for wire-format completeness
+        inner = _runs_encode(diff)
+        if inner is not None:
+            xor_payload = struct.pack(">II", epoch & 0xFFFFFFFF, CODEC_RUNS) + inner
+            if len(xor_payload) < a.nbytes:
+                cands.append((len(xor_payload), CODEC_XOR, xor_payload))
+    size, codec, payload = min(cands, key=lambda c: (c[0], c[1]))
+    if codec == CODEC_RAW:
+        payload = a.tobytes()
+    return Encoded(codec, a.dtype, a.shape, payload, a.nbytes)
+
+
+def decode_array(
+    codec: int,
+    dtype: np.dtype,
+    shape: tuple,
+    payload: bytes,
+    prev: Optional[bytes] = None,
+    epoch: int = 0,
+) -> np.ndarray:
+    """Exact inverse of every encoding.  XOR requires the previous
+    payload on the stream AND a matching epoch word — a mismatch means
+    one side missed a codec reset (snapshot restore / peer change) and
+    MUST fail loudly rather than decode garbage."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    if codec == CODEC_RAW:
+        return np.frombuffer(payload, dtype, count=-1).reshape(shape).copy()
+    if codec == CODEC_ROWS:
+        return _rows_decode(payload, tuple(shape), np.dtype(dtype))
+    if codec == CODEC_RUNS:
+        words = _runs_decode(payload, nbytes)
+        return np.frombuffer(words.tobytes(), dtype).reshape(shape).copy()
+    if codec == CODEC_XOR:
+        got_epoch, inner_codec = struct.unpack_from(">II", payload, 0)
+        if prev is None or got_epoch != (epoch & 0xFFFFFFFF):
+            raise FabricError(
+                f"codec epoch desync: XOR frame carries epoch {got_epoch} but "
+                f"this rank is at epoch {epoch & 0xFFFFFFFF} with "
+                f"{'no' if prev is None else 'a'} previous payload — a codec "
+                "reset (snapshot restore / peer-count change) was missed on "
+                "one side"
+            )
+        inner = payload[8:]
+        if inner_codec == CODEC_RUNS:
+            diff = _runs_decode(inner, nbytes).tobytes()
+        else:
+            diff = inner
+        raw = np.bitwise_xor(
+            np.frombuffer(diff, np.uint8),
+            np.frombuffer(prev, np.uint8),
+        ).tobytes()
+        return np.frombuffer(raw, dtype).reshape(shape).copy()
+    raise FabricError(f"unknown wire codec byte {codec}")
 
 
 class LocalKV:
@@ -118,7 +378,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
-            raise ConnectionError("fabric peer closed the connection")
+            raise FabricPeerLost("fabric peer closed the connection")
         got += r
     return bytes(buf)
 
@@ -139,14 +399,26 @@ class Fabric:
         namespace: str = "fabric",
         host: str = "127.0.0.1",
         timeout_ms: int = 120_000,
+        codec: bool = True,
     ):
         if not 0 <= rank < nprocs:
             raise ValueError(f"rank {rank} outside [0, {nprocs})")
         self.rank, self.nprocs = rank, nprocs
         self.kv, self.ns = kv, namespace
         self.timeout_ms = timeout_ms
-        self.bytes_sent = 0
+        self.codec = codec
+        self.bytes_sent = 0  # actual wire bytes
         self.bytes_recv = 0
+        self.raw_bytes_sent = 0  # what the same messages cost codec-off
+        self.raw_bytes_recv = 0
+        self.codec_counts: dict[int, int] = {}  # sent arrays per codec byte
+        # XOR-delta stream state: (peer, stream, array-idx) -> payload
+        # bytes recorded under codec_epoch; reset_codec_state() clears both
+        # sides' dicts and bumps the epoch word (collective by convention:
+        # every rank resets at the same protocol point — snapshot restore)
+        self.codec_epoch = 0
+        self._tx_prev: dict[tuple, bytes] = {}
+        self._rx_prev: dict[tuple, bytes] = {}
         self._peers: dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         if nprocs > 1:
@@ -209,49 +481,146 @@ class Fabric:
 
     # -- framed numpy messages ------------------------------------------------
 
-    def _pack(self, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
+    def reset_codec_state(self) -> None:
+        """Drop every XOR-delta stream and bump the epoch word.  Call at
+        any protocol point where the payload history breaks — snapshot
+        restore, engine re-init — on EVERY rank (the epoch word in each
+        XOR frame turns a missed reset into a loud ``FabricError`` instead
+        of silently decoded garbage)."""
+        with self._lock:
+            self.codec_epoch += 1
+            self._tx_prev.clear()
+            self._rx_prev.clear()
+
+    def wire_stats(self) -> dict:
+        """Counter snapshot for journals/bench records (wire vs raw bytes
+        + per-codec sent-array counts, names not bytes)."""
+        with self._lock:
+            return {
+                "bytes_sent": self.bytes_sent,
+                "bytes_recv": self.bytes_recv,
+                "raw_bytes_sent": self.raw_bytes_sent,
+                "raw_bytes_recv": self.raw_bytes_recv,
+                "codec_counts": {
+                    CODEC_NAMES.get(c, str(c)): n
+                    for c, n in sorted(self.codec_counts.items())
+                },
+            }
+
+    def _encode_item(
+        self, item: Union[np.ndarray, Encoded], peer: int, stream, idx: int
+    ) -> Encoded:
+        if isinstance(item, Encoded):
+            if stream is not None:
+                # the sender has no raw bytes to record as XOR history,
+                # but the receiver records its decode — the two prevs
+                # would diverge under MATCHING epochs, defeating the
+                # epoch word's whole purpose.  Refuse rather than
+                # corrupt (today's pre-encoded path, the exchange legs,
+                # is stream-less by design: window shapes move with s).
+                raise ValueError(
+                    "pre-encoded (Encoded) items cannot ride a streamed "
+                    "round: the XOR-delta payload history would diverge "
+                    "between sender and receiver — send the ndarray, or "
+                    "drop the stream"
+                )
+            return item  # pre-encoded (device-sourced ROWS) — pass through
+        a = np.ascontiguousarray(item)
+        if not self.codec:
+            return Encoded(CODEC_RAW, a.dtype, a.shape, a.tobytes(), a.nbytes)
+        prev = self._tx_prev.get((peer, stream, idx)) if stream is not None else None
+        enc = encode_array(a, prev=prev, epoch=self.codec_epoch)
+        if stream is not None:
+            self._tx_prev[(peer, stream, idx)] = a.tobytes()
+        return enc
+
+    def _pack(self, tag: int, arrays, peer: int, stream=None) -> tuple[bytes, int]:
+        """-> (wire message, raw-equivalent size)."""
         parts = []
         total = 0
-        for a in arrays:
-            a = np.ascontiguousarray(a)
-            dt = a.dtype.str.encode()
-            shape = np.asarray(a.shape, ">u8").tobytes()
-            parts.append(_AHDR.pack(len(dt), a.ndim, a.nbytes) + dt + shape)
-            parts.append(a.tobytes())
+        raw_total = _HDR.size
+        counts: dict[int, int] = {}
+        for idx, item in enumerate(arrays):
+            enc = self._encode_item(item, peer, stream, idx)
+            dt = enc.dtype.str.encode()
+            shape = np.asarray(enc.shape, ">u8").tobytes()
+            meta = _AHDR.pack(enc.codec, len(dt), len(enc.shape), len(enc.payload))
+            parts.append(meta + dt + shape)
+            parts.append(enc.payload)
             total += len(parts[-2]) + len(parts[-1])
-        return _HDR.pack(tag, len(arrays), total) + b"".join(parts)
+            raw_total += len(meta) + len(dt) + len(shape) + enc.raw_nbytes
+            counts[enc.codec] = counts.get(enc.codec, 0) + 1
+        with self._lock:
+            for c, k in counts.items():
+                self.codec_counts[c] = self.codec_counts.get(c, 0) + k
+        return _HDR.pack(tag, len(arrays), total) + b"".join(parts), raw_total
 
-    def _send(self, peer: int, tag: int, arrays: Sequence[np.ndarray]) -> None:
-        msg = self._pack(tag, arrays)
+    def _send(self, peer: int, tag: int, arrays, stream=None) -> None:
+        msg, raw = self._pack(tag, arrays, peer, stream)
         with self._lock:
             self.bytes_sent += len(msg)
-        _send_exact(self._peers[peer], msg)
+            self.raw_bytes_sent += raw
+        try:
+            _send_exact(self._peers[peer], msg)
+        except socket.timeout as e:
+            raise FabricTimeout(
+                f"rank {self.rank}: send to peer {peer} (tag {tag}) could not "
+                f"drain within {self.timeout_ms} ms — peer wedged or "
+                "partitioned"
+            ) from e
+        except FabricError:
+            raise
+        except OSError as e:
+            raise FabricPeerLost(
+                f"rank {self.rank}: send to peer {peer} (tag {tag}) failed "
+                f"({e}) — peer process died mid-exchange"
+            ) from e
 
-    def _recv(self, peer: int, tag: int) -> list[np.ndarray]:
+    def _recv(self, peer: int, tag: int, stream=None) -> list[np.ndarray]:
         sock = self._peers[peer]
-        hdr = _recv_exact(sock, _HDR.size)
-        got_tag, n_arrays, total = _HDR.unpack(hdr)
-        if got_tag != tag:
-            raise RuntimeError(
-                f"fabric desync: rank {self.rank} expected tag {tag} from peer "
-                f"{peer}, got {got_tag} — a leg was skipped or reordered"
-            )
-        payload = _recv_exact(sock, total)
-        self.bytes_recv += len(hdr) + total
+        try:
+            hdr = _recv_exact(sock, _HDR.size)
+            got_tag, n_arrays, total = _HDR.unpack(hdr)
+            if got_tag != tag:
+                raise FabricDesync(
+                    f"fabric desync: rank {self.rank} expected tag {tag} from peer "
+                    f"{peer}, got {got_tag} — a leg was skipped or reordered"
+                )
+            payload = _recv_exact(sock, total)
+        except socket.timeout as e:
+            raise FabricTimeout(
+                f"rank {self.rank}: peer {peer} sent nothing for tag {tag} "
+                f"within {self.timeout_ms} ms — peer dead-but-connected, "
+                "wedged, or partitioned (NOT a tag desync: nothing arrived "
+                "at all)"
+            ) from e
+        except FabricPeerLost as e:
+            raise FabricPeerLost(
+                f"rank {self.rank}: peer {peer} closed its socket while this "
+                f"rank awaited tag {tag} — peer process died mid-exchange"
+            ) from e
         out, off = [], 0
-        for _ in range(n_arrays):
-            dtl, ndim, nbytes = _AHDR.unpack_from(payload, off)
+        raw_total = _HDR.size
+        for idx in range(n_arrays):
+            codec, dtl, ndim, nbytes = _AHDR.unpack_from(payload, off)
             off += _AHDR.size
             dt = payload[off : off + dtl].decode()
             off += dtl
             shape = tuple(np.frombuffer(payload, ">u8", count=ndim, offset=off).astype(int))
             off += 8 * ndim
-            out.append(
-                np.frombuffer(payload, np.dtype(dt), count=nbytes // np.dtype(dt).itemsize, offset=off)
-                .reshape(shape)
-                .copy()
+            prev = self._rx_prev.get((peer, stream, idx)) if stream is not None else None
+            a = decode_array(
+                codec, np.dtype(dt), shape, payload[off : off + nbytes],
+                prev=prev, epoch=self.codec_epoch,
             )
+            if stream is not None:
+                self._rx_prev[(peer, stream, idx)] = a.tobytes()
+            out.append(a)
+            raw_total += _AHDR.size + dtl + 8 * ndim + a.nbytes
             off += nbytes
+        with self._lock:
+            self.bytes_recv += len(hdr) + total
+            self.raw_bytes_recv += raw_total
         return out
 
     # -- rounds ---------------------------------------------------------------
@@ -259,19 +628,38 @@ class Fabric:
     def exchange(
         self,
         tag: int,
-        sends: dict[int, Sequence[np.ndarray]],
+        sends: dict[int, Sequence[Union[np.ndarray, Encoded]]],
         recv_from: Sequence[int],
+        stream: Optional[str] = None,
     ) -> dict[int, list[np.ndarray]]:
         """One deterministic communication round: send each payload in
         ``sends`` (background threads), receive one message from every
         peer in ``recv_from`` (in the given order), join.  Both sides must
         derive the same schedule — a mismatch surfaces as a tag desync or
-        timeout, never silent misdata."""
+        timeout, never silent misdata.  ``stream`` (a tick-stable name)
+        opts the round's arrays into the XOR-delta codec: the previous
+        payload per (peer, stream, index) is retained on both sides, so
+        only use it for rounds whose shapes recur (the reduce words —
+        retaining a full window would double memory for no ratio)."""
+        if stream is not None:
+            # validate BEFORE any socket work so the contract violation
+            # raises synchronously on every rank instead of leaving the
+            # peers blocked into a timeout (_encode_item's check would
+            # only fire inside a background send thread)
+            for arrays in sends.values():
+                for it in arrays:
+                    if isinstance(it, Encoded):
+                        raise ValueError(
+                            "pre-encoded (Encoded) items cannot ride a "
+                            "streamed round: the XOR-delta payload history "
+                            "would diverge between sender and receiver — "
+                            "send the ndarray, or drop the stream"
+                        )
         errs: list[BaseException] = []
 
         def _bg(peer, arrays):
             try:
-                self._send(peer, tag, arrays)
+                self._send(peer, tag, arrays, stream)
             except BaseException as e:  # surfaced after join
                 errs.append(e)
 
@@ -281,20 +669,26 @@ class Fabric:
         ]
         for t in threads:
             t.start()
-        out = {p: self._recv(p, tag) for p in recv_from}
-        for t in threads:
-            t.join()
+        try:
+            out = {p: self._recv(p, tag, stream) for p in recv_from}
+        finally:
+            for t in threads:
+                t.join()
         if errs:
             raise errs[0]
         return out
 
-    def allgather(self, tag: int, arr: np.ndarray) -> list[np.ndarray]:
+    def allgather(
+        self, tag: int, arr: np.ndarray, stream: Optional[str] = None
+    ) -> list[np.ndarray]:
         """Every rank's ``arr``, ordered by rank (self included).  Tiny
         payloads only (reduce words, digest partials) — full-mesh sends."""
         if self.nprocs == 1:
             return [np.asarray(arr)]
         peers = [p for p in range(self.nprocs) if p != self.rank]
-        got = self.exchange(tag, {p: [np.asarray(arr)] for p in peers}, peers)
+        got = self.exchange(
+            tag, {p: [np.asarray(arr)] for p in peers}, peers, stream=stream
+        )
         return [
             np.asarray(arr) if r == self.rank else got[r][0]
             for r in range(self.nprocs)

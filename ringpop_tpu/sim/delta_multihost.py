@@ -39,7 +39,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.parallel.fabric import Fabric, plan_window
+from ringpop_tpu.parallel.fabric import (
+    Fabric,
+    encode_array,
+    encode_rows,
+    plan_window,
+    rows_wire_size,
+)
 from ringpop_tpu.parallel.partition import (
     combine_leaf_partials,
     leaf_partial_sums,
@@ -55,8 +61,10 @@ from ringpop_tpu.sim.delta import (
 from ringpop_tpu.sim.packbits import (
     and_reduce_rows,
     n_words,
+    nonzero_rows,
     or_reduce_rows,
     pack_bool,
+    popcount_rows,
     row_mask,
     unpack_bits,
 )
@@ -207,8 +215,50 @@ def _k_coverage_bits(learned_l, *, g: int):
     N·K ≥ 2³² — each chunk here covers block/g rows × 32·W bits, kept
     well inside uint32 by the caller's chunk choice; the host folds the
     [g] vector in int64)."""
-    per_row = jax.lax.population_count(learned_l).sum(axis=1, dtype=jnp.uint32)
+    per_row = popcount_rows(learned_l)
     return per_row.reshape(g, -1).sum(axis=1, dtype=jnp.uint32)
+
+
+# -- device-side window programs (r15) ----------------------------------------
+# Both run PER PROCESS, outside any mesh — collective-free by construction
+# (jaxlint RPJ206's collective-free flavor pins it), and they are what
+# keeps device→host transfer at pieces-only: the host-side np fancy-index
+# they replace materialized the ENTIRE local plane per exchange leg.
+
+
+@jax.jit
+def _k_window_all(plane, start):
+    """The P=1 degenerate window: the whole plane cyclically shifted by
+    ``start`` — a materialized-index gather on device (RPA102's blessed
+    lowering), so the single-process exchange leg transfers ZERO bytes
+    to host."""
+    with jax.named_scope("rumor-exchange"):
+        n = plane.shape[0]
+        idx = (start + jnp.arange(n, dtype=jnp.int32)) % n
+        return jnp.take(plane, idx, axis=0)
+
+
+@jax.jit
+def _k_plane_nzbits(plane):
+    """Send-side nonzero-row summary of one exchange plane, one cheap
+    pass per leg: the nonzero-row bitmap packed LSB-first — byte-for-byte
+    the fabric's ROWS wire bitmap (``packbits.pack_bool``'s little-endian
+    word view == ``np.packbits(bitorder="little")``).  ~7 ms at 4M rows;
+    the cumsum+scatter compaction this replaced cost ~430 ms/leg on
+    XLA:CPU (elementwise scatter), which ate the whole wire win."""
+    with jax.named_scope("rumor-exchange"):
+        return pack_bool(nonzero_rows(plane))
+
+
+@jax.jit
+def _k_rows_gather(plane, idx):
+    """The nonzero rows a ROWS-encoded piece actually ships: a
+    materialized-index device gather over the host-built index (callers
+    pad ``idx`` to a power of two with a repeated last index so distinct
+    compiled shapes stay logarithmic; the pad rows are sliced off before
+    transfer)."""
+    with jax.named_scope("rumor-exchange"):
+        return jnp.take(plane, idx, axis=0)
 
 
 class MultihostDelta:
@@ -249,6 +299,17 @@ class MultihostDelta:
         self.learned, self.pcount, self.ride_ok, self.key = learned, pcount, ride_ok, key
         self.tick = 0
         self.converged = None  # unknown until a tick reports the AND plane
+        # device→host transfer accounting for the exchange legs (r15):
+        # summaries + pieces only — the twin tests pin this under the
+        # old full-plane-per-leg floor
+        self.d2h_bytes = 0
+        # journal per-tick deltas: (tick, wire sent, raw sent) at the
+        # last journal_record
+        self._journal_prev = (0, 0, 0)
+        # a fresh engine breaks any XOR-delta payload history a reused
+        # fabric carries (and restore may change P) — reset is local and
+        # every rank constructs its engine at the same protocol point
+        self.fabric.reset_codec_state()
         # coverage chunking: block/g rows per chunk, each chunk's bit count
         # bounded by (block/g)·K — keep it under 2^26 bits per chunk
         from ringpop_tpu.sim.packbits import block_count
@@ -264,42 +325,94 @@ class MultihostDelta:
         """All ranks exchange so each assembles its own window
         ``[lo + rel_shift, lo + rel_shift + B) mod n`` of the globally
         node-sharded ``plane``.  ``rel_shift`` is the same on every rank
-        (leg 1: -s; leg 2: +s), which makes the schedule deterministic."""
+        (leg 1: -s; leg 2: +s), which makes the schedule deterministic.
+
+        r15 hot path: the local plane never materializes on host.  At
+        P=1 the window is a device gather (zero transfer); at P>1 the
+        per-peer pieces are device slices and the nonzero-row summary
+        (``_k_plane_nzbits`` + ``_k_rows_gather``) lets ride-masked
+        pieces transfer ONLY their nonzero rows, as the fabric's ROWS wire format
+        — device→host volume ≈ what actually crosses the wire
+        (``d2h_bytes`` accounts every transfer; the twin tests pin it
+        under the old full-plane floor)."""
         n, b = self.params.n, self.block
         if self.nprocs == 1:
-            idx = (self.lo + rel_shift + np.arange(b)) % n
-            return jnp.asarray(np.asarray(plane_dev)[idx])
-        host_plane = np.asarray(plane_dev)
+            return _k_window_all(
+                plane_dev, jnp.asarray((self.lo + rel_shift) % n, jnp.int32)
+            )
+        row_nbytes = int(np.prod(plane_dev.shape[1:], dtype=np.int64)) * plane_dev.dtype.itemsize
+        use_codec = self.fabric.codec
+        if use_codec:
+            bits_host = np.asarray(_k_plane_nzbits(plane_dev))
+            self.d2h_bytes += bits_host.nbytes
+            mask_all = np.unpackbits(
+                bits_host.view(np.uint8), count=b, bitorder="little"
+            ).astype(bool)
+            cum = np.zeros(b + 1, np.int64)
+            np.cumsum(mask_all, out=cum[1:])
         # build sends: for every other rank, the pieces of MY rows their
-        # window needs, concatenated in THEIR window order
-        sends: dict[int, list[np.ndarray]] = {}
+        # window needs, in THEIR window order (one wire array per piece)
+        sends: dict[int, list] = {}
         for r in range(self.nprocs):
             if r == self.rank:
                 continue
             r_lo = process_block(n, r, self.nprocs)[0]
             plan = plan_window((r_lo + rel_shift) % n, b, n, self.nprocs)
-            mine = [
-                host_plane[glo - self.lo : glo - self.lo + glen]
-                for owner, glo, glen, _ in plan
-                if owner == self.rank
-            ]
-            if mine:
-                sends[r] = [np.ascontiguousarray(np.concatenate(mine, axis=0))]
-        # my own assembly plan
+            items = []
+            for owner, glo, glen, _ in plan:
+                if owner != self.rank:
+                    continue
+                s0 = glo - self.lo
+                if use_codec:
+                    nnz = int(cum[s0 + glen] - cum[s0])
+                    if rows_wire_size(glen, nnz, row_nbytes) < glen * row_nbytes:
+                        if nnz:
+                            idx = np.flatnonzero(mask_all[s0 : s0 + glen]).astype(np.int32)
+                            idx += np.int32(s0)
+                            pad = 1 << max(int(nnz) - 1, 0).bit_length()
+                            idx = np.concatenate(
+                                [idx, np.full(pad - nnz, idx[-1], np.int32)]
+                            )
+                            payload = np.asarray(
+                                _k_rows_gather(plane_dev, jnp.asarray(idx))[:nnz]
+                            )
+                        else:
+                            payload = np.empty((0,) + plane_dev.shape[1:], plane_dev.dtype)
+                        self.d2h_bytes += payload.nbytes
+                        items.append(
+                            encode_rows(
+                                mask_all[s0 : s0 + glen],
+                                payload,
+                                (glen,) + plane_dev.shape[1:],
+                                plane_dev.dtype,
+                            )
+                        )
+                        continue
+                raw = np.asarray(plane_dev[s0 : s0 + glen])
+                self.d2h_bytes += raw.nbytes
+                if use_codec:
+                    # ROWS was already rejected from the device summary —
+                    # pre-encode with rows=False so the fabric does not
+                    # re-scan the dense piece (RUNS/RAW still measured)
+                    items.append(encode_array(raw, rows=False))
+                else:
+                    items.append(raw)
+            if items:
+                sends[r] = items
+        # my own assembly plan: local pieces stay device slices, received
+        # pieces upload, one device concatenate stitches the window
         my_plan = plan_window((self.lo + rel_shift) % n, b, n, self.nprocs)
         recv_from = sorted({owner for owner, *_ in my_plan if owner != self.rank})
         got = self.fabric.exchange(tag, sends, recv_from)
-        out = np.empty((b,) + host_plane.shape[1:], host_plane.dtype)
         used: dict[int, int] = {r: 0 for r in recv_from}
+        parts = []
         for owner, glo, glen, woff in my_plan:
             if owner == self.rank:
-                out[woff : woff + glen] = host_plane[glo - self.lo : glo - self.lo + glen]
+                parts.append(plane_dev[glo - self.lo : glo - self.lo + glen])
             else:
-                buf = got[owner][0]
-                off = used[owner]
-                out[woff : woff + glen] = buf[off : off + glen]
-                used[owner] = off + glen
-        return jnp.asarray(out)
+                parts.append(jnp.asarray(got[owner][used[owner]]))
+                used[owner] += 1
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
     # -- one protocol period --------------------------------------------------
 
@@ -323,9 +436,14 @@ class MultihostDelta:
             self.pcount, self.up_l, has_up=self.has_up,
         )
         if self.nprocs > 1:
+            # stream="reduce": the [2, W] words recur shape-stable every
+            # tick and the AND plane saturates — the XOR-delta codec's one
+            # naturally matching stream (windows move with s, so the legs
+            # stay stream-less)
             partials = self.fabric.allgather(
                 _tag(self.tick, _TAG_REDUCE),
                 np.stack([np.asarray(part_and), np.asarray(part_or)]),
+                stream="reduce",
             )
             fully_w = functools.reduce(np.bitwise_and, [pp[0] for pp in partials])
             riding_any_w = functools.reduce(np.bitwise_or, [pp[1] for pp in partials])
@@ -388,15 +506,42 @@ class MultihostDelta:
         )
         return float(sum(counts)) / float(self.params.n * self.params.k)
 
-    def journal_record(self) -> dict:
+    def journal_record(self, light: bool = False) -> dict:
+        """One journal block: cumulative fabric counters PLUS the r15
+        per-interval deltas and codec ratio — `fabric_*_delta` keys cover
+        the ticks since the previous record (``fabric_ticks_delta`` of
+        them), which is what lets a journal plot the dissemination-phase
+        traffic wave instead of only the cumulative ramp.
+
+        ``light=True`` skips the state digest (coverage stays — a cheap
+        popcount, and the wave wants its phase label): the digest mixes
+        EVERY state leaf including the [N, K] pcount plane, which at 16M
+        costs more than the tick it journals — per-tick wire waves use
+        light records and keep the full digest for the exit record.
+        Collective either way (coverage allgathers): every rank must pass
+        the same ``light``."""
+        ws = self.fabric.wire_stats()
+        prev_tick, prev_wire, prev_raw = self._journal_prev
+        wire_d = ws["bytes_sent"] - prev_wire
+        raw_d = ws["raw_bytes_sent"] - prev_raw
+        self._journal_prev = (self.tick, ws["bytes_sent"], ws["raw_bytes_sent"])
         rec = {
             "tick": self.tick,
             "coverage": round(self.coverage(), 6),
-            "digest": self.state_digest(),
+            **({} if light else {"digest": self.state_digest()}),
             "process_count": self.nprocs,
             "process_id": self.rank,
-            "fabric_bytes_sent": self.fabric.bytes_sent,
-            "fabric_bytes_recv": self.fabric.bytes_recv,
+            "fabric_bytes_sent": ws["bytes_sent"],
+            "fabric_bytes_recv": ws["bytes_recv"],
+            "fabric_raw_sent": ws["raw_bytes_sent"],
+            "fabric_raw_recv": ws["raw_bytes_recv"],
+            "fabric_ticks_delta": self.tick - prev_tick,
+            "fabric_wire_sent_delta": wire_d,
+            "fabric_raw_sent_delta": raw_d,
+            # raw/wire over the interval; 1.0 when nothing crossed (P=1)
+            "fabric_codec_ratio": round(raw_d / wire_d, 4) if wire_d else 1.0,
+            "fabric_codec_counts": ws["codec_counts"],
+            "d2h_bytes": self.d2h_bytes,
         }
         return rec
 
@@ -472,17 +617,37 @@ class MultihostDelta:
         )
         shardings = named_shardings(example, self._snapshot_mesh())
         gstate = load_state_orbax(path, example, shardings=shardings)
-        local = host_gather(gstate)
+        self._install_block_state(host_gather(gstate))
+        self.fabric.barrier(f"restore-done-{self.tick}")
+        return self
+
+    def _install_block_state(self, local) -> None:
+        """Adopt a restored LOCAL block of DeltaState (tick included) and
+        reset the wire-codec streams: any XOR-delta payload history
+        predates the restore — and the restoring fabric may run a
+        DIFFERENT process count than the saver — so the epoch word bumps
+        on every rank here, turning a rank that skipped the reset into a
+        loud FabricError instead of silently decoded garbage."""
         self.learned = jnp.asarray(local.learned)
         self.pcount = jnp.asarray(local.pcount)
         self.ride_ok = jnp.asarray(local.ride_ok)
         self.key = jnp.asarray(local.key)
         self.tick = int(np.asarray(local.tick))
         self.converged = None
-        self.fabric.barrier(f"restore-done-{self.tick}")
-        return self
+        self.fabric.reset_codec_state()
+        # re-base the journal deltas too: the restored tick may sit
+        # BEFORE the last journaled tick (negative ticks_delta) and the
+        # restore-era traffic belongs to no wave interval
+        ws = self.fabric.wire_stats()
+        self._journal_prev = (self.tick, ws["bytes_sent"], ws["raw_bytes_sent"])
 
-    def run_until_converged(self, max_ticks: int = 10_000, sink=None, journal_every: int = 0):
+    def run_until_converged(
+        self,
+        max_ticks: int = 10_000,
+        sink=None,
+        journal_every: int = 0,
+        journal_light: bool = False,
+    ):
         """Step until the global AND plane reports convergence (checked
         every tick — the reduce words already cross the fabric, so the
         check is free).  Returns (ticks_used, converged).
@@ -491,14 +656,18 @@ class MultihostDelta:
         ticks plus one at exit.  Record building is COLLECTIVE (digest and
         coverage allgather across the fabric), so every rank must pass the
         same ``journal_every`` — ranks without a ``sink`` still take part
-        in the combine and simply drop the record."""
+        in the combine and simply drop the record.  ``journal_light``
+        makes the PERIODIC records skip the state digest (the per-tick
+        wire wave's mode — see :meth:`journal_record`); the exit record
+        is always full."""
         start = self.tick
         emitted_at = None
         while self.tick - start < max_ticks:
             self.step()
             done = bool(self.converged)
             if journal_every and (((self.tick - start) % journal_every == 0) or done):
-                rec = self.journal_record()  # collective on every rank
+                # collective on every rank; the final record is full
+                rec = self.journal_record(light=journal_light and not done)
                 emitted_at = self.tick
                 if sink is not None:
                     sink(rec)

@@ -112,6 +112,24 @@ def row_mask(rows: jax.Array) -> jax.Array:
     return jnp.where(rows, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))[..., None]
 
 
+def nonzero_rows(p: jax.Array) -> jax.Array:
+    """[N, ...] -> bool[N]: rows carrying ANY nonzero element — the
+    send-side summary of the r15 wire codec's zero-row suppression
+    (``parallel/fabric`` ROWS encoding).  Shard-local/elementwise along
+    the node axis by construction; the trailing axes reduce in-row.
+    INTEGER planes only as a codec summary: the test is value-level, so
+    float -0.0 would read as a zero row while its bytes are not (the
+    host-side ``fabric._rows_encode`` masks the byte view instead)."""
+    return jnp.any(p.reshape(p.shape[0], -1) != 0, axis=-1)
+
+
+def popcount_rows(p: jax.Array) -> jax.Array:
+    """uint32[N, W] -> uint32[N]: per-row set-bit count (each row's is
+    ≤ 32·W so uint32 never wraps; callers doing GLOBAL sums chunk and
+    fold in wider host arithmetic — the r14 headroom rule)."""
+    return jax.lax.population_count(p).sum(axis=-1, dtype=jnp.uint32)
+
+
 # node-axis block count for the row reduces: reduce WITHIN each of G
 # contiguous blocks first (slices along the unpartitioned in-block axis —
 # shard-local under SPMD), then combine the G block results (G×W words of

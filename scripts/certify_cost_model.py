@@ -50,6 +50,14 @@ story"):
   dispatch on a real chip should be orders beyond) — less than 2x or
   any bit-inequality REFUTES the serve-tier premise.
 
+- (r15) the DCN wire codec A/B: ``dcn_wire`` — unlike every item above
+  this is NOT behind the TPU gate (fabric bytes + wall-clock are host
+  measurements), so the judge reads the committed SIMBENCH_r09.json
+  artifact directly and runs even with no ksweep capture on disk.  The
+  wire model says sparsity-aware encoding moves >= 2x fewer MB/tick/host
+  than raw frames averaged over the run at no wall-clock cost;
+  bit-unequal digests or slower-than-raw REFUTES the codec.
+
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
 """
@@ -107,11 +115,69 @@ def newest_ksweep() -> str | None:
     return p if os.path.exists(p) else None
 
 
+def judge_dcn_wire():
+    """The r15 wire-codec verdict from the committed SIMBENCH_r09.json —
+    host-certifiable, so it is judged with or without a ksweep capture.
+    Returns a (name, ok, detail) verdict tuple, or None when the
+    artifact does not exist."""
+    path = os.path.join(REPO, "SIMBENCH_r09.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return ("dcn wire codec A/B", None, f"unreadable SIMBENCH_r09.json: {e}")
+    sc = next(
+        (s for s in data.get("scenarios", [])
+         if str(s.get("metric", "")).startswith("dcn_wire")),
+        None,
+    )
+    if sc is None:
+        return ("dcn wire codec A/B", None,
+                "SIMBENCH_r09.json carries no dcn_wire scenario")
+    ratio, wall = sc.get("wire_ratio"), sc.get("wall_ratio_on_over_off")
+    ok = (
+        bool(sc.get("digests_equal")) and bool(sc.get("twin_certified"))
+        and ratio is not None and ratio >= 2.0
+        and wall is not None and wall <= 1.05
+    )
+    return (
+        f"dcn wire codec A/B (n={sc.get('n_nodes')}, P=2)",
+        ok,
+        f"wire {sc.get('wire_mb_per_tick_on')} vs raw "
+        f"{sc.get('wire_mb_per_tick_off')} MB/tick/host = {ratio}x "
+        f"(dissemination phase {sc.get('dissemination_ratio')}x), "
+        f"wall on/off {wall} (<= 1.05 required), "
+        f"digests_equal={sc.get('digests_equal')} "
+        f"twin_certified={sc.get('twin_certified')}",
+    )
+
+
+def _print_solo(dw) -> int:
+    """Render the dcn_wire verdict when no on-chip capture is judgeable
+    (the r15 claim is host-level, so it never waits on the TPU gate)."""
+    if dw is None:
+        return 1
+    name, ok, detail = dw
+    mark = "?" if ok is None else ("CERTIFIES" if ok else "REFUTES  ")
+    print(f"  [{mark}] {name}: {detail}")
+    if ok is False:
+        print("VERDICT: SIMBENCH_r09.json REFUTES the dcn_wire model")
+        return 2
+    if ok:
+        print("VERDICT: dcn_wire CERTIFIES (on-chip model still unjudged)")
+        return 0
+    return 1
+
+
 def main() -> int:
+    dw = judge_dcn_wire()
     path = sys.argv[1] if len(sys.argv) > 1 else newest_ksweep()
     if not path:
         print("no ksweep capture found (run make tpu-watch and wait for a window)")
-        return 1
+        rc = _print_solo(dw)
+        return rc
     try:
         with open(path) as f:
             cap = json.load(f)
@@ -124,10 +190,15 @@ def main() -> int:
     print(f"  platform={cap.get('platform')} git_head={str(cap.get('git_head'))[:12]} "
           f"dirty={cap.get('git_dirty')} at={cap.get('captured_at')}")
     if cap.get("platform") == "cpu":
-        print("  CPU capture — the model under test is the on-chip one; nothing to certify")
-        return 1
+        # same knowledge state as "no capture": the on-chip model is
+        # unjudgeable, only the host-level dcn_wire claim decides rc
+        print("  CPU capture — the on-chip model is unjudgeable from it; "
+              "only the host-level dcn_wire claim can be certified")
+        return _print_solo(dw)
 
     verdicts = []
+    if dw is not None:
+        verdicts.append(dw)
 
     for k_str, tc in (cap.get("tick_cost") or {}).items():
         if "ms_per_tick_median" not in tc:

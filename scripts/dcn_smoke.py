@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""DCN wire-codec CI gate (r15, < 30 s, 2-core container).
+
+Tiny codec A/B over the host-bridged fabric — 2 in-process ranks (LocalKV
+threads; the same fabric code path the OS-process runs take) stepping one
+seeded delta scenario to convergence, once with the r15 wire codec and
+once shipping raw frames:
+
+1. **digests equal** — codec-on == codec-off == the in-process engine's
+   ``telemetry.tree_digest`` (the codec is bit-transparent or it is
+   wrong);
+2. **bytes strictly lower during dissemination** — the codec run's wire
+   bytes must undercut the raw run's cumulatively AND on every early
+   (dissemination-phase) tick interval, where the ride-masked planes are
+   sparsest;
+3. **raw fallback exercised** — at least one array in the codec run must
+   have shipped RAW (the measured fallback is a live code path, not dead
+   armor), alongside at least one compressed encoding;
+4. **pieces-only device→host** — the exchange legs' d2h accounting stays
+   under the pre-r15 full-plane floor.
+
+Exit 0 = certified; any assertion prints and exits 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_SCRIPTS))
+
+T0 = time.perf_counter()
+N, K, SEED, NPROCS, MAX_TICKS = 4096, 64, 17, 2, 512
+
+
+def _run(codec: bool):
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta import DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=N, k=K, rng="counter")
+    kv = LocalKV()
+    out = [None] * NPROCS
+    errs = []
+
+    def run(rank):
+        try:
+            with Fabric(rank, NPROCS, kv, namespace=f"dcn{int(codec)}",
+                        codec=codec) as fab:
+                mh = MultihostDelta(params, fab, seed=SEED)
+                per_tick = []
+                for _ in range(MAX_TICKS):
+                    mh.step()
+                    per_tick.append(mh.journal_record())
+                    if mh.converged:
+                        break
+                out[rank] = (per_tick, mh.d2h_bytes, fab.wire_stats())
+        except BaseException as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(NPROCS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(240)
+    if errs:
+        raise errs[0]
+    assert all(o is not None for o in out), "a rank hung"
+    return out
+
+
+def main() -> int:
+    import jax
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
+    from ringpop_tpu.sim.packbits import n_words
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    on = _run(codec=True)
+    off = _run(codec=False)
+
+    # 1. digest chain: every rank, both modes, == engine
+    params = DeltaParams(n=N, k=K, rng="counter")
+    st = init_state(params, seed=SEED)
+    stp = jax.jit(functools.partial(step, params))
+    ticks_on = len(on[0][0])
+    for _ in range(ticks_on):
+        st = stp(st, DeltaFaults())
+    anchor = int(tree_digest(st))
+    d_on = {pt[-1]["digest"] for pt, _, _ in on}
+    d_off = {pt[-1]["digest"] for pt, _, _ in off}
+    assert len(on[0][0]) == len(off[0][0]), "codec changed the tick count"
+    assert d_on == d_off == {anchor}, (
+        f"digest chain broken: codec-on {d_on}, codec-off {d_off}, "
+        f"engine {anchor}"
+    )
+    print(f"digests OK: codec-on == codec-off == engine {anchor} "
+          f"({ticks_on} ticks)")
+
+    # 2. bytes strictly lower — cumulatively and per dissemination tick
+    wire_on = on[0][2]["bytes_sent"]
+    wire_off = off[0][2]["bytes_sent"]
+    assert wire_on < wire_off, (wire_on, wire_off)
+    dissem = max(2, ticks_on // 2)
+    for t in range(dissem):
+        a = on[0][0][t]["fabric_wire_sent_delta"]
+        b = off[0][0][t]["fabric_wire_sent_delta"]
+        assert a < b, f"tick {t}: codec {a} B not below raw {b} B"
+    ratio = on[0][2]["raw_bytes_sent"] / wire_on
+    print(f"bytes OK: wire {wire_on} < raw-mode {wire_off} "
+          f"(codec ratio {ratio:.2f}x, every dissemination tick lower)")
+
+    # 3. measured raw fallback is a live path
+    counts = on[0][2]["codec_counts"]
+    assert counts.get("raw", 0) >= 1, f"raw fallback never taken: {counts}"
+    assert sum(v for k, v in counts.items() if k != "raw") >= 1, counts
+    print(f"codec mix OK: {counts}")
+
+    # 4. pieces-only device→host (the acceptance floor)
+    plane_nbytes = (N // NPROCS) * n_words(K) * 4
+    floor = 2 * ticks_on * plane_nbytes
+    for pt, d2h, _ in on:
+        assert 0 < d2h < floor, (d2h, floor)
+    print(f"d2h OK: {on[0][1]} B < full-plane floor {floor} B")
+
+    print(f"dcn-smoke PASS in {time.perf_counter() - T0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"dcn-smoke FAIL: {e}", file=sys.stderr)
+        sys.exit(1)

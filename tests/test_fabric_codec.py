@@ -1,0 +1,324 @@
+"""r15 wire-codec suite: the fabric's self-describing per-array codec
+(RAW / ROWS / RUNS / XOR-delta) must round-trip EXACTLY for every dtype
+and adversarial shape the fabric ships, pick only strictly-smaller
+encodings (the measured raw fallback), and carry an epoch word that turns
+a missed XOR reset (snapshot restore / peer-count change) into a loud
+error.  Plus the r15 fabric-robustness fix: a dead or silent peer
+surfaces as a typed fabric error with rank/peer context — never a hang,
+and never mistakable for a tag desync.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.parallel.fabric import (
+    CODEC_RAW,
+    CODEC_ROWS,
+    CODEC_RUNS,
+    CODEC_XOR,
+    Encoded,
+    Fabric,
+    FabricError,
+    FabricPeerLost,
+    FabricTimeout,
+    LocalKV,
+    decode_array,
+    encode_array,
+    encode_rows,
+    rows_wire_size,
+)
+
+# every dtype the fabric ships today (uint32 planes, int8 pcount, bool
+# masks, int64 coverage counts, float32 rates) plus paranoia extras
+DTYPES = [np.uint32, np.int8, np.uint8, np.int32, np.int64, np.float32, bool]
+
+
+def _roundtrip(a, prev=None, epoch=0):
+    e = encode_array(a, prev=prev, epoch=epoch)
+    d = decode_array(e.codec, e.dtype, e.shape, e.payload, prev=prev, epoch=epoch)
+    ref = np.ascontiguousarray(a)
+    assert d.dtype == ref.dtype and d.shape == ref.shape
+    # BIT equality, unconditionally: value-equality would wave through a
+    # canonicalizing codec (float -0.0 → +0.0) that breaks digest parity
+    assert d.tobytes() == ref.tobytes(), "round trip not bit-exact"
+    assert len(e.payload) <= e.raw_nbytes, "codec grew the payload"
+    return e
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_roundtrip_adversarial_planes_every_dtype(dtype):
+    rng = np.random.default_rng(7)
+    planes = [
+        np.zeros((33, 5), dtype),  # all-zero
+        np.ones((33, 5), dtype),  # all-ones
+        np.zeros((1, 4), dtype),  # single row
+        np.zeros((0, 4), dtype),  # empty
+        rng.integers(0, 2, (64, 3)).astype(dtype),  # random sparse
+        np.eye(17).astype(dtype),  # diagonal
+    ]
+    one_hot = np.zeros((257, 3), dtype)
+    one_hot[128] = np.ones(3, dtype)
+    planes.append(one_hot)
+    if np.dtype(dtype) == np.float32:
+        # bit-distinct-but-value-zero and NaN rows: the ROWS mask must
+        # work on the byte view or these rows canonicalize
+        tricky = np.zeros((64, 3), np.float32)
+        tricky[7] = -0.0
+        tricky[9] = np.nan
+        planes.append(tricky)
+    for a in planes:
+        _roundtrip(a)
+
+
+def test_roundtrip_random_property_sweep():
+    rng = np.random.default_rng(11)
+    for trial in range(120):
+        dtype = DTYPES[trial % len(DTYPES)]
+        rows = int(rng.integers(1, 80))
+        cols = int(rng.integers(1, 9))
+        density = rng.choice([0.0, 0.02, 0.3, 1.0])
+        a = (rng.random((rows, cols)) < density) * rng.integers(
+            1, 100, (rows, cols)
+        )
+        a = a.astype(dtype)
+        prev = None
+        if trial % 3 == 0:
+            flips = (rng.random((rows, cols)) < 0.05).astype(dtype)
+            prev = np.ascontiguousarray((a + flips).astype(dtype)).tobytes()
+        _roundtrip(a, prev=prev, epoch=trial)
+
+
+def test_measured_fallbacks_pick_the_smallest_encoding():
+    rng = np.random.default_rng(3)
+    # dense random: nothing pays -> RAW
+    dense = rng.integers(1, 2**32, (64, 4), dtype=np.uint32)
+    assert encode_array(dense).codec == CODEC_RAW
+    # scattered dense-random rows: ROWS beats RUNS and raw
+    plane = np.zeros((1000, 4), np.uint32)
+    plane[rng.choice(1000, 100, replace=False)] = rng.integers(
+        1, 2**32, (100, 4), dtype=np.uint32
+    )
+    assert encode_array(plane).codec == CODEC_ROWS
+    # dense-but-patchy columns: every row nonzero, zero-word runs inside
+    patchy = rng.integers(1, 2**32, (64, 8), dtype=np.uint32)
+    patchy[:, 2:7] = 0
+    assert encode_array(patchy).codec == CODEC_RUNS
+    # one nonzero row: RUNS undercuts even ROWS (no per-row bitmap cost)
+    lone = np.zeros((1000, 4), np.uint32)
+    lone[7] = 9
+    e = encode_array(lone)
+    assert e.codec == CODEC_RUNS and len(e.payload) < 64
+
+
+def test_encode_rows_is_wire_identical_to_host_encoder():
+    """The device-sourced pre-encoding (mask + compacted rows) must
+    produce byte-identical frames to the host-side chooser's ROWS path."""
+    rng = np.random.default_rng(5)
+    plane = np.zeros((200, 2), np.uint32)
+    plane[rng.choice(200, 40, replace=False)] = rng.integers(
+        1, 2**32, (40, 2), dtype=np.uint32
+    )
+    mask = (plane != 0).any(axis=1)
+    pre = encode_rows(mask, plane[mask], plane.shape, plane.dtype)
+    host = encode_array(plane)
+    assert host.codec == CODEC_ROWS
+    assert pre.payload == host.payload and pre.codec == host.codec
+    assert rows_wire_size(200, int(mask.sum()), 8) == len(pre.payload)
+
+
+def test_xor_epoch_desync_is_loud():
+    rng = np.random.default_rng(9)
+    a0 = rng.integers(1, 2**32, (64, 8), dtype=np.uint32)
+    a1 = a0.copy()
+    a1[3, 2] ^= 12345
+    e = encode_array(a1, prev=a0.tobytes(), epoch=4)
+    assert e.codec == CODEC_XOR
+    d = decode_array(e.codec, e.dtype, e.shape, e.payload, prev=a0.tobytes(), epoch=4)
+    assert np.array_equal(d, a1)
+    with pytest.raises(FabricError, match="epoch desync"):
+        decode_array(e.codec, e.dtype, e.shape, e.payload, prev=a0.tobytes(), epoch=5)
+    with pytest.raises(FabricError, match="epoch desync"):
+        decode_array(e.codec, e.dtype, e.shape, e.payload, prev=None, epoch=4)
+
+
+# -- the codec through a live fabric ------------------------------------------
+
+
+def _run_ranks(nprocs, body, ns, timeout_ms=120_000, codec=True, join_s=60):
+    """Spin nprocs threaded ranks over one LocalKV; each runs
+    ``body(fabric, rank)``; per-rank return values / exceptions out."""
+    kv = LocalKV()
+    out, errs = [None] * nprocs, [None] * nprocs
+
+    def run(rank):
+        try:
+            with Fabric(rank, nprocs, kv, namespace=ns, timeout_ms=timeout_ms,
+                        codec=codec) as fab:
+                out[rank] = body(fab, rank)
+        except BaseException as e:
+            errs[rank] = e
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(nprocs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_s)
+    assert not any(t.is_alive() for t in ts), "a rank hung past the join budget"
+    return out, errs
+
+
+def test_exchange_codec_roundtrip_and_stream_xor():
+    """Adversarial planes through a real 2-rank exchange: decode exact,
+    wire strictly below raw on compressible rounds, XOR engaging on a
+    shape-stable stream, and reset_codec_state re-certifying after an
+    epoch bump."""
+    rng = np.random.default_rng(21)
+    sparse = np.zeros((256, 4), np.uint32)
+    sparse[rng.choice(256, 10, replace=False)] = 7
+    dense = rng.integers(1, 2**32, (64, 4), dtype=np.uint32)
+
+    def body(fab, rank):
+        peer = 1 - rank
+        seen = []
+        for tick in range(4):
+            # the shape-stable stream: same plane + a 1-word mutation, so
+            # tick>0 sends can XOR against the previous payload
+            plane = sparse.copy()
+            plane[0, 0] = tick
+            got = fab.exchange(
+                100 + tick, {peer: [plane, dense]}, [peer], stream="s"
+            )
+            seen.append(got[peer])
+            if tick == 1:
+                fab.reset_codec_state()  # both ranks, same point
+        return seen, fab.wire_stats(), fab.codec_epoch
+
+    out, errs = _run_ranks(2, body, "codecrt")
+    assert errs == [None, None], errs
+    for seen, ws, epoch in out:
+        for tick, (p, d) in enumerate(seen):
+            ref = sparse.copy()
+            ref[0, 0] = tick
+            assert np.array_equal(p, ref) and np.array_equal(d, dense)
+        assert ws["bytes_sent"] < ws["raw_bytes_sent"]
+        # raw fallback exercised by the dense plane, compression by the rest
+        assert ws["codec_counts"].get("raw", 0) >= 1
+        assert sum(v for k, v in ws["codec_counts"].items() if k != "raw") >= 1
+        # engine-driven reset (tick==1) on top of the constructor state
+        assert epoch >= 1
+
+
+def test_codec_off_ships_raw_frames():
+    a = np.zeros((128, 4), np.uint32)
+
+    def body(fab, rank):
+        got = fab.exchange(5, {1 - rank: [a]}, [1 - rank])
+        return got[1 - rank][0], fab.wire_stats()
+
+    out, errs = _run_ranks(2, body, "codecoff", codec=False)
+    assert errs == [None, None], errs
+    for got, ws in out:
+        assert np.array_equal(got, a)
+        assert ws["bytes_sent"] == ws["raw_bytes_sent"]
+        assert set(ws["codec_counts"]) <= {"raw"}
+
+
+# -- fabric robustness: dead / silent peers (r15 satellite) -------------------
+
+
+def test_kill_one_rank_surfaces_peer_lost_not_hang():
+    """A rank dying mid-run must fail its peers' next receive with a
+    typed FabricPeerLost naming the peer — promptly, not at timeout_ms,
+    and distinguishable from a tag desync."""
+    rounds_before_death = 2
+
+    def body(fab, rank):
+        peers = [p for p in range(3) if p != rank]
+        for tick in range(6):
+            if rank == 2 and tick == rounds_before_death:
+                fab.close()  # simulated death: sockets gone mid-schedule
+                return "died"
+            fab.exchange(tick, {p: [np.arange(4, dtype=np.uint32)] for p in peers}, peers)
+        return "done"
+
+    t0 = time.monotonic()
+    out, errs = _run_ranks(3, body, "kill1", timeout_ms=30_000)
+    wall = time.monotonic() - t0
+    assert out[2] == "died"
+    for r in (0, 1):
+        assert isinstance(errs[r], FabricError), (r, errs[r], out[r])
+        assert "peer" in str(errs[r])
+        assert "desync" not in str(errs[r])
+    # the closed socket fails the read immediately — nowhere near the
+    # 30 s timeout budget (a hang-then-timeout would take >= 30 s)
+    assert wall < 20, wall
+
+
+def test_stalled_peer_surfaces_fabric_timeout():
+    """A live-but-silent peer (wedged, partitioned) must surface as
+    FabricTimeout at timeout_ms — the pre-r15 behavior on builds without
+    socket timeouts was an unbounded _recv_exact hang."""
+
+    def body(fab, rank):
+        payload = [np.arange(8, dtype=np.uint32)]
+        fab.exchange(0, {1 - rank: payload}, [1 - rank])
+        if rank == 1:
+            time.sleep(2.5)  # wedged: never sends round 1
+            return "stalled"
+        fab.exchange(1, {1 - rank: payload}, [1 - rank])
+        return "done"
+
+    out, errs = _run_ranks(2, body, "stall", timeout_ms=700)
+    assert out[1] == "stalled"
+    assert isinstance(errs[0], FabricTimeout), (errs[0], out[0])
+    assert "peer 1" in str(errs[0]) and "700 ms" in str(errs[0])
+
+
+def test_encoded_item_refused_on_streamed_round():
+    """A pre-encoded item on a STREAMED round would desync the two
+    sides' XOR payload histories under matching epochs (the sender has
+    no raw bytes to record) — the fabric must refuse loudly."""
+    e = Encoded(CODEC_RAW, np.dtype(np.uint32), (4,),
+                np.arange(4, dtype=np.uint32).tobytes(), 16)
+
+    def body(fab, rank):
+        fab.exchange(3, {1 - rank: [e]}, [1 - rank], stream="s")
+
+    out, errs = _run_ranks(2, body, "encstream", timeout_ms=5_000)
+    assert all(isinstance(x, ValueError) for x in errs), errs
+    assert all("streamed round" in str(x) for x in errs)
+
+
+def test_rows_false_skips_rows_attempt():
+    """encode_array(rows=False): the engine's device summary already
+    rejected ROWS — the host chooser must not re-scan for it (RUNS and
+    the raw fallback stay measured)."""
+    rng = np.random.default_rng(2)
+    plane = np.zeros((1000, 4), np.uint32)
+    plane[rng.choice(1000, 100, replace=False)] = rng.integers(
+        1, 2**32, (100, 4), dtype=np.uint32
+    )
+    assert encode_array(plane).codec == CODEC_ROWS
+    e = encode_array(plane, rows=False)
+    assert e.codec != CODEC_ROWS
+    d = decode_array(e.codec, e.dtype, e.shape, e.payload)
+    assert np.array_equal(d, plane)
+
+
+def test_encoded_passthrough_type():
+    """Encoded items pass the fabric untouched (the device-sourced hot
+    path) — also pinning the public tuple layout the engine builds."""
+    e = Encoded(CODEC_RAW, np.dtype(np.uint32), (2, 2),
+                np.arange(4, dtype=np.uint32).tobytes(), 16)
+
+    def body(fab, rank):
+        got = fab.exchange(9, {1 - rank: [e]}, [1 - rank])
+        return got[1 - rank][0]
+
+    out, errs = _run_ranks(2, body, "pass")
+    assert errs == [None, None], errs
+    for got in out:
+        assert np.array_equal(got, np.arange(4, dtype=np.uint32).reshape(2, 2))

@@ -86,7 +86,7 @@ def _engine_digest(params, faults, seed, ticks):
     return int(tree_digest(st)), st
 
 
-def _fabric_digests(params, faults, seed, ticks, nprocs, ns):
+def _fabric_digests(params, faults, seed, ticks, nprocs, ns, codec=True):
     from ringpop_tpu.parallel.fabric import Fabric, LocalKV
     from ringpop_tpu.sim.delta_multihost import MultihostDelta
 
@@ -96,11 +96,14 @@ def _fabric_digests(params, faults, seed, ticks, nprocs, ns):
 
     def run(rank):
         try:
-            with Fabric(rank, nprocs, kv, namespace=ns) as fab:
+            with Fabric(rank, nprocs, kv, namespace=ns, codec=codec) as fab:
                 mh = MultihostDelta(params, fab, seed=seed, faults=faults)
                 for _ in range(ticks):
                     mh.step()
-                out[rank] = (mh.state_digest(), mh.coverage(), mh.converged)
+                out[rank] = (
+                    mh.state_digest(), mh.coverage(), mh.converged,
+                    mh.d2h_bytes, fab.wire_stats(),
+                )
         except BaseException as e:  # surfaced below
             errs.append(e)
 
@@ -117,10 +120,13 @@ def _fabric_digests(params, faults, seed, ticks, nprocs, ns):
     return out
 
 
+@pytest.mark.parametrize("codec", [True, False], ids=["codec-on", "codec-off"])
 @pytest.mark.parametrize("nprocs", [1, 2, 4])
-def test_fabric_step_bit_identical_to_engine(nprocs):
+def test_fabric_step_bit_identical_to_engine(nprocs, codec):
     """The process-spanning step at P processes == delta.step, digest-
-    exact, under the full supported fault surface (victims + loss)."""
+    exact, under the full supported fault surface (victims + loss) —
+    codec-on AND codec-off (the r15 wire codec is bit-transparent by
+    construction; this is the dynamic certificate)."""
     import jax.numpy as jnp
 
     from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
@@ -130,10 +136,153 @@ def test_fabric_step_bit_identical_to_engine(nprocs):
     up[::9] = False
     faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(0.1))
     ref, _ = _engine_digest(params, faults, seed=4, ticks=10)
-    out = _fabric_digests(params, faults, 4, 10, nprocs, f"tw{nprocs}")
+    out = _fabric_digests(params, faults, 4, 10, nprocs, f"tw{nprocs}{int(codec)}",
+                          codec=codec)
     assert {o[0] for o in out} == {ref}
     # coverage is the exact popcount fraction — identical on every rank
     assert len({o[1] for o in out}) == 1
+
+
+@pytest.mark.parametrize("nprocs", [1, 2, 4])
+def test_exchange_d2h_is_pieces_only(nprocs):
+    """r15 acceptance pin: device→host transfer per exchange leg drops
+    from full-plane to pieces-only.  The pre-r15 engine materialized the
+    ENTIRE local plane on host once per leg (2·ticks·block·W·4 bytes);
+    the byte accounting must land strictly under that floor at P>1 and at
+    ZERO at P=1 (the window is a pure device gather there)."""
+    from ringpop_tpu.sim.delta import DeltaParams
+    from ringpop_tpu.sim.packbits import n_words
+
+    params = DeltaParams(n=256, k=64, rng="counter")
+    ticks = 8
+    out = _fabric_digests(params, None, 3, ticks, nprocs, f"d2h{nprocs}")
+    block = params.n // nprocs
+    plane_nbytes = block * n_words(params.k) * 4
+    old_floor = 2 * ticks * plane_nbytes  # full plane, once per leg
+    for digest, cov, conv, d2h, ws in out:
+        if nprocs == 1:
+            assert d2h == 0, d2h
+        else:
+            assert 0 < d2h < old_floor, (d2h, old_floor)
+            # and the wire itself compressed: raw strictly above wire
+            assert ws["bytes_sent"] < ws["raw_bytes_sent"]
+
+
+def test_journal_carries_per_tick_deltas_and_ratio():
+    """r15 observability satellite: journal records carry per-interval
+    wire/raw deltas and the codec ratio (OBSERVABILITY.md schema row), so
+    a journal can plot the dissemination-phase traffic wave."""
+    import threading as _t
+
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta import DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=128, k=64, rng="counter")
+    kv = LocalKV()
+    recs = [None, None]
+
+    def run(rank):
+        with Fabric(rank, 2, kv, namespace="jdelta") as fab:
+            mh = MultihostDelta(params, fab, seed=1)
+            per_tick = []
+            for t in range(6):
+                mh.step()
+                # alternate light/full records: light ones must skip the
+                # digest but keep coverage + the delta keys
+                per_tick.append(mh.journal_record(light=t % 2 == 0))
+            recs[rank] = per_tick
+
+    ts = [_t.Thread(target=run, args=(r,), daemon=True) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert all(r is not None for r in recs)
+    for per_tick in recs:
+        for t, rec in enumerate(per_tick):
+            assert rec["fabric_ticks_delta"] == 1
+            assert rec["fabric_wire_sent_delta"] > 0
+            assert rec["fabric_codec_ratio"] >= 1.0
+            assert rec["fabric_raw_sent_delta"] >= rec["fabric_wire_sent_delta"]
+            assert ("digest" in rec) == (t % 2 == 1), "light/full digest mix"
+            assert "coverage" in rec
+        # deltas telescope back to the cumulative counter
+        assert sum(r["fabric_wire_sent_delta"] for r in per_tick) == (
+            per_tick[-1]["fabric_bytes_sent"]
+        )
+
+
+def test_state_reinstall_across_process_counts_resets_codec_epoch():
+    """The r15 epoch lifecycle at the restore seam, across process
+    counts: a 2-process run's state re-installed onto a 4-process fabric
+    (the _install_block_state path snapshot restore uses) continues
+    digest-equal to an unbroken engine run, and the XOR-delta epoch is
+    forced to reset on every rank."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.parallel.partition import process_block
+    from ringpop_tpu.sim.delta import DeltaParams, DeltaState
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=256, k=64, rng="counter")
+    t1, t2, seed = 7, 5, 13
+
+    # phase 1: P=2 run, block states collected (threads share memory —
+    # this is the in-process analog of the block-sharded orbax save)
+    kv = LocalKV()
+    blocks = [None, None]
+
+    def run2(rank):
+        with Fabric(rank, 2, kv, namespace="xp2") as fab:
+            mh = MultihostDelta(params, fab, seed=seed)
+            for _ in range(t1):
+                mh.step()
+            blocks[rank] = jax.tree.map(np.asarray, mh._as_block_state())
+
+    ts = [threading.Thread(target=run2, args=(r,), daemon=True) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert all(b is not None for b in blocks)
+    glearned = np.concatenate([b.learned for b in blocks])
+    gpcount = np.concatenate([b.pcount for b in blocks])
+    gride = np.concatenate([b.ride_ok for b in blocks])
+
+    # phase 2: re-split onto a 4-process fabric and continue
+    kv4 = LocalKV()
+    out = [None] * 4
+
+    def run4(rank):
+        with Fabric(rank, 4, kv4, namespace="xp4") as fab:
+            mh = MultihostDelta(params, fab, seed=0)
+            epoch_before = fab.codec_epoch
+            lo, hi = process_block(params.n, rank, 4)
+            mh._install_block_state(
+                DeltaState(
+                    learned=glearned[lo:hi], pcount=gpcount[lo:hi],
+                    ride_ok=gride[lo:hi], tick=blocks[0].tick,
+                    key=blocks[0].key,
+                )
+            )
+            assert fab.codec_epoch > epoch_before, "epoch not reset"
+            for _ in range(t2):
+                mh.step()
+            out[rank] = mh.state_digest()
+
+    ts = [threading.Thread(target=run4, args=(r,), daemon=True) for r in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert len(set(out)) == 1 and out[0] is not None
+
+    from ringpop_tpu.sim.delta import DeltaFaults
+
+    ref, _ = _engine_digest(params, DeltaFaults(), seed, t1 + t2)
+    assert out[0] == ref
 
 
 def test_fabric_convergence_matches_engine():
