@@ -59,11 +59,15 @@ serve-smoke:
 multihost-smoke:
 	$(PY) scripts/multihost_smoke.py
 
-# DCN wire-codec gate (r15): tiny 2-rank codec A/B over the fabric —
-# codec-on digests == codec-off == engine, wire bytes strictly lower on
-# every dissemination tick, the measured RAW fallback exercised, and
-# exchange-leg device→host transfer pinned under the pre-r15
-# full-plane-per-leg floor (pieces-only).
+# DCN wire-codec + exchange-schedule gate (r15/r16): tiny codec A/B over
+# the fabric — codec-on digests == codec-off == engine, wire bytes
+# strictly lower on every dissemination tick, the measured RAW fallback
+# exercised, exchange-leg device→host transfer pinned under the pre-r15
+# full-plane-per-leg floor (pieces-only) — plus the r16 grid: every
+# (swing|cyclic) x (overlap on|off) combination at P=2 and the P=4 swing
+# relay leg land the SAME engine digest, the drain/overlap journal keys
+# are present, P=2 swing wire bytes == cyclic exactly (the schedule
+# degenerates) and the P=4 relay overhead is visible in raw accounting.
 dcn-smoke:
 	$(PY) scripts/dcn_smoke.py
 

@@ -574,6 +574,44 @@ def build_entrypoints(mesh=None) -> dict:
             lambda pl, ix: delta_multihost._k_rows_gather(pl, ix)
         )(mh_plane, jnp.arange(16, dtype=jnp.int32))
 
+        # the r16 addition: the engine's shard-local kernel quartet A–D
+        # (the programs the cross-tick overlap runs UNDER the draining
+        # wire) traced with the full supported fault surface (victims +
+        # loss).  Per-process, outside any mesh — 32-bit, callback-free,
+        # and censused collective-free in run_hlo_checks like the window
+        # programs above.
+        mh_params = delta.DeltaParams(n=_N, k=_K, rng="counter")
+        mh_key = jnp.zeros((2,), jnp.uint32)
+        mh_up = jnp.ones((_N,), bool)
+        mh_bool = jnp.ones((_N,), bool)
+        mh_pcount = jnp.zeros((_N, _K), jnp.int8)
+        mh_words = jnp.zeros((_n_words(_K),), jnp.uint32)
+        out["mh_kernel_sent"] = jax.make_jaxpr(
+            lambda L, R, key, t, lo, up, dr: delta_multihost._k_sent(
+                mh_params, L, R, key, t, lo, up, dr,
+                has_up=True, has_drop=True,
+            )
+        )(mh_plane, mh_plane, mh_key, jnp.int32(3), jnp.int32(0), mh_up,
+          jnp.float32(0.1))
+        out["mh_kernel_merge"] = jax.make_jaxpr(
+            lambda L, R, I, key, t, lo, s, up, dr: delta_multihost._k_merge(
+                mh_params, L, R, I, key, t, lo, s, up, dr,
+                has_up=True, has_drop=True,
+            )
+        )(mh_plane, mh_plane, mh_plane, mh_key, jnp.int32(3), jnp.int32(0),
+          jnp.int32(5), mh_up, jnp.float32(0.1))
+        out["mh_kernel_counters"] = jax.make_jaxpr(
+            lambda L, L1, Rs, c, gp, ri, pc, up: delta_multihost._k_counters(
+                mh_params, L, L1, Rs, c, gp, ri, pc, up, has_up=True
+            )
+        )(mh_plane, mh_plane, mh_plane, mh_bool, mh_bool, mh_plane,
+          mh_pcount, mh_up)
+        out["mh_kernel_finish"] = jax.make_jaxpr(
+            lambda L2, pm, mr, fw, rw: delta_multihost._k_finish(
+                mh_params, L2, pm, mr, fw, rw
+            )
+        )(mh_plane, mh_pcount, mh_plane, mh_words, mh_words)
+
     # the chaos-enabled steps: the same engines driven by a time-varying
     # FaultPlan with every leg populated — fault evaluation (the
     # fault-plan phase) must stay collective-free (RPJ203/RPJ206) and the
@@ -856,6 +894,43 @@ def run_hlo_checks() -> list[Finding]:
     findings += check_hlo_collective_free("mh_window_slice[hlo,dense]", slice_text)
     findings += check_hlo_collective_free("mh_window_summary[hlo,dense]", summary_text)
     findings += check_hlo_collective_free("mh_rows_gather[hlo,dense]", gather_text)
+
+    # r16: the engine's shard-local kernel quartet A–D compiled dense —
+    # the programs the cross-tick overlap runs while the wire drains.
+    # They execute per-process OUTSIDE the mesh (the fabric carries the
+    # only cross-process data), so a collective in any of them would be
+    # a layering bug: censused zero like the window programs.
+    from ringpop_tpu.sim import delta as _delta
+
+    mh_params = _delta.DeltaParams(n=_HLO_N, k=_K, rng="counter")
+    mh_key = jnp.zeros((2,), jnp.uint32)
+    mh_up = jnp.ones((_HLO_N,), bool)
+    mh_bool = jnp.ones((_HLO_N,), bool)
+    mh_pcount = jnp.zeros((_HLO_N, _K), jnp.int8)
+    mh_words = jnp.zeros((_n_words(_K),), jnp.uint32)
+    with _no_compile_cache():
+        kernel_texts = {
+            "mh_kernel_sent": delta_multihost._k_sent.lower(
+                mh_params, mh_plane, mh_plane, mh_key, jnp.int32(3),
+                jnp.int32(0), mh_up, jnp.float32(0.1),
+                has_up=True, has_drop=True,
+            ).compile().as_text(),
+            "mh_kernel_merge": delta_multihost._k_merge.lower(
+                mh_params, mh_plane, mh_plane, mh_plane, mh_key,
+                jnp.int32(3), jnp.int32(0), jnp.int32(5), mh_up,
+                jnp.float32(0.1), has_up=True, has_drop=True,
+            ).compile().as_text(),
+            "mh_kernel_counters": delta_multihost._k_counters.lower(
+                mh_params, mh_plane, mh_plane, mh_plane, mh_bool, mh_bool,
+                mh_plane, mh_pcount, mh_up, has_up=True,
+            ).compile().as_text(),
+            "mh_kernel_finish": delta_multihost._k_finish.lower(
+                mh_params, mh_plane, mh_pcount, mh_plane, mh_words,
+                mh_words,
+            ).compile().as_text(),
+        }
+    for kname, ktext in kernel_texts.items():
+        findings += check_hlo_collective_free(f"{kname}[hlo,dense]", ktext)
     return findings
 
 

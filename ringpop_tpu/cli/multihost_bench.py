@@ -72,6 +72,18 @@ def main(argv=None) -> int:
         "'off' ships raw frames — the A/B baseline the dcn_wire scenario "
         "certifies against",
     )
+    p.add_argument(
+        "--schedule", choices=["cyclic", "swing"], default="cyclic",
+        help="r16 window-exchange schedule: 'cyclic' direct sends (r14) "
+        "or 'swing' distance-halving relay rounds (power-of-two P; the "
+        "relay bytes are priced in the fabric accounting)",
+    )
+    p.add_argument(
+        "--overlap", choices=["on", "off"], default="off",
+        help="r16 cross-tick pipelining: sends drain on persistent fabric "
+        "threads while the next tick's shard-local kernels run; 'off' is "
+        "the blocking r15 semantics — the A/B baseline",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -106,9 +118,13 @@ def main(argv=None) -> int:
             kw["drop_rate"] = jnp.float32(args.drop)
         faults = DeltaFaults(**kw)
 
+    engine_kw = dict(
+        seed=args.seed, faults=faults,
+        schedule=args.schedule, overlap=args.overlap == "on",
+    )
     t0 = time.perf_counter()
     if args.leg == "twin":
-        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        mh = MultihostDelta(params, fabric, **engine_kw)
         for _ in range(args.ticks):
             mh.step()
         _emit(
@@ -120,7 +136,7 @@ def main(argv=None) -> int:
             }
         )
     elif args.leg == "converge":
-        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        mh = MultihostDelta(params, fabric, **engine_kw)
         sink = (lambda rec: _emit({"kind": "block", **rec}))
         ticks, ok = mh.run_until_converged(
             max_ticks=args.max_ticks, sink=sink, journal_every=args.journal_every,
@@ -152,6 +168,11 @@ def main(argv=None) -> int:
                 "fabric_codec_counts": ws["codec_counts"],
                 "d2h_bytes": mh.d2h_bytes,
                 "codec": args.codec,
+                "schedule": args.schedule,
+                "overlap": args.overlap == "on",
+                # cumulative blocked-per-leg + hidden-drain wall (r16
+                # observability; per-interval deltas ride the journal)
+                **mh.leg_timing(),
                 "process_count": nprocs,
                 "process_id": rank,
                 "n": args.n,
@@ -159,7 +180,7 @@ def main(argv=None) -> int:
             }
         )
     elif args.leg == "snapshot-save":
-        mh = MultihostDelta(params, fabric, seed=args.seed, faults=faults)
+        mh = MultihostDelta(params, fabric, **engine_kw)
         for _ in range(args.ticks):
             mh.step()
         mh.save_snapshot(args.path)
@@ -173,7 +194,10 @@ def main(argv=None) -> int:
             }
         )
     elif args.leg == "snapshot-restore":
-        mh = MultihostDelta.restore_snapshot(args.path, params, fabric, faults=faults)
+        mh = MultihostDelta.restore_snapshot(
+            args.path, params, fabric, faults=faults,
+            schedule=args.schedule, overlap=args.overlap == "on",
+        )
         restored_digest = mh.state_digest()
         for _ in range(args.extra_ticks):
             mh.step()

@@ -56,6 +56,7 @@ Scale auto-shrinks on CPU hosts (full sizes on an accelerator or with
 from __future__ import annotations
 
 import argparse
+import functools as _functools
 import json
 import time
 
@@ -1704,6 +1705,57 @@ def bench_multihost16m(seed: int, full: bool) -> dict:
     }
 
 
+# the canonical engine-anchored twin scenario every fabric A/B certifies
+# against — ONE definition, so the dcn_wire and swing_overlap artifacts
+# cannot drift onto different anchors
+_MH_TWIN = {"n": 65536, "k": 64, "ticks": 24, "victims": 64, "drop": 0.05}
+
+
+def _mh_launch():
+    """The multihost launcher + worker argv base shared by the fabric
+    scenarios (one spawn path: scripts/multihost_launch.py)."""
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))), "scripts"))
+    from multihost_launch import launch
+
+    return launch, ["-m", "ringpop_tpu.cli.multihost_bench"]
+
+
+def _mh_twin_common(seed: int) -> list:
+    t = _MH_TWIN
+    return ["--n", str(t["n"]), "--k", str(t["k"]), "--seed", str(seed),
+            "--victims", str(t["victims"]), "--drop", str(t["drop"])]
+
+
+@_functools.lru_cache(maxsize=None)
+def _mh_twin_anchor(seed: int) -> int:
+    """The in-process engine digest of the canonical twin scenario —
+    cached so a run covering both fabric scenarios computes it once."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
+    from ringpop_tpu.sim.telemetry import tree_digest
+
+    t = _MH_TWIN
+    params = DeltaParams(n=t["n"], k=t["k"], rng="counter")
+    rng = np.random.default_rng(seed + 999)
+    up = np.ones(t["n"], bool)
+    up[rng.choice(t["n"], size=t["victims"], replace=False)] = False
+    st = init_state(params, seed=seed)
+    stp = jax.jit(functools.partial(step, params))
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(t["drop"]))
+    for _ in range(t["ticks"]):
+        st = stp(st, faults)
+    return int(tree_digest(st))
+
+
 def bench_dcn_wire(seed: int, full: bool) -> dict:
     """r15: the sparsity-aware wire codec A/B over the host-bridged DCN
     fabric (``parallel/fabric`` ROWS/RUNS/XOR codec + device-side window
@@ -1725,26 +1777,16 @@ def bench_dcn_wire(seed: int, full: bool) -> dict:
        raw, digests bit-identical.  ``certify_cost_model``'s ``dcn_wire``
        judge refutes on any violation.
     """
-    import functools
-    import os as _os
-    import sys as _sys
-
-    import numpy as np
-
-    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
-        _os.path.dirname(_os.path.abspath(__file__)))), "scripts"))
-    from multihost_launch import launch
-
-    base = ["-m", "ringpop_tpu.cli.multihost_bench"]
+    launch, base = _mh_launch()
 
     # -- leg 1: engine-anchored twin, codec on vs off ------------------------
-    tn, tk, tticks, victims, drop = 65536, 64, 24, 64, 0.05
-    common = ["--n", str(tn), "--k", str(tk), "--seed", str(seed),
-              "--victims", str(victims), "--drop", str(drop)]
+    common = _mh_twin_common(seed)
     twin = {}
     for codec in ("on", "off"):
         ranks = launch(
-            2, base + ["twin", *common, "--ticks", str(tticks), "--codec", codec],
+            2,
+            base + ["twin", *common, "--ticks", str(_MH_TWIN["ticks"]),
+                    "--codec", codec],
             timeout_s=900,
         )
         recs = [r["records"][-1] for r in ranks]
@@ -1752,22 +1794,7 @@ def bench_dcn_wire(seed: int, full: bool) -> dict:
             "digest": recs[0]["digest"],
             "ranks_agree": len({r["digest"] for r in recs}) == 1,
         }
-    import jax
-    import jax.numpy as jnp
-
-    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams, init_state, step
-    from ringpop_tpu.sim.telemetry import tree_digest
-
-    tparams = DeltaParams(n=tn, k=tk, rng="counter")
-    rng = np.random.default_rng(seed + 999)
-    up = np.ones(tn, bool)
-    up[rng.choice(tn, size=victims, replace=False)] = False
-    st = init_state(tparams, seed=seed)
-    stp = jax.jit(functools.partial(step, tparams))
-    tfaults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(drop))
-    for _ in range(tticks):
-        st = stp(st, tfaults)
-    engine_digest = int(tree_digest(st))
+    engine_digest = _mh_twin_anchor(seed)
     twin_certified = all(
         v["ranks_agree"] and v["digest"] == engine_digest for v in twin.values()
     )
@@ -1865,6 +1892,180 @@ def bench_dcn_wire(seed: int, full: bool) -> dict:
     }
 
 
+def bench_swing_overlap(seed: int, full: bool) -> dict:
+    """r16: the exchange-schedule + cross-tick-pipelining A/B over the
+    host-bridged DCN fabric (``plan_window_swing`` distance-halving
+    relays + ``exchange_async`` completions in ``parallel/fabric``,
+    ``schedule=``/``overlap=`` on ``sim/delta_multihost``).  Host-level
+    like ``dcn_wire`` — NOT behind the TPU gate (the real-pod DCN pricing
+    of the same schedules is the ksweep ``swing_exchange`` section).
+
+    Three legs, all recorded:
+
+    1. **twin** — the engine-anchored scenario (65536 nodes, victims +
+       loss) at P=2 under every (schedule, overlap) combination: every
+       digest must equal the in-process engine's (both knobs are
+       bit-transparent by construction; this certifies it at artifact
+       scale).
+    2. **overlap A/B** — delta convergence at P=2 (1M full / 256k
+       smoke), cyclic schedule, overlap on vs off, reps INTERLEAVED
+       (off/on/off/on/...) so container drift hits both sides:
+       digests bit-identical, per-tick journals carry the r16
+       drain/overlap keys, and the pipelined min-of-reps wall must not
+       exceed the sequential one (overlap must not lose —
+       ``certify_cost_model``'s ``swing_overlap`` judge refutes).
+    3. **swing A/B** — P=4 convergence (256k full / 64k smoke), cyclic
+       vs swing: digests bit-identical, the relay overhead priced
+       explicitly (swing raw bytes / cyclic raw bytes — the extra hops
+       are REAL bytes on this mesh, the schedule's win is leg-count on a
+       physical ring), wall recorded and judged within noise of cyclic.
+    """
+    launch, base = _mh_launch()
+
+    # -- leg 1: engine-anchored twin grid ------------------------------------
+    common = _mh_twin_common(seed)
+    twin = {}
+    for schedule in ("cyclic", "swing"):
+        for overlap in ("off", "on"):
+            ranks = launch(
+                2,
+                base + ["twin", *common, "--ticks", str(_MH_TWIN["ticks"]),
+                        "--schedule", schedule, "--overlap", overlap],
+                timeout_s=900,
+            )
+            recs = [r["records"][-1] for r in ranks]
+            twin[f"{schedule}/{overlap}"] = {
+                "digest": recs[0]["digest"],
+                "ranks_agree": len({r["digest"] for r in recs}) == 1,
+                "leg_ms": recs[0]["fabric_leg_ms"],
+                "overlap_hidden_ms": recs[0]["overlap_hidden_ms"],
+            }
+    engine_digest = _mh_twin_anchor(seed)
+    twin_certified = all(
+        v["ranks_agree"] and v["digest"] == engine_digest for v in twin.values()
+    )
+
+    def _converge(n, nprocs, schedule, overlap, journal=True):
+        args = ["converge", "--n", str(n), "--k", "64", "--seed", str(seed),
+                "--max-ticks", "4096", "--schedule", schedule,
+                "--overlap", overlap]
+        if journal:
+            args += ["--journal-every", "1", "--journal-light"]
+        ranks = launch(nprocs, base + args, timeout_s=3600)
+        results = [
+            next(rec for rec in reversed(r["records"]) if rec["kind"] == "result")
+            for r in ranks
+        ]
+        blocks = [rec for rec in ranks[0]["records"] if rec["kind"] == "block"]
+        return results, blocks
+
+    # -- leg 2: overlap A/B (cross-tick pipelining must not lose) ------------
+    n2 = 1_048_576 if full else 262_144
+    reps = 5
+    # warm the persistent compile cache for both modes so the timed reps
+    # measure stepping, not XLA compiles (one untimed launch each)
+    _converge(n2, 2, "cyclic", "off", journal=False)
+    _converge(n2, 2, "cyclic", "on", journal=False)
+    ab: dict = {"sequential": {"walls": []}, "pipelined": {"walls": []}}
+    for rep in range(reps):
+        for mode, overlap in (("sequential", "off"), ("pipelined", "on")):
+            results, blocks = _converge(n2, 2, "cyclic", overlap)
+            r0 = results[0]
+            side = ab[mode]
+            side["walls"].append(max(r["wall_s"] for r in results))
+            side["digest"] = r0["digest"]
+            side["ranks_agree"] = len({r["digest"] for r in results}) == 1
+            side["ticks"] = r0["ticks"]
+            side["converged"] = r0["converged"]
+            side["leg_ms"] = r0["fabric_leg_ms"]
+            side["overlap_hidden_ms"] = r0["overlap_hidden_ms"]
+            side["wire_mb_per_tick"] = r0["fabric_mb_per_tick"]
+            side["journal_keys_present"] = bool(blocks) and all(
+                "fabric_leg_ms" in b and "overlap_hidden_ms" in b
+                and "schedule" in b
+                for b in blocks
+            )
+    for side in ab.values():
+        side["wall_min"] = min(side["walls"])
+        side["wall_median"] = sorted(side["walls"])[len(side["walls"]) // 2]
+    ab["digests_equal"] = bool(
+        ab["sequential"]["digest"] == ab["pipelined"]["digest"]
+        and ab["sequential"]["ranks_agree"] and ab["pipelined"]["ranks_agree"]
+        and ab["sequential"]["ticks"] == ab["pipelined"]["ticks"]
+        and ab["sequential"]["converged"] and ab["pipelined"]["converged"]
+    )
+    # min-of-reps: "can the pipelined path run at least as fast" — the
+    # noise-floor estimator (the shared container's drift makes single
+    # reps meaningless; medians also recorded)
+    ab["wall_ratio_min"] = round(
+        ab["pipelined"]["wall_min"] / ab["sequential"]["wall_min"], 3
+    )
+    ab["wall_ratio_median"] = round(
+        ab["pipelined"]["wall_median"] / ab["sequential"]["wall_median"], 3
+    )
+    overlap_ok = bool(
+        ab["digests_equal"]
+        and ab["sequential"]["journal_keys_present"]
+        and ab["pipelined"]["journal_keys_present"]
+        and ab["wall_ratio_min"] <= 1.0
+        # the overlap actually hid drain (the gauge is live, not zero)
+        and ab["pipelined"]["overlap_hidden_ms"] > 0.0
+    )
+
+    # -- leg 3: swing A/B at P=4 (relays exist there; P=2 degenerates) -------
+    n4 = 262_144 if full else 65_536
+    _converge(n4, 4, "cyclic", "off", journal=False)
+    _converge(n4, 4, "swing", "off", journal=False)
+    sw: dict = {}
+    for schedule in ("cyclic", "swing"):
+        walls = []
+        for rep in range(3):
+            results, _ = _converge(n4, 4, schedule, "off", journal=False)
+            r0 = results[0]
+            walls.append(max(r["wall_s"] for r in results))
+        sw[schedule] = {
+            "walls": walls,
+            "wall_min": min(walls),
+            "digest": r0["digest"],
+            "ranks_agree": len({r["digest"] for r in results}) == 1,
+            "ticks": r0["ticks"],
+            "wire_mb_per_tick": r0["fabric_mb_per_tick"],
+            "raw_mb_per_tick": r0["fabric_raw_mb_per_tick"],
+            "leg_ms": r0["fabric_leg_ms"],
+        }
+    sw["digests_equal"] = bool(
+        sw["cyclic"]["digest"] == sw["swing"]["digest"]
+        and sw["cyclic"]["ranks_agree"] and sw["swing"]["ranks_agree"]
+        and sw["cyclic"]["ticks"] == sw["swing"]["ticks"]
+    )
+    # the relay overhead, explicitly priced: raw bytes the swing hops
+    # move per tick over the direct cyclic plan's
+    sw["relay_raw_ratio"] = round(
+        sw["swing"]["raw_mb_per_tick"] / sw["cyclic"]["raw_mb_per_tick"], 3
+    )
+    sw["wall_ratio_min"] = round(
+        sw["swing"]["wall_min"] / sw["cyclic"]["wall_min"], 3
+    )
+    swing_ok = bool(sw["digests_equal"] and sw["wall_ratio_min"] <= 1.05)
+
+    return {
+        "metric": "swing_overlap",
+        # headline: pipelined/sequential wall at the P=2 scale point
+        "value": ab["wall_ratio_min"],
+        "unit": "pipelined_over_sequential_wall_min",
+        "certified": bool(twin_certified and overlap_ok and swing_ok),
+        "engine_digest": engine_digest,
+        "twin": twin,
+        "twin_certified": twin_certified,
+        "overlap_ab": {"n": n2, "nprocs": 2, **ab},
+        "swing_ab": {"n": n4, "nprocs": 4, **sw},
+        "overlap_certified": overlap_ok,
+        "swing_certified": swing_ok,
+        "n_nodes": n2,
+        "n_rumors": 64,
+    }
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
@@ -1883,6 +2084,7 @@ BENCHES = {
     "delta16m": bench_delta16m,
     "multihost16m": bench_multihost16m,
     "dcn_wire": bench_dcn_wire,
+    "swing_overlap": bench_swing_overlap,
     "churn100k": bench_churn100k,
     "flap1k": bench_flap1k,
     "asym_partition": bench_asym_partition,

@@ -19,10 +19,28 @@ Layering:
 * data — length-framed raw-numpy messages over the peer sockets, tagged
   by the caller (``delta_multihost`` encodes ``tick << 8 | leg`` so a
   stray message from a diverged schedule trips the tag check instead of
-  being consumed as a later tick's payload); deadlock-free by sending on
-  background threads while the main thread receives in rank order (every
-  tick's communication schedule is deterministic on all ranks, derived
-  from the same counter-RNG draw);
+  being consumed as a later tick's payload); deadlock-free by draining
+  sends on per-peer PERSISTENT sender threads while per-peer receiver
+  threads demux tagged expectations in FIFO order (every tick's
+  communication schedule is deterministic on all ranks, derived from the
+  same counter-RNG draw, and TCP preserves per-peer message order — so
+  the demux is a queue, not a search);
+* completions (r16) — ``exchange_async`` enqueues a round and returns an
+  :class:`ExchangeHandle`; ``exchange`` is exactly
+  ``exchange_async(...).wait()``.  ``wait(join_sends=False)`` joins only
+  the receives, which is what lets the multihost engine overlap tick
+  t+1's shard-local compute with tick t's wire drain (the cross-TICK
+  pipelining of PAPERS "Pipelined Gossiping").  A sender-thread failure
+  is sticky: it fails the round's handle AND every later enqueue to that
+  peer, so an unjoined drain error cannot vanish;
+* schedules (r16) — :func:`plan_window_swing` is the distance-halving
+  (Swing-style, hypercube dimension-fixing) relay alternative to the
+  direct :func:`plan_window` assembly: O(log P) rounds of exactly ONE
+  partner each at power-of-two distances, relay ranks forwarding
+  coalesced pieces, vs the cyclic plan's arbitrary-distance direct
+  sends.  ``allgather(schedule="swing")`` is the matching
+  recursive-doubling variant — bitwise OR/AND reduces reassociate
+  exactly, so the combine stays bit-identical under either schedule;
 * collectives — ``allgather`` of per-rank partial words implements the
   OR/AND row reduces and digest combines (bitwise ops reassociate
   exactly, so partial-then-combine is bit-identical to the single-host
@@ -51,6 +69,8 @@ MB/tick on the wire and the compression ratio against the committed
 from __future__ import annotations
 
 import base64
+import functools
+import queue
 import socket
 import struct
 import threading
@@ -371,6 +391,256 @@ def _send_exact(sock: socket.socket, data) -> None:
     sock.sendall(data)
 
 
+class _Future:
+    """One pending send or receive: an event plus a value-or-error slot.
+    ``value`` for a send is the monotonic completion timestamp (the
+    drain-timing hook); for a receive, the decoded array list."""
+
+    __slots__ = ("ev", "value", "err")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.value = None
+        self.err: Optional[BaseException] = None
+
+    def fulfill(self, value) -> None:
+        self.value = value
+        self.ev.set()
+
+    def fail(self, err: BaseException) -> None:
+        self.err = err
+        self.ev.set()
+
+
+class _RecvJob(NamedTuple):
+    tag: int
+    stream: Optional[str]
+    fut: _Future
+
+
+def _aggregate_raise(errs: Sequence[BaseException]) -> None:
+    """Raise ``errs[0]`` with every OTHER error attached: chained via
+    ``__context__`` (so one traceback shows the whole multi-peer outage)
+    and collected on ``peer_errors`` for programmatic access.  Before r16
+    a round that failed on several sender threads raised only ``errs[0]``
+    and silently dropped the rest."""
+    if not errs:
+        return
+    primary = errs[0]
+    rest = [e for e in errs[1:] if e is not primary]
+    node = primary
+    seen = {id(primary)}
+    for e in rest:
+        while node.__context__ is not None and id(node.__context__) not in seen:
+            node = node.__context__
+            seen.add(id(node))
+        if id(e) not in seen:
+            node.__context__ = e
+            seen.add(id(e))
+            node = e
+    primary.peer_errors = tuple([primary, *rest])  # type: ignore[attr-defined]
+    raise primary
+
+
+class _PeerLink:
+    """One peer's persistent send/receive machinery: a sender thread
+    draining a FIFO of pre-packed wire messages and a receiver thread
+    draining a FIFO of tagged expectations.  Errors are STICKY — after a
+    socket failure every queued and future job on that side of the link
+    fails with the same typed error (the socket state is undefined after
+    a partial frame, so there is nothing to resume)."""
+
+    def __init__(self, fabric: "Fabric", peer: int, sock: socket.socket):
+        self.fabric = fabric
+        self.peer = peer
+        self.sock = sock
+        self.sendq: "queue.Queue" = queue.Queue()
+        self.recvq: "queue.Queue" = queue.Queue()
+        self.send_err: Optional[BaseException] = None
+        self.recv_err: Optional[BaseException] = None
+        self._sender = threading.Thread(
+            target=self._send_loop, daemon=True,
+            name=f"fabric-r{fabric.rank}-send-p{peer}",
+        )
+        self._receiver = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"fabric-r{fabric.rank}-recv-p{peer}",
+        )
+        self._sender.start()
+        self._receiver.start()
+
+    def _drain_failed(self, q, err: BaseException) -> None:
+        """Fail every still-queued job on ``q`` — a loop exiting early
+        (fabric closed) must not leave later futures unfulfilled, or
+        their waiters would block into a misleading timeout."""
+        while True:
+            try:
+                job = q.get_nowait()
+            except queue.Empty:
+                return
+            if job is None:
+                continue
+            # send jobs are (fut, msg, tag) tuples; recv jobs are
+            # _RecvJob NamedTuples — which are ALSO tuples, so match the
+            # typed one first
+            fut = job.fut if isinstance(job, _RecvJob) else job[0]
+            fut.fail(err)
+
+    def _send_loop(self) -> None:
+        while True:
+            job = self.sendq.get()
+            if job is None:
+                return
+            fut, msg, tag = job
+            if self.send_err is not None:
+                fut.fail(self.send_err)
+                continue
+            try:
+                _send_exact(self.sock, msg)
+                fut.fulfill(time.monotonic())
+            except socket.timeout as e:
+                self.send_err = FabricTimeout(
+                    f"rank {self.fabric.rank}: send to peer {self.peer} "
+                    f"(tag {tag}) could not drain within "
+                    f"{self.fabric.timeout_ms} ms — peer wedged or partitioned"
+                )
+                self.send_err.__cause__ = e
+                fut.fail(self.send_err)
+            except OSError as e:
+                if self.fabric._closed:
+                    err = FabricError(
+                        f"rank {self.fabric.rank}: fabric closed with a send "
+                        f"to peer {self.peer} still queued")
+                    fut.fail(err)
+                    self._drain_failed(self.sendq, err)
+                    return
+                self.send_err = FabricPeerLost(
+                    f"rank {self.fabric.rank}: send to peer {self.peer} "
+                    f"(tag {tag}) failed ({e}) — peer process died mid-exchange"
+                )
+                self.send_err.__cause__ = e
+                fut.fail(self.send_err)
+
+    def _recv_loop(self) -> None:
+        while True:
+            job = self.recvq.get()
+            if job is None:
+                return
+            if self.recv_err is not None:
+                job.fut.fail(self.recv_err)
+                continue
+            try:
+                job.fut.fulfill(
+                    self.fabric._recv(self.peer, job.tag, job.stream)
+                )
+            except FabricError as e:
+                if self.fabric._closed:
+                    err = FabricError(
+                        f"rank {self.fabric.rank}: fabric closed with a "
+                        f"receive from peer {self.peer} still pending")
+                    job.fut.fail(err)
+                    self._drain_failed(self.recvq, err)
+                    return
+                self.recv_err = e
+                job.fut.fail(e)
+            except BaseException as e:  # decode bugs must not hang waiters
+                self.recv_err = FabricError(
+                    f"rank {self.fabric.rank}: receive from peer {self.peer} "
+                    f"(tag {job.tag}) failed: {type(e).__name__}: {e}"
+                )
+                self.recv_err.__cause__ = e
+                job.fut.fail(self.recv_err)
+
+    def shutdown(self) -> None:
+        # let queued sends drain briefly BEFORE the socket closes (an
+        # overlapped final round may still be in the queue); a peer-dead
+        # stall is bounded by the join timeout, then the close forces the
+        # sender out
+        self.sendq.put(None)
+        self._sender.join(timeout=2.0)
+        self.recvq.put(None)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._sender.join(timeout=2.0)
+        self._receiver.join(timeout=2.0)
+
+
+class ExchangeHandle:
+    """The completion handle of one asynchronous fabric round.
+
+    ``wait()`` (the default, ``join_sends=True``) reproduces the
+    blocking ``exchange`` contract exactly: receives joined in order,
+    sends joined, every error of the round aggregated into one raise.
+    ``wait(join_sends=False)`` joins ONLY the receives — the engine's
+    cross-tick overlap mode: the send drain continues on the persistent
+    sender threads, ordered FIFO behind nothing (a later round's payload
+    cannot overtake it), and a drain failure is sticky on the link so it
+    surfaces at the next enqueue or wait touching that peer.
+    """
+
+    def __init__(self, fabric: "Fabric", tag: int, recv_futs, send_futs):
+        self.fabric = fabric
+        self.tag = tag
+        self._recv_futs = recv_futs  # [(peer, _Future)] in recv_from order
+        self._send_futs = send_futs  # [(peer, _Future)] in enqueue order
+        self.issued_s = time.monotonic()
+        self.waited_s = 0.0  # total wall spent blocked in wait() calls
+
+    def _budget_s(self) -> float:
+        # the socket-level timeout fires first with its richer message;
+        # the margin only catches a wedged demux thread
+        return self.fabric.timeout_ms / 1000.0 + 5.0
+
+    def wait(self, join_sends: bool = True) -> dict[int, list[np.ndarray]]:
+        t0 = time.monotonic()
+        deadline = t0 + self._budget_s()
+        errs: list[BaseException] = []
+        out: dict[int, list[np.ndarray]] = {}
+        try:
+            for peer, fut in self._recv_futs:
+                if not fut.ev.wait(timeout=max(0.0, deadline - time.monotonic())):
+                    errs.append(FabricTimeout(
+                        f"rank {self.fabric.rank}: completion for tag "
+                        f"{self.tag} from peer {peer} not fulfilled within "
+                        f"{self.fabric.timeout_ms} ms"))
+                    continue
+                if fut.err is not None:
+                    errs.append(fut.err)
+                else:
+                    out[peer] = fut.value
+            for peer, fut in self._send_futs:
+                if not join_sends:
+                    # non-blocking: surface only already-failed sends
+                    if fut.ev.is_set() and fut.err is not None:
+                        errs.append(fut.err)
+                    continue
+                if not fut.ev.wait(timeout=max(0.0, deadline - time.monotonic())):
+                    errs.append(FabricTimeout(
+                        f"rank {self.fabric.rank}: send to peer {peer} for "
+                        f"tag {self.tag} still undrained within "
+                        f"{self.fabric.timeout_ms} ms"))
+                elif fut.err is not None:
+                    errs.append(fut.err)
+        finally:
+            self.waited_s += time.monotonic() - t0
+        if errs:
+            _aggregate_raise(errs)
+        return out
+
+    def sends_done_s(self) -> Optional[float]:
+        """Monotonic timestamp when the LAST send of this round hit the
+        socket — ``None`` while any is still draining (or failed).  The
+        engine's ``overlap_hidden_ms`` gauge reads this after the fact."""
+        done = self.issued_s
+        for _, fut in self._send_futs:
+            if not fut.ev.is_set() or fut.err is not None:
+                return None
+            done = max(done, fut.value)
+        return done
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -420,9 +690,13 @@ class Fabric:
         self._tx_prev: dict[tuple, bytes] = {}
         self._rx_prev: dict[tuple, bytes] = {}
         self._peers: dict[int, socket.socket] = {}
+        self._links: dict[int, _PeerLink] = {}
+        self._closed = False
         self._lock = threading.Lock()
         if nprocs > 1:
             self._connect(host)
+            for peer, s in self._peers.items():
+                self._links[peer] = _PeerLink(self, peer, s)
 
     # -- bring-up -------------------------------------------------------------
 
@@ -466,6 +740,10 @@ class Fabric:
         srv.close()
 
     def close(self) -> None:
+        self._closed = True
+        for link in self._links.values():
+            link.shutdown()
+        self._links.clear()
         for s in self._peers.values():
             try:
                 s.close()
@@ -555,27 +833,6 @@ class Fabric:
                 self.codec_counts[c] = self.codec_counts.get(c, 0) + k
         return _HDR.pack(tag, len(arrays), total) + b"".join(parts), raw_total
 
-    def _send(self, peer: int, tag: int, arrays, stream=None) -> None:
-        msg, raw = self._pack(tag, arrays, peer, stream)
-        with self._lock:
-            self.bytes_sent += len(msg)
-            self.raw_bytes_sent += raw
-        try:
-            _send_exact(self._peers[peer], msg)
-        except socket.timeout as e:
-            raise FabricTimeout(
-                f"rank {self.rank}: send to peer {peer} (tag {tag}) could not "
-                f"drain within {self.timeout_ms} ms — peer wedged or "
-                "partitioned"
-            ) from e
-        except FabricError:
-            raise
-        except OSError as e:
-            raise FabricPeerLost(
-                f"rank {self.rank}: send to peer {peer} (tag {tag}) failed "
-                f"({e}) — peer process died mid-exchange"
-            ) from e
-
     def _recv(self, peer: int, tag: int, stream=None) -> list[np.ndarray]:
         sock = self._peers[peer]
         try:
@@ -598,6 +855,14 @@ class Fabric:
             raise FabricPeerLost(
                 f"rank {self.rank}: peer {peer} closed its socket while this "
                 f"rank awaited tag {tag} — peer process died mid-exchange"
+            ) from e
+        except OSError as e:
+            # RST instead of FIN: the peer died with OUR data still in
+            # flight to it — same diagnosis as a clean close
+            raise FabricPeerLost(
+                f"rank {self.rank}: connection to peer {peer} reset while "
+                f"this rank awaited tag {tag} ({e}) — peer process died "
+                "mid-exchange"
             ) from e
         out, off = [], 0
         raw_total = _HDR.size
@@ -625,27 +890,33 @@ class Fabric:
 
     # -- rounds ---------------------------------------------------------------
 
-    def exchange(
+    def exchange_async(
         self,
         tag: int,
         sends: dict[int, Sequence[Union[np.ndarray, Encoded]]],
         recv_from: Sequence[int],
         stream: Optional[str] = None,
-    ) -> dict[int, list[np.ndarray]]:
-        """One deterministic communication round: send each payload in
-        ``sends`` (background threads), receive one message from every
-        peer in ``recv_from`` (in the given order), join.  Both sides must
-        derive the same schedule — a mismatch surfaces as a tag desync or
-        timeout, never silent misdata.  ``stream`` (a tick-stable name)
-        opts the round's arrays into the XOR-delta codec: the previous
-        payload per (peer, stream, index) is retained on both sides, so
-        only use it for rounds whose shapes recur (the reduce words —
-        retaining a full window would double memory for no ratio)."""
+    ) -> ExchangeHandle:
+        """Enqueue one deterministic communication round and return its
+        completion handle: each payload in ``sends`` is packed HERE (so
+        byte accounting and the XOR-delta payload history advance in
+        program order — the double-buffering invariant the overlapped
+        engine leans on) and drained by the peer's persistent sender
+        thread; each peer in ``recv_from`` gets one tagged expectation
+        queued on its receiver thread.  Both sides must derive the same
+        schedule — a mismatch surfaces as a tag desync or timeout, never
+        silent misdata.  ``stream`` (a tick-stable name) opts the round's
+        arrays into the XOR-delta codec: the previous payload per (peer,
+        stream, index) is retained on both sides, so only use it for
+        rounds whose shapes recur (the reduce words — retaining a full
+        window would double memory for no ratio)."""
+        if self._closed:
+            raise FabricError(f"rank {self.rank}: fabric is closed")
         if stream is not None:
             # validate BEFORE any socket work so the contract violation
             # raises synchronously on every rank instead of leaving the
             # peers blocked into a timeout (_encode_item's check would
-            # only fire inside a background send thread)
+            # only fire inside a sender thread)
             for arrays in sends.values():
                 for it in arrays:
                     if isinstance(it, Encoded):
@@ -655,40 +926,109 @@ class Fabric:
                             "would diverge between sender and receiver — "
                             "send the ndarray, or drop the stream"
                         )
-        errs: list[BaseException] = []
-
-        def _bg(peer, arrays):
-            try:
-                self._send(peer, tag, arrays, stream)
-            except BaseException as e:  # surfaced after join
-                errs.append(e)
-
-        threads = [
-            threading.Thread(target=_bg, args=(p, a), daemon=True)
-            for p, a in sends.items()
+        # a sticky drain failure from an earlier UNJOINED round (the
+        # overlap mode) surfaces at the next enqueue, not never
+        sticky = [
+            link.send_err
+            for link in self._links.values()
+            if link.send_err is not None
         ]
-        for t in threads:
-            t.start()
-        try:
-            out = {p: self._recv(p, tag, stream) for p in recv_from}
-        finally:
-            for t in threads:
-                t.join()
-        if errs:
-            raise errs[0]
-        return out
+        if sticky:
+            _aggregate_raise(sticky)
+        send_futs: list[tuple[int, _Future]] = []
+        # packing runs HERE, serially, and that is a deliberate trade:
+        # program-order packing is what keeps the XOR history and the
+        # byte counters deterministic (readable mid-drain by journals),
+        # and the fan-out is small by construction — a cyclic window leg
+        # sends to <= 2 peers (a block window spans at most two owner
+        # blocks, any P), a swing round to exactly 1; only the tiny
+        # reduce words ever fan to P-1
+        for peer, arrays in sends.items():
+            msg, raw = self._pack(tag, arrays, peer, stream)
+            with self._lock:
+                self.bytes_sent += len(msg)
+                self.raw_bytes_sent += raw
+            fut = _Future()
+            self._links[peer].sendq.put((fut, msg, tag))
+            send_futs.append((peer, fut))
+        recv_futs: list[tuple[int, _Future]] = []
+        for peer in recv_from:
+            fut = _Future()
+            self._links[peer].recvq.put(_RecvJob(tag, stream, fut))
+            recv_futs.append((peer, fut))
+        return ExchangeHandle(self, tag, recv_futs, send_futs)
+
+    def exchange(
+        self,
+        tag: int,
+        sends: dict[int, Sequence[Union[np.ndarray, Encoded]]],
+        recv_from: Sequence[int],
+        stream: Optional[str] = None,
+    ) -> dict[int, list[np.ndarray]]:
+        """The synchronous round: ``exchange_async(...).wait()`` —
+        receives joined in ``recv_from`` order, sends joined, every
+        failure of the round aggregated into one raise (see
+        ``_aggregate_raise``)."""
+        return self.exchange_async(tag, sends, recv_from, stream=stream).wait()
 
     def allgather(
-        self, tag: int, arr: np.ndarray, stream: Optional[str] = None
+        self,
+        tag: int,
+        arr: np.ndarray,
+        stream: Optional[str] = None,
+        schedule: str = "cyclic",
+        join_sends: bool = True,
+        on_round=None,
     ) -> list[np.ndarray]:
         """Every rank's ``arr``, ordered by rank (self included).  Tiny
-        payloads only (reduce words, digest partials) — full-mesh sends."""
+        payloads only (reduce words, digest partials).
+
+        ``schedule="cyclic"`` is one full-mesh round (P-1 sends, P-1
+        receives).  ``schedule="swing"`` is recursive doubling: log2(P)
+        rounds against ONE partner at distance 2^j each, the accumulated
+        half forwarded whole — the gather analog of the Swing exchange
+        (requires a power-of-two P; the returned per-rank arrays are
+        byte-identical either way, so any bitwise combine over them is
+        schedule-invariant).  Round j's wire tag is ``tag + j`` — callers
+        keep the low nibble of their leg tags clear for it.
+        ``join_sends=False`` lets the final round's drain overlap the
+        caller's next compute (the engine's overlap mode); ``on_round``
+        (called with each round's ExchangeHandle right after its wait)
+        hands those still-draining handles to the caller — the engine's
+        overlap-hidden gauge folds the reduce drain through it."""
         if self.nprocs == 1:
             return [np.asarray(arr)]
+        if schedule == "swing":
+            if self.nprocs & (self.nprocs - 1):
+                raise ValueError(
+                    f"swing allgather requires a power-of-two process "
+                    f"count, got {self.nprocs}"
+                )
+            have = {self.rank: np.asarray(arr)}
+            for j in range(self.nprocs.bit_length() - 1):
+                q = self.rank ^ (1 << j)
+                order = sorted(have)
+                h = self.exchange_async(
+                    (tag + j) & 0xFFFFFFFF,
+                    {q: [have[r] for r in order]},
+                    [q],
+                    stream=None if stream is None else f"{stream}/sw{j}",
+                )
+                got = h.wait(join_sends=join_sends)
+                if on_round is not None:
+                    on_round(h)
+                for r, a in zip(sorted(r ^ (1 << j) for r in order), got[q]):
+                    have[r] = a
+            return [have[r] for r in range(self.nprocs)]
+        if schedule != "cyclic":
+            raise ValueError(f"unknown allgather schedule {schedule!r}")
         peers = [p for p in range(self.nprocs) if p != self.rank]
-        got = self.exchange(
+        h = self.exchange_async(
             tag, {p: [np.asarray(arr)] for p in peers}, peers, stream=stream
         )
+        got = h.wait(join_sends=join_sends)
+        if on_round is not None:
+            on_round(h)
         return [
             np.asarray(arr) if r == self.rank else got[r][0]
             for r in range(self.nprocs)
@@ -718,7 +1058,14 @@ class Fabric:
 
 def window_pieces(start: int, length: int, n: int) -> list[tuple[int, int]]:
     """The cyclic row window ``[start, start+length) mod n`` as ordered
-    contiguous global pieces (at most two)."""
+    contiguous global pieces (at most two).  ``start`` is taken mod ``n``
+    (negative and >= n shifts are legal); a zero-length window is the
+    empty list; ``length`` beyond ``n`` is a contract violation (the
+    window would cover rows twice)."""
+    if not 0 <= length <= n:
+        raise ValueError(f"window length {length} outside [0, n={n}]")
+    if length == 0:
+        return []
     start %= n
     if start + length <= n:
         return [(start, length)]
@@ -736,13 +1083,27 @@ def plan_window(
 ) -> list[tuple[int, int, int, int]]:
     """Assembly plan for the cyclic window ``[want_start, want_start+block)``
     over equal process blocks: ordered ``(owner_rank, global_lo, length,
-    window_offset)`` entries.  Derived identically on every rank — the
-    sender runs it for the RECEIVER's window to learn what to send."""
+    window_offset)`` entries, window offsets ascending.  Derived
+    identically on every rank — the sender runs it for the RECEIVER's
+    window to learn what to send.  ``n`` must divide evenly over
+    ``nprocs``: silently planning over truncated ``n // nprocs`` blocks
+    would assign the ring's tail rows to no owner (the same divisibility
+    ``partition.process_block`` imposes, surfaced HERE because this
+    function is also reachable from schedule tooling that never builds a
+    partition)."""
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if n % nprocs:
+        raise ValueError(
+            f"n={n} does not divide over {nprocs} processes — equal-block "
+            "window plans would drop the tail rows (pad n or change the "
+            "process count)"
+        )
+    b = n // nprocs
     out = []
     off = 0
     for glo, glen in window_pieces(want_start, block, n):
         # owners overlapping [glo, glo+glen)
-        b = n // nprocs
         first, last = glo // b, (glo + glen - 1) // b
         for r in range(first, last + 1):
             piece = intersect(glo, glen, r * b, b)
@@ -750,3 +1111,56 @@ def plan_window(
             out.append((r, piece[0], piece[1], off + piece[0] - glo))
         off += glen
     return out
+
+
+@functools.lru_cache(maxsize=8192)
+def plan_window_swing(
+    rel_start: int, n: int, nprocs: int
+) -> tuple[dict[int, tuple], ...]:
+    """Distance-halving relay schedule for the per-rank block windows
+    ``[rank*b + rel_start, ...+b) mod n`` (Swing-style, PAPERS arxiv
+    2401.09356, realized as hypercube dimension-fixing on the full-mesh
+    fabric): ``log2(P)`` rounds, each rank talking to exactly ONE partner
+    at distance ``2^j``, relay ranks forwarding coalesced pieces — vs the
+    cyclic :func:`plan_window` execution's direct sends to partners at
+    arbitrary ring distance.  On a physical ring/torus DCN that bounds
+    the worst-case leg count at O(log P) power-of-two hops instead of the
+    O(P)-step walk a distant window piece implies; on this TCP mesh the
+    hop count is priced honestly as relay bytes (the wire accounting
+    counts every forwarded copy).
+
+    Returns one manifest per round: ``rounds[j]`` maps ``holder_rank`` to
+    its ordered entries ``(dest, owner, global_lo, length, window_off)``;
+    every listed entry moves ``holder -> holder ^ (1 << j)`` in round
+    ``j`` (bit j of ``owner ^ dest`` set).  Derived identically on every
+    rank from :func:`plan_window`, so the assembled windows are
+    byte-identical to the cyclic plan's by construction.  Pieces with
+    ``owner == dest`` never enter the wire schedule."""
+    if nprocs < 2 or nprocs & (nprocs - 1):
+        raise ValueError(
+            f"swing schedule requires a power-of-two process count >= 2, "
+            f"got {nprocs}"
+        )
+    if nprocs > (1 << 15):
+        raise ValueError(
+            "swing round tags ride the low nibble-and-a-bit of the leg "
+            f"tag byte — {nprocs} processes would overflow it"
+        )
+    block = n // nprocs  # plan_window validates divisibility
+    nrounds = nprocs.bit_length() - 1
+    rounds: list[dict[int, list]] = [{} for _ in range(nrounds)]
+    for d in range(nprocs):
+        start_d = (rel_start + d * block) % n
+        for owner, glo, glen, woff in plan_window(start_d, block, n, nprocs):
+            if owner == d:
+                continue
+            diff = owner ^ d
+            h = owner
+            for j in range(nrounds):
+                if (diff >> j) & 1:
+                    rounds[j].setdefault(h, []).append((d, owner, glo, glen, woff))
+                    h ^= 1 << j
+    return tuple(
+        {h: tuple(sorted(v, key=lambda e: (e[0], e[4]))) for h, v in r.items()}
+        for r in rounds
+    )

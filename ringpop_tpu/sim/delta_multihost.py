@@ -33,6 +33,7 @@ handles the full fault surface; this bridge certifies the DCN layer.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -44,6 +45,7 @@ from ringpop_tpu.parallel.fabric import (
     encode_array,
     encode_rows,
     plan_window,
+    plan_window_swing,
     rows_wire_size,
 )
 from ringpop_tpu.parallel.partition import (
@@ -268,6 +270,21 @@ class MultihostDelta:
     local slices) — that degenerate instance is pinned bit-identical to
     ``delta.step``, and the 2/4-process instances are pinned digest-equal
     to IT, which closes the chain to the single-host engine.
+
+    r16 knobs, both bit-transparent by construction and pinned so by the
+    twin tests:
+
+    * ``schedule`` — ``"cyclic"`` (direct window sends, the r14 plan) or
+      ``"swing"`` (distance-halving relay rounds, power-of-two P;
+      ``plan_window_swing``); the assembled windows and the reduce-word
+      gathers are byte-identical either way.
+    * ``overlap`` — cross-tick pipelining: every round's sends drain on
+      the fabric's persistent sender threads while the engine keeps
+      computing (tick t's leg-2/reduce drain runs under tick t+1's
+      kernels A–D and leg-1 slicing); the engine joins exactly at the
+      point inbound rows are consumed.  The XOR-delta payload history
+      stays exact because the fabric advances it in enqueue/decode order
+      (FIFO per peer) — the double-buffering contract.
     """
 
     def __init__(
@@ -276,8 +293,24 @@ class MultihostDelta:
         fabric: Fabric,
         seed: int = 0,
         faults: Optional[DeltaFaults] = None,
+        schedule: str = "cyclic",
+        overlap: bool = False,
     ):
         _check_supported(params, faults)
+        if schedule not in ("cyclic", "swing"):
+            raise ValueError(f"unknown exchange schedule {schedule!r}")
+        if schedule == "swing" and fabric.nprocs > 1 and (
+            fabric.nprocs & (fabric.nprocs - 1)
+        ):
+            raise ValueError(
+                "swing schedule requires a power-of-two process count, got "
+                f"{fabric.nprocs} (select schedule='cyclic')"
+            )
+        # overlap (r16): issue each round's sends async and join ONLY the
+        # receives — tick t's leg-2/reduce drain overlaps tick t+1's
+        # shard-local kernels.  Off = the r15 blocking semantics through
+        # the same persistent-thread fabric (the A/B baseline).
+        self.schedule, self.overlap = schedule, bool(overlap)
         self.params, self.fabric = params, fabric
         self.rank, self.nprocs = fabric.rank, fabric.nprocs
         self.lo, self.hi = process_block(params.n, self.rank, self.nprocs)
@@ -303,9 +336,17 @@ class MultihostDelta:
         # summaries + pieces only — the twin tests pin this under the
         # old full-plane-per-leg floor
         self.d2h_bytes = 0
-        # journal per-tick deltas: (tick, wire sent, raw sent) at the
-        # last journal_record
-        self._journal_prev = (0, 0, 0)
+        # journal per-tick deltas: counters at the last journal_record
+        self._journal_prev = {"tick": 0, "wire": 0, "raw": 0,
+                              "leg": {"leg1": 0.0, "leg2": 0.0, "reduce": 0.0},
+                              "hidden": 0.0}
+        # per-leg drain/overlap timing (r16 observability): cumulative
+        # seconds BLOCKED waiting on each leg's completions, and the
+        # estimated send-drain wall that ran hidden under compute (folded
+        # lazily from drained handles — see _fold_round_timings)
+        self._leg_wait_s = {"leg1": 0.0, "leg2": 0.0, "reduce": 0.0}
+        self._hidden_s = 0.0
+        self._inflight: list = []
         # a fresh engine breaks any XOR-delta payload history a reused
         # fabric carries (and restore may change P) — reset is local and
         # every rank constructs its engine at the same protocol point
@@ -321,7 +362,110 @@ class MultihostDelta:
 
     # -- the exchange legs ----------------------------------------------------
 
-    def _exchange_window(self, plane_dev, rel_shift: int, tag: int):
+    def _plane_summary(self, plane_dev):
+        """Send-side nonzero-row summary of one exchange plane (codec
+        path): the packed device bitmap unpacked to a host mask + prefix
+        sums, one cheap pass per leg shared by every piece decision."""
+        b = self.block
+        bits_host = np.asarray(_k_plane_nzbits(plane_dev))
+        self.d2h_bytes += bits_host.nbytes
+        mask_all = np.unpackbits(
+            bits_host.view(np.uint8), count=b, bitorder="little"
+        ).astype(bool)
+        cum = np.zeros(b + 1, np.int64)
+        np.cumsum(mask_all, out=cum[1:])
+        return mask_all, cum
+
+    def _piece_item(self, plane_dev, s0: int, glen: int, summ):
+        """One contiguous LOCAL piece ``[s0, s0+glen)`` of the plane as a
+        fabric wire item: device-ROWS pre-encoded when the nonzero-row
+        summary says it pays (transfer = nonzero rows only), else the
+        dense device slice (pre-encoded ``rows=False`` so the fabric does
+        not re-scan what the summary already rejected).  ``summ`` is the
+        ``_plane_summary`` pair, or None when the codec is off."""
+        row_nbytes = (
+            int(np.prod(plane_dev.shape[1:], dtype=np.int64))
+            * plane_dev.dtype.itemsize
+        )
+        if summ is not None:
+            mask_all, cum = summ
+            nnz = int(cum[s0 + glen] - cum[s0])
+            if rows_wire_size(glen, nnz, row_nbytes) < glen * row_nbytes:
+                if nnz:
+                    idx = np.flatnonzero(mask_all[s0 : s0 + glen]).astype(np.int32)
+                    idx += np.int32(s0)
+                    pad = 1 << max(int(nnz) - 1, 0).bit_length()
+                    idx = np.concatenate(
+                        [idx, np.full(pad - nnz, idx[-1], np.int32)]
+                    )
+                    payload = np.asarray(
+                        _k_rows_gather(plane_dev, jnp.asarray(idx))[:nnz]
+                    )
+                else:
+                    payload = np.empty((0,) + plane_dev.shape[1:], plane_dev.dtype)
+                self.d2h_bytes += payload.nbytes
+                return encode_rows(
+                    mask_all[s0 : s0 + glen],
+                    payload,
+                    (glen,) + plane_dev.shape[1:],
+                    plane_dev.dtype,
+                )
+        raw = np.asarray(plane_dev[s0 : s0 + glen])
+        self.d2h_bytes += raw.nbytes
+        return encode_array(raw, rows=False) if summ is not None else raw
+
+    def _wait(self, handles, leg: str):
+        """Join a round's completions, attributing the blocked wall to
+        ``leg``.  ``handles`` is (recv_handle, send_handle) — rounds are
+        issued as a receive-expectation post FIRST (so the demux thread
+        decodes inbound while this rank is still encoding its own
+        pieces) and a send enqueue second.  Sync mode joins the sends
+        too (the r15 blocking contract); overlap mode leaves them
+        draining and stamps the resume point for the hidden-drain
+        fold."""
+        recv_h, send_h = handles
+        t0 = time.perf_counter()
+        got = recv_h.wait(join_sends=not self.overlap)
+        if send_h is not None:
+            # sync: join the drain; overlap: surface already-failed
+            # sends only (non-blocking)
+            send_h.wait(join_sends=not self.overlap)
+        self._leg_wait_s[leg] += time.perf_counter() - t0
+        resume = time.monotonic()
+        for h in (recv_h, send_h):
+            if h is not None:
+                h.resumed_s = resume
+                self._inflight.append(h)
+        return got
+
+    def _note_reduce_round(self, handle) -> None:
+        """Track a reduce-allgather round: its BLOCKED wall (the
+        handle's own waited_s — not the surrounding pack/bookkeeping
+        CPU, so the attribution matches leg1/leg2's join-only timing)
+        and the handle itself for the hidden-drain fold — without this
+        the reduce leg (the one XOR-streamed, every-tick round) would
+        be invisible to ``overlap_hidden_ms``."""
+        self._leg_wait_s["reduce"] += handle.waited_s
+        handle.resumed_s = time.monotonic()
+        self._inflight.append(handle)
+
+    def _fold_round_timings(self) -> None:
+        """Price drained rounds into ``overlap_hidden_ms``: the send-
+        drain wall that completed AFTER the engine resumed computing —
+        i.e. drain genuinely hidden under compute.  Sync mode joins
+        every send before resuming, so its hidden contribution is zero
+        by construction; rounds still draining stay queued for a later
+        fold."""
+        keep = []
+        for h in self._inflight:
+            done = h.sends_done_s()
+            if done is None:
+                keep.append(h)
+                continue
+            self._hidden_s += max(0.0, done - getattr(h, "resumed_s", h.issued_s))
+        self._inflight = keep
+
+    def _exchange_window(self, plane_dev, rel_shift: int, tag: int, leg: str):
         """All ranks exchange so each assembles its own window
         ``[lo + rel_shift, lo + rel_shift + B) mod n`` of the globally
         node-sharded ``plane``.  ``rel_shift`` is the same on every rank
@@ -331,25 +475,33 @@ class MultihostDelta:
         P=1 the window is a device gather (zero transfer); at P>1 the
         per-peer pieces are device slices and the nonzero-row summary
         (``_k_plane_nzbits`` + ``_k_rows_gather``) lets ride-masked
-        pieces transfer ONLY their nonzero rows, as the fabric's ROWS wire format
-        — device→host volume ≈ what actually crosses the wire
-        (``d2h_bytes`` accounts every transfer; the twin tests pin it
-        under the old full-plane floor)."""
+        pieces transfer ONLY their nonzero rows, as the fabric's ROWS
+        wire format — device→host volume ≈ what actually crosses the
+        wire (``d2h_bytes`` accounts every transfer; the twin tests pin
+        it under the old full-plane floor).
+
+        r16: ``schedule="swing"`` routes the same pieces through the
+        distance-halving relay rounds instead of direct sends; the
+        assembled window is byte-identical by construction (the relayed
+        rows are the same rows).  ``overlap=True`` joins only receives —
+        this round's send drain overlaps whatever the engine computes
+        next (``tag`` keeps its low nibble clear so swing rounds can ride
+        ``tag + j``)."""
         n, b = self.params.n, self.block
         if self.nprocs == 1:
             return _k_window_all(
                 plane_dev, jnp.asarray((self.lo + rel_shift) % n, jnp.int32)
             )
-        row_nbytes = int(np.prod(plane_dev.shape[1:], dtype=np.int64)) * plane_dev.dtype.itemsize
-        use_codec = self.fabric.codec
-        if use_codec:
-            bits_host = np.asarray(_k_plane_nzbits(plane_dev))
-            self.d2h_bytes += bits_host.nbytes
-            mask_all = np.unpackbits(
-                bits_host.view(np.uint8), count=b, bitorder="little"
-            ).astype(bool)
-            cum = np.zeros(b + 1, np.int64)
-            np.cumsum(mask_all, out=cum[1:])
+        if self.schedule == "swing":
+            return self._exchange_window_swing(plane_dev, rel_shift, tag, leg)
+        # post the receive expectations BEFORE computing any send piece:
+        # the demux threads decode the peers' payloads (which arrive as
+        # soon as THEY finish encoding) while this rank is still slicing
+        # and encoding its own — decode overlaps encode on both sides
+        my_plan = plan_window((self.lo + rel_shift) % n, b, n, self.nprocs)
+        recv_from = sorted({owner for owner, *_ in my_plan if owner != self.rank})
+        recv_h = self.fabric.exchange_async(tag, {}, recv_from)
+        summ = self._plane_summary(plane_dev) if self.fabric.codec else None
         # build sends: for every other rank, the pieces of MY rows their
         # window needs, in THEIR window order (one wire array per piece)
         sends: dict[int, list] = {}
@@ -358,52 +510,17 @@ class MultihostDelta:
                 continue
             r_lo = process_block(n, r, self.nprocs)[0]
             plan = plan_window((r_lo + rel_shift) % n, b, n, self.nprocs)
-            items = []
-            for owner, glo, glen, _ in plan:
-                if owner != self.rank:
-                    continue
-                s0 = glo - self.lo
-                if use_codec:
-                    nnz = int(cum[s0 + glen] - cum[s0])
-                    if rows_wire_size(glen, nnz, row_nbytes) < glen * row_nbytes:
-                        if nnz:
-                            idx = np.flatnonzero(mask_all[s0 : s0 + glen]).astype(np.int32)
-                            idx += np.int32(s0)
-                            pad = 1 << max(int(nnz) - 1, 0).bit_length()
-                            idx = np.concatenate(
-                                [idx, np.full(pad - nnz, idx[-1], np.int32)]
-                            )
-                            payload = np.asarray(
-                                _k_rows_gather(plane_dev, jnp.asarray(idx))[:nnz]
-                            )
-                        else:
-                            payload = np.empty((0,) + plane_dev.shape[1:], plane_dev.dtype)
-                        self.d2h_bytes += payload.nbytes
-                        items.append(
-                            encode_rows(
-                                mask_all[s0 : s0 + glen],
-                                payload,
-                                (glen,) + plane_dev.shape[1:],
-                                plane_dev.dtype,
-                            )
-                        )
-                        continue
-                raw = np.asarray(plane_dev[s0 : s0 + glen])
-                self.d2h_bytes += raw.nbytes
-                if use_codec:
-                    # ROWS was already rejected from the device summary —
-                    # pre-encode with rows=False so the fabric does not
-                    # re-scan the dense piece (RUNS/RAW still measured)
-                    items.append(encode_array(raw, rows=False))
-                else:
-                    items.append(raw)
+            items = [
+                self._piece_item(plane_dev, glo - self.lo, glen, summ)
+                for owner, glo, glen, _ in plan
+                if owner == self.rank
+            ]
             if items:
                 sends[r] = items
-        # my own assembly plan: local pieces stay device slices, received
-        # pieces upload, one device concatenate stitches the window
-        my_plan = plan_window((self.lo + rel_shift) % n, b, n, self.nprocs)
-        recv_from = sorted({owner for owner, *_ in my_plan if owner != self.rank})
-        got = self.fabric.exchange(tag, sends, recv_from)
+        send_h = self.fabric.exchange_async(tag, sends, []) if sends else None
+        # local pieces stay device slices, received pieces upload, one
+        # device concatenate stitches the window
+        got = self._wait((recv_h, send_h), leg)
         used: dict[int, int] = {r: 0 for r in recv_from}
         parts = []
         for owner, glo, glen, woff in my_plan:
@@ -414,10 +531,61 @@ class MultihostDelta:
                 used[owner] += 1
         return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
+    def _exchange_window_swing(self, plane_dev, rel_shift: int, tag: int, leg: str):
+        """The distance-halving execution of the same window assembly:
+        ``log2(P)`` rounds against one partner each (``plan_window_swing``
+        manifests, wire tag ``tag + j``), pieces first hopping off their
+        owner as device slices (the r15 ROWS/dense pre-encode path),
+        relayed hops forwarding the received host rows — every forwarded
+        copy is priced by the fabric's byte accounting, which is exactly
+        the swing relay overhead the simbench artifact reports."""
+        n, b, P = self.params.n, self.block, self.nprocs
+        rounds = plan_window_swing(rel_shift % n, n, P)
+        summ = self._plane_summary(plane_dev) if self.fabric.codec else None
+        store: dict[tuple, np.ndarray] = {}
+        for j, manifest in enumerate(rounds):
+            q = self.rank ^ (1 << j)
+            out_entries = manifest.get(self.rank, ())
+            in_entries = manifest.get(q, ())
+            # expectation first (decode-under-encode, as the cyclic path)
+            recv_h = self.fabric.exchange_async(
+                tag + j, {}, [q] if in_entries else []
+            )
+            items = []
+            for entry in out_entries:
+                d, owner, glo, glen, woff = entry
+                if owner == self.rank:
+                    # first hop: straight off the device plane
+                    items.append(self._piece_item(plane_dev, glo - self.lo, glen, summ))
+                else:
+                    # relay hop: forward the rows received earlier
+                    items.append(store.pop(entry))
+            send_h = (
+                self.fabric.exchange_async(tag + j, {q: items}, [])
+                if items else None
+            )
+            got = self._wait((recv_h, send_h), leg)
+            for entry, arr in zip(in_entries, got.get(q, [])):
+                store[entry] = arr
+        my_plan = plan_window((self.lo + rel_shift) % n, b, n, P)
+        parts = []
+        for owner, glo, glen, woff in my_plan:
+            if owner == self.rank:
+                parts.append(plane_dev[glo - self.lo : glo - self.lo + glen])
+            else:
+                parts.append(
+                    jnp.asarray(store.pop((self.rank, owner, glo, glen, woff)))
+                )
+        assert not store, f"undelivered swing pieces: {sorted(store)}"
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
     # -- one protocol period --------------------------------------------------
 
     def step(self) -> None:
         p = self.params
+        # price any fully drained overlapped rounds from earlier ticks
+        # into the hidden gauge before this tick issues new ones
+        self._fold_round_timings()
         t = jnp.asarray(self.tick, jnp.int32)
         lo = jnp.asarray(self.lo, jnp.int32)
         sent, conn, riding, s_dev = _k_sent(
@@ -425,12 +593,14 @@ class MultihostDelta:
             self.drop_rate, has_up=self.has_up, has_drop=self.has_drop,
         )
         s = int(s_dev)
-        inbound = self._exchange_window(sent, -s, _tag(self.tick, _TAG_LEG1))
+        inbound = self._exchange_window(sent, -s, _tag(self.tick, _TAG_LEG1), "leg1")
         learned1, answerable, got_pinged = _k_merge(
             p, self.learned, self.ride_ok, inbound, self.key, t, lo, s_dev,
             self.up, self.drop_rate, has_up=self.has_up, has_drop=self.has_drop,
         )
-        resp_src = self._exchange_window(answerable, +s, _tag(self.tick, _TAG_LEG2))
+        resp_src = self._exchange_window(
+            answerable, +s, _tag(self.tick, _TAG_LEG2), "leg2"
+        )
         learned2, pcount_mid, mid_ride, part_and, part_or = _k_counters(
             p, self.learned, learned1, resp_src, conn, got_pinged, riding,
             self.pcount, self.up_l, has_up=self.has_up,
@@ -439,11 +609,16 @@ class MultihostDelta:
             # stream="reduce": the [2, W] words recur shape-stable every
             # tick and the AND plane saturates — the XOR-delta codec's one
             # naturally matching stream (windows move with s, so the legs
-            # stay stream-less)
+            # stay stream-less).  Under schedule="swing" the gather is
+            # recursive doubling (per-round streams "reduce/sw{j}"), and
+            # under overlap the final round's drain runs behind kernel D.
             partials = self.fabric.allgather(
                 _tag(self.tick, _TAG_REDUCE),
                 np.stack([np.asarray(part_and), np.asarray(part_or)]),
                 stream="reduce",
+                schedule=self.schedule,
+                join_sends=not self.overlap,
+                on_round=self._note_reduce_round,
             )
             fully_w = functools.reduce(np.bitwise_and, [pp[0] for pp in partials])
             riding_any_w = functools.reduce(np.bitwise_or, [pp[1] for pp in partials])
@@ -506,6 +681,18 @@ class MultihostDelta:
         )
         return float(sum(counts)) / float(self.params.n * self.params.k)
 
+    def leg_timing(self) -> dict:
+        """Cumulative per-leg blocked wall + hidden drain, in ms — the
+        run-total view of the per-interval journal keys (bench records
+        embed this next to the byte counters)."""
+        self._fold_round_timings()
+        return {
+            "fabric_leg_ms": {
+                k: round(v * 1e3, 3) for k, v in self._leg_wait_s.items()
+            },
+            "overlap_hidden_ms": round(self._hidden_s * 1e3, 3),
+        }
+
     def journal_record(self, light: bool = False) -> dict:
         """One journal block: cumulative fabric counters PLUS the r15
         per-interval deltas and codec ratio — `fabric_*_delta` keys cover
@@ -519,29 +706,50 @@ class MultihostDelta:
         costs more than the tick it journals — per-tick wire waves use
         light records and keep the full digest for the exit record.
         Collective either way (coverage allgathers): every rank must pass
-        the same ``light``."""
+        the same ``light``.
+
+        r16 adds the schedule name and per-interval leg timing:
+        ``fabric_leg_ms`` is the wall this rank spent BLOCKED waiting on
+        each leg's completions over the interval, ``overlap_hidden_ms``
+        the send-drain wall that ran hidden under compute instead (zero
+        by construction in sync mode) — so the overlap win is a measured
+        fact per run, not a hope."""
+        self._fold_round_timings()
         ws = self.fabric.wire_stats()
-        prev_tick, prev_wire, prev_raw = self._journal_prev
-        wire_d = ws["bytes_sent"] - prev_wire
-        raw_d = ws["raw_bytes_sent"] - prev_raw
-        self._journal_prev = (self.tick, ws["bytes_sent"], ws["raw_bytes_sent"])
+        prev = self._journal_prev
+        wire_d = ws["bytes_sent"] - prev["wire"]
+        raw_d = ws["raw_bytes_sent"] - prev["raw"]
+        leg_ms = {
+            k: round((self._leg_wait_s[k] - prev["leg"][k]) * 1e3, 3)
+            for k in self._leg_wait_s
+        }
+        hidden_ms = round((self._hidden_s - prev["hidden"]) * 1e3, 3)
         rec = {
             "tick": self.tick,
             "coverage": round(self.coverage(), 6),
             **({} if light else {"digest": self.state_digest()}),
             "process_count": self.nprocs,
             "process_id": self.rank,
+            "schedule": self.schedule,
+            "overlap": self.overlap,
             "fabric_bytes_sent": ws["bytes_sent"],
             "fabric_bytes_recv": ws["bytes_recv"],
             "fabric_raw_sent": ws["raw_bytes_sent"],
             "fabric_raw_recv": ws["raw_bytes_recv"],
-            "fabric_ticks_delta": self.tick - prev_tick,
+            "fabric_ticks_delta": self.tick - prev["tick"],
             "fabric_wire_sent_delta": wire_d,
             "fabric_raw_sent_delta": raw_d,
             # raw/wire over the interval; 1.0 when nothing crossed (P=1)
             "fabric_codec_ratio": round(raw_d / wire_d, 4) if wire_d else 1.0,
             "fabric_codec_counts": ws["codec_counts"],
+            "fabric_leg_ms": leg_ms,
+            "overlap_hidden_ms": hidden_ms,
             "d2h_bytes": self.d2h_bytes,
+        }
+        self._journal_prev = {
+            "tick": self.tick, "wire": ws["bytes_sent"],
+            "raw": ws["raw_bytes_sent"],
+            "leg": dict(self._leg_wait_s), "hidden": self._hidden_s,
         }
         return rec
 
@@ -588,6 +796,8 @@ class MultihostDelta:
         params: DeltaParams,
         fabric: Fabric,
         faults: Optional[DeltaFaults] = None,
+        schedule: str = "cyclic",
+        overlap: bool = False,
     ) -> "MultihostDelta":
         """Restore a block-sharded checkpoint onto THIS fabric's process
         count — which need not match the count that saved it (the 2-proc
@@ -605,7 +815,8 @@ class MultihostDelta:
                 f"at the fabric's process count ({fabric.nprocs}); "
                 f"jax.process_count()={_jax.process_count()}"
             )
-        self = cls(params, fabric, seed=0, faults=faults)
+        self = cls(params, fabric, seed=0, faults=faults,
+                   schedule=schedule, overlap=overlap)
         n, k = params.n, params.k
         w = n_words(k)
         example = DeltaState(
@@ -638,8 +849,13 @@ class MultihostDelta:
         # re-base the journal deltas too: the restored tick may sit
         # BEFORE the last journaled tick (negative ticks_delta) and the
         # restore-era traffic belongs to no wave interval
+        self._fold_round_timings()
         ws = self.fabric.wire_stats()
-        self._journal_prev = (self.tick, ws["bytes_sent"], ws["raw_bytes_sent"])
+        self._journal_prev = {
+            "tick": self.tick, "wire": ws["bytes_sent"],
+            "raw": ws["raw_bytes_sent"],
+            "leg": dict(self._leg_wait_s), "hidden": self._hidden_s,
+        }
 
     def run_until_converged(
         self,

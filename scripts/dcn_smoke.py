@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""DCN wire-codec CI gate (r15, < 30 s, 2-core container).
+"""DCN wire-codec + exchange-schedule CI gate (r15/r16, < 60 s, 2-core
+container).
 
-Tiny codec A/B over the host-bridged fabric — 2 in-process ranks (LocalKV
-threads; the same fabric code path the OS-process runs take) stepping one
-seeded delta scenario to convergence, once with the r15 wire codec and
-once shipping raw frames:
+Codec, schedule and overlap A/Bs over the host-bridged fabric —
+in-process ranks (LocalKV threads; the same fabric code path the
+OS-process runs take) stepping one seeded delta scenario to convergence:
 
 1. **digests equal** — codec-on == codec-off == the in-process engine's
    ``telemetry.tree_digest`` (the codec is bit-transparent or it is
@@ -17,7 +17,14 @@ once shipping raw frames:
    have shipped RAW (the measured fallback is a live code path, not dead
    armor), alongside at least one compressed encoding;
 4. **pieces-only device→host** — the exchange legs' d2h accounting stays
-   under the pre-r15 full-plane floor.
+   under the pre-r15 full-plane floor;
+5. **(r16) swing / overlap A/B** — every (schedule, overlap) combination
+   at P=2 plus the P=4 swing relay leg lands the SAME digest in the same
+   tick count, the per-leg drain/overlap journal keys are present, wall
+   and bytes are recorded (wall is *recorded* here, *judged* by the
+   committed simbench artifact — this is a 2-core CI box), swing wire
+   bytes match cyclic exactly at P=2 (the schedule degenerates) and the
+   P=4 relay overhead is visible in the raw accounting.
 
 Exit 0 = certified; any assertion prints and exits 1.
 """
@@ -38,32 +45,37 @@ T0 = time.perf_counter()
 N, K, SEED, NPROCS, MAX_TICKS = 4096, 64, 17, 2, 512
 
 
-def _run(codec: bool):
+def _run(codec: bool, schedule: str = "cyclic", overlap: bool = False,
+         nprocs: int = NPROCS):
     from ringpop_tpu.parallel.fabric import Fabric, LocalKV
     from ringpop_tpu.sim.delta import DeltaParams
     from ringpop_tpu.sim.delta_multihost import MultihostDelta
 
     params = DeltaParams(n=N, k=K, rng="counter")
     kv = LocalKV()
-    out = [None] * NPROCS
+    out = [None] * nprocs
     errs = []
+    ns = f"dcn{int(codec)}{schedule}{int(overlap)}{nprocs}"
 
     def run(rank):
         try:
-            with Fabric(rank, NPROCS, kv, namespace=f"dcn{int(codec)}",
-                        codec=codec) as fab:
-                mh = MultihostDelta(params, fab, seed=SEED)
+            with Fabric(rank, nprocs, kv, namespace=ns, codec=codec) as fab:
+                mh = MultihostDelta(params, fab, seed=SEED,
+                                    schedule=schedule, overlap=overlap)
                 per_tick = []
+                t0 = time.perf_counter()
                 for _ in range(MAX_TICKS):
                     mh.step()
                     per_tick.append(mh.journal_record())
                     if mh.converged:
                         break
-                out[rank] = (per_tick, mh.d2h_bytes, fab.wire_stats())
+                wall = time.perf_counter() - t0
+                out[rank] = (per_tick, mh.d2h_bytes, fab.wire_stats(), wall,
+                             mh.leg_timing())
         except BaseException as e:
             errs.append(e)
 
-    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(NPROCS)]
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(nprocs)]
     for t in ts:
         t.start()
     for t in ts:
@@ -92,8 +104,8 @@ def main() -> int:
     for _ in range(ticks_on):
         st = stp(st, DeltaFaults())
     anchor = int(tree_digest(st))
-    d_on = {pt[-1]["digest"] for pt, _, _ in on}
-    d_off = {pt[-1]["digest"] for pt, _, _ in off}
+    d_on = {pt[-1]["digest"] for pt, *_ in on}
+    d_off = {pt[-1]["digest"] for pt, *_ in off}
     assert len(on[0][0]) == len(off[0][0]), "codec changed the tick count"
     assert d_on == d_off == {anchor}, (
         f"digest chain broken: codec-on {d_on}, codec-off {d_off}, "
@@ -124,9 +136,43 @@ def main() -> int:
     # 4. pieces-only device→host (the acceptance floor)
     plane_nbytes = (N // NPROCS) * n_words(K) * 4
     floor = 2 * ticks_on * plane_nbytes
-    for pt, d2h, _ in on:
+    for pt, d2h, *_ in on:
         assert 0 < d2h < floor, (d2h, floor)
     print(f"d2h OK: {on[0][1]} B < full-plane floor {floor} B")
+
+    # 5. r16 swing / overlap A/B legs: digest chain + schedule accounting
+    grid = {("cyclic", False): on}
+    for schedule, overlap in (("swing", False), ("cyclic", True), ("swing", True)):
+        grid[(schedule, overlap)] = _run(codec=True, schedule=schedule,
+                                         overlap=overlap)
+    for (schedule, overlap), res in grid.items():
+        pt = res[0][0]
+        assert {p[-1]["digest"] for p, *_ in res} == {anchor}, (
+            f"{schedule}/overlap={overlap} broke the digest chain")
+        assert len(pt) == ticks_on, (schedule, overlap, len(pt), ticks_on)
+        rec = pt[-1]
+        assert rec["schedule"] == schedule and rec["overlap"] is overlap
+        assert set(rec["fabric_leg_ms"]) == {"leg1", "leg2", "reduce"}
+        assert rec["overlap_hidden_ms"] >= 0.0
+    # at P=2 the swing schedule degenerates to the cyclic messages — the
+    # wire totals must agree EXACTLY (relay-free by construction)
+    assert (grid[("swing", False)][0][2]["bytes_sent"]
+            == grid[("cyclic", False)][0][2]["bytes_sent"]), "P=2 swing relayed"
+    for key, res in grid.items():
+        print(f"A/B OK: schedule={key[0]} overlap={key[1]} digest={anchor} "
+              f"wall {max(r[3] for r in res):.2f}s "
+              f"wire {res[0][2]['bytes_sent']} B "
+              f"leg_ms {res[0][4]['fabric_leg_ms']}")
+    # P=4 swing: relays priced in the raw accounting, digests still exact
+    sw4 = _run(codec=True, schedule="swing", nprocs=4)
+    cy4 = _run(codec=True, schedule="cyclic", nprocs=4)
+    assert {p[-1]["digest"] for p, *_ in sw4} == {anchor}, "P=4 swing digest"
+    assert {p[-1]["digest"] for p, *_ in cy4} == {anchor}, "P=4 cyclic digest"
+    raw4_sw = sw4[0][2]["raw_bytes_sent"]
+    raw4_cy = cy4[0][2]["raw_bytes_sent"]
+    assert raw4_sw > raw4_cy, "P=4 swing relay overhead not accounted"
+    print(f"P=4 swing OK: digest={anchor}, relay overhead "
+          f"{raw4_sw - raw4_cy} B raw ({raw4_sw / raw4_cy:.2f}x cyclic)")
 
     print(f"dcn-smoke PASS in {time.perf_counter() - T0:.1f}s")
     return 0

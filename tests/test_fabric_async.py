@@ -1,0 +1,455 @@
+"""r16 async-fabric suite: ``exchange_async`` completions must be
+result- and accounting-identical to the synchronous rounds they replace
+(persistent per-peer sender threads + the tagged receive demux), the
+XOR-delta stream history must stay exact with several rounds in flight
+(the double-buffering contract the overlapped engine leans on), a
+multi-peer outage must aggregate EVERY failed leg into one raise, and
+the swing (distance-halving) schedule must route window pieces to their
+destinations in <= log2(P) power-of-two hops with byte-identical
+assembly vs the cyclic plan.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.parallel.fabric import (
+    Fabric,
+    FabricError,
+    FabricPeerLost,
+    FabricTimeout,
+    LocalKV,
+    plan_window,
+    plan_window_swing,
+    window_pieces,
+)
+
+
+def _run_ranks(nprocs, body, ns, timeout_ms=120_000, codec=True, join_s=60):
+    kv = LocalKV()
+    out, errs = [None] * nprocs, [None] * nprocs
+
+    def run(rank):
+        try:
+            with Fabric(rank, nprocs, kv, namespace=ns, timeout_ms=timeout_ms,
+                        codec=codec) as fab:
+                out[rank] = body(fab, rank)
+        except BaseException as e:
+            errs[rank] = e
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(nprocs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_s)
+    assert not any(t.is_alive() for t in ts), "a rank hung past the join budget"
+    return out, errs
+
+
+# -- async == sync ------------------------------------------------------------
+
+
+def _round_payloads(rank, tick, rng_seed=13):
+    rng = np.random.default_rng(rng_seed + 31 * rank + tick)
+    sparse = np.zeros((128, 4), np.uint32)
+    sparse[rng.choice(128, 9, replace=False)] = rng.integers(
+        1, 2**32, (9, 4), dtype=np.uint32
+    )
+    dense = rng.integers(1, 2**32, (32, 4), dtype=np.uint32)
+    return [sparse, dense]
+
+
+def test_async_rounds_equal_sync_rounds_including_accounting():
+    """Two legs per tick for several ticks, once through blocking
+    ``exchange`` and once with BOTH legs' handles held in flight before
+    either is waited: identical arrays out, identical wire/raw byte
+    totals and codec mix (packing happens at enqueue either way)."""
+
+    def sync_body(fab, rank):
+        peer = 1 - rank
+        seen = []
+        for tick in range(3):
+            a = fab.exchange(tick * 16, {peer: _round_payloads(rank, tick)}, [peer])
+            b = fab.exchange(tick * 16 + 1, {peer: _round_payloads(rank, tick + 100)}, [peer])
+            seen.append((a[peer], b[peer]))
+        return seen, fab.wire_stats()
+
+    def async_body(fab, rank):
+        peer = 1 - rank
+        seen = []
+        for tick in range(3):
+            h1 = fab.exchange_async(tick * 16, {peer: _round_payloads(rank, tick)}, [peer])
+            h2 = fab.exchange_async(
+                tick * 16 + 1, {peer: _round_payloads(rank, tick + 100)}, [peer]
+            )
+            # both rounds in flight; join receives only — the drain is
+            # the sender threads' business
+            a = h1.wait(join_sends=False)
+            b = h2.wait(join_sends=False)
+            seen.append((a[peer], b[peer]))
+        return seen, fab.wire_stats()
+
+    out_s, errs_s = _run_ranks(2, sync_body, "asyncs")
+    out_a, errs_a = _run_ranks(2, async_body, "asynca")
+    assert errs_s == [None, None] and errs_a == [None, None], (errs_s, errs_a)
+    for rank in range(2):
+        seen_s, ws_s = out_s[rank]
+        seen_a, ws_a = out_a[rank]
+        for (a_s, b_s), (a_a, b_a) in zip(seen_s, seen_a):
+            for x, y in zip(a_s + b_s, a_a + b_a):
+                assert x.tobytes() == y.tobytes()
+        assert ws_s == ws_a, "async round accounting diverged from sync"
+
+
+def test_inflight_stream_xor_history_stays_exact():
+    """Several STREAMED rounds enqueued before any is waited: the
+    XOR-delta payload history advances in enqueue order on the sender
+    and FIFO decode order on the receiver, so every round decodes exact
+    — and the wire total matches the fully synchronous run (same
+    encodings chosen)."""
+    base = np.zeros((64, 4), np.uint32)
+    base[5] = 7
+
+    def payload(tick):
+        a = base.copy()
+        a[0, 0] = tick
+        return a
+
+    def async_body(fab, rank):
+        peer = 1 - rank
+        handles = [
+            fab.exchange_async(t, {peer: [payload(t)]}, [peer], stream="s")
+            for t in range(4)
+        ]
+        got = [h.wait(join_sends=False) for h in handles]
+        return [g[peer][0] for g in got], fab.wire_stats()
+
+    def sync_body(fab, rank):
+        peer = 1 - rank
+        got = [
+            fab.exchange(t, {peer: [payload(t)]}, [peer], stream="s")
+            for t in range(4)
+        ]
+        return [g[peer][0] for g in got], fab.wire_stats()
+
+    out_a, errs_a = _run_ranks(2, async_body, "xora")
+    out_s, errs_s = _run_ranks(2, sync_body, "xors")
+    assert errs_a == [None, None] and errs_s == [None, None], (errs_a, errs_s)
+    for rank in range(2):
+        arrs, ws_a = out_a[rank]
+        refs, ws_s = out_s[rank]
+        for t, (a, r) in enumerate(zip(arrs, refs)):
+            assert a.tobytes() == payload(t).tobytes()
+            assert a.tobytes() == r.tobytes()
+        assert ws_a == ws_s
+        # the stream actually engaged the XOR codec past the first round
+        assert ws_a["codec_counts"].get("xor", 0) >= 1, ws_a["codec_counts"]
+
+
+# -- failure modes under in-flight completions --------------------------------
+
+
+def test_two_dead_peers_aggregate_into_one_raise():
+    """A round failing on SEVERAL peers must surface every failure:
+    before r16 only ``errs[0]`` escaped and a multi-peer outage read as
+    a single-peer one.  Ranks 1 and 2 die after bring-up; rank 3 stays
+    honest; rank 0's exchange must raise with BOTH dead peers attached
+    (``peer_errors`` + the ``__context__`` chain)."""
+
+    def body(fab, rank):
+        if rank in (1, 2):
+            fab.close()
+            return "died"
+        if rank == 3:
+            try:
+                fab.exchange(
+                    0, {0: [np.arange(4, dtype=np.uint32)]}, [0]
+                )
+            except FabricError:
+                pass  # rank 0 may abort before sending back
+            return "peer3"
+        time.sleep(0.3)  # let 1 and 2 actually die first
+        peers = [1, 2, 3]
+        fab.exchange(
+            0, {p: [np.arange(4, dtype=np.uint32)] for p in peers}, peers
+        )
+        return "unreachable"
+
+    out, errs = _run_ranks(4, body, "twodead", timeout_ms=30_000)
+    assert out[1] == "died" and out[2] == "died"
+    e = errs[0]
+    assert isinstance(e, FabricError), e
+    attached = getattr(e, "peer_errors", (e,))
+    assert len(attached) >= 2, f"second dead peer dropped: {attached}"
+    texts = [str(x) for x in attached]
+    assert any("peer 1" in t for t in texts), texts
+    assert any("peer 2" in t for t in texts), texts
+    # the chain renders in ONE traceback: context links the rest
+    assert e.__context__ is not None
+
+
+def test_kill_one_rank_fails_inflight_completion_promptly():
+    """A peer dying while a completion handle is already in flight must
+    fail that handle's wait() with a typed FabricPeerLost promptly — not
+    at timeout_ms, and not silently at the next round."""
+
+    def body(fab, rank):
+        if rank == 1:
+            got = fab.exchange(0, {0: [np.arange(3, dtype=np.uint32)]}, [0])
+            assert got[0][0].shape == (3,)
+            fab.close()  # die with rank 0's tick-1 expectation in flight
+            return "died"
+        fab.exchange(0, {1: [np.arange(3, dtype=np.uint32)]}, [1])
+        h = fab.exchange_async(1, {1: [np.arange(3, dtype=np.uint32)]}, [1])
+        t0 = time.monotonic()
+        with pytest.raises(FabricPeerLost, match="peer 1"):
+            h.wait()
+        return time.monotonic() - t0
+
+    out, errs = _run_ranks(2, body, "killinflight", timeout_ms=30_000)
+    assert errs == [None, None], errs
+    assert out[1] == "died"
+    assert out[0] < 15, f"peer-lost took {out[0]}s — that is a timeout, not EOF"
+
+
+def test_stalled_peer_times_out_inflight_completion():
+    """A live-but-silent peer fails an in-flight completion with
+    FabricTimeout at ~timeout_ms (the demux thread's socket timeout)."""
+
+    def body(fab, rank):
+        if rank == 1:
+            time.sleep(2.0)  # wedged: never sends
+            return "stalled"
+        h = fab.exchange_async(7, {}, [1])
+        with pytest.raises(FabricTimeout, match="peer 1"):
+            h.wait()
+        return "timed-out"
+
+    out, errs = _run_ranks(2, body, "stallinflight", timeout_ms=600)
+    assert errs == [None, None], errs
+    assert out == ["timed-out", "stalled"]
+
+
+def test_unjoined_send_error_is_sticky_and_surfaces_at_next_enqueue():
+    """Overlap mode never joins sends — a drain failure must not vanish:
+    the sender thread's sticky error fails the NEXT exchange_async on
+    that fabric."""
+
+    def body(fab, rank):
+        if rank == 1:
+            got = fab.exchange(0, {0: [np.zeros(2, np.uint32)]}, [0])
+            fab.close()
+            return "died"
+        fab.exchange(0, {1: [np.zeros(2, np.uint32)]}, [1])
+        # big payload so the drain outlives the peer's close; never joined
+        big = np.arange(2_000_000, dtype=np.uint32)
+        fab.exchange_async(1, {1: [big]}, [])
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            try:
+                fab.exchange_async(2, {1: [np.zeros(2, np.uint32)]}, [])
+            except FabricError:
+                return "sticky-surfaced"
+        return "never-surfaced"
+
+    out, errs = _run_ranks(2, body, "sticky", timeout_ms=30_000)
+    assert errs == [None, None], errs
+    assert out[0] == "sticky-surfaced"
+
+
+def test_close_fails_every_queued_expectation_promptly():
+    """close() with SEVERAL receive expectations still queued on one
+    peer must fail every queued future promptly — the drain helper has
+    to recognize the typed _RecvJob (itself a tuple) and not die on it,
+    which would leave later waiters blocking into a misleading
+    timeout."""
+
+    def body(fab, rank):
+        if rank == 1:
+            time.sleep(0.4)  # never sends; peer 0 closes on its own
+            return "idle"
+        h1 = fab.exchange_async(0, {}, [1])
+        h2 = fab.exchange_async(1, {}, [1])
+        fab.close()
+        t0 = time.monotonic()
+        for h in (h1, h2):
+            with pytest.raises(FabricError):
+                h.wait()
+        return time.monotonic() - t0
+
+    out, errs = _run_ranks(2, body, "closeq", timeout_ms=10_000)
+    assert errs == [None, None], errs
+    assert out[1] == "idle"
+    assert out[0] < 5, f"queued expectation hung {out[0]}s past the close"
+
+
+def test_closed_fabric_refuses_rounds():
+    fab = Fabric(0, 1, LocalKV())
+    fab.close()
+    with pytest.raises(FabricError, match="closed"):
+        fab.exchange_async(0, {}, [])
+
+
+# -- the swing schedule -------------------------------------------------------
+
+
+def _simulate_swing(plane, rel_start, nprocs):
+    """Pure-host replay of the swing manifests: every rank's store
+    stepped through the rounds with in-memory delivery — an independent
+    executor for the plan, so the engine's device path is not the only
+    interpretation of the schedule."""
+    n = plane.shape[0]
+    b = n // nprocs
+    rounds = plan_window_swing(rel_start % n, n, nprocs)
+    stores = [dict() for _ in range(nprocs)]
+    hops: dict[tuple, int] = {}
+    for j, manifest in enumerate(rounds):
+        moved = []
+        for holder, entries in manifest.items():
+            dst_rank = holder ^ (1 << j)
+            for entry in entries:
+                d, owner, glo, glen, woff = entry
+                if owner == holder:
+                    payload = plane[glo : glo + glen]
+                else:
+                    payload = stores[holder].pop(entry)
+                moved.append((dst_rank, entry, payload))
+                hops[entry] = hops.get(entry, 0) + 1
+        for dst_rank, entry, payload in moved:
+            stores[dst_rank][entry] = payload
+    # assemble every rank's window and check hop bounds
+    out = []
+    log_p = nprocs.bit_length() - 1
+    for entry, k in hops.items():
+        assert k <= log_p, f"{entry} took {k} hops > log2(P)={log_p}"
+        assert k == bin(entry[1] ^ entry[0]).count("1")
+    for r in range(nprocs):
+        lo = r * b
+        my_plan = plan_window((lo + rel_start) % n, b, n, nprocs)
+        parts = []
+        for owner, glo, glen, woff in my_plan:
+            if owner == r:
+                parts.append(plane[glo : glo + glen])
+            else:
+                parts.append(stores[r].pop((r, owner, glo, glen, woff)))
+        assert not stores[r], f"rank {r} left undelivered pieces: {stores[r]}"
+        out.append(np.concatenate(parts) if len(parts) > 1 else parts[0])
+    return out
+
+
+def test_swing_assembly_byte_identical_to_cyclic_property_sweep():
+    """Random (n, P, shift, K): the swing-relayed window of every rank
+    equals both the cyclic-plan assembly and the direct cyclic-take
+    oracle, byte for byte."""
+    rng = np.random.default_rng(42)
+    for trial in range(40):
+        nprocs = int(rng.choice([2, 4, 8, 16]))
+        n = nprocs * int(rng.integers(1, 9))
+        k = int(rng.integers(1, 5))
+        shift = int(rng.integers(-2 * n, 2 * n))
+        plane = rng.integers(0, 2**32, (n, k), dtype=np.uint32)
+        b = n // nprocs
+        windows = _simulate_swing(plane, shift, nprocs)
+        for r in range(nprocs):
+            start = (r * b + shift) % n
+            oracle = np.take(
+                plane, (start + np.arange(b)) % n, axis=0
+            )
+            assert windows[r].tobytes() == oracle.tobytes(), (
+                trial, n, nprocs, shift, r
+            )
+
+
+def test_swing_plan_refuses_non_power_of_two():
+    with pytest.raises(ValueError, match="power-of-two"):
+        plan_window_swing(1, 12, 3)
+
+
+def test_swing_allgather_matches_cyclic_bitwise():
+    """allgather(schedule='swing') returns the same per-rank list as the
+    cyclic full mesh — including with an XOR stream attached — so any
+    bitwise reduce over it is schedule-invariant."""
+    arrs = {
+        r: np.arange(8, dtype=np.uint32) * (r + 1) for r in range(4)
+    }
+
+    def body_for(schedule):
+        def body(fab, rank):
+            got = []
+            for tick in range(3):
+                a = arrs[rank] + tick
+                got.append(
+                    fab.allgather(tick * 16, a, stream="reduce", schedule=schedule)
+                )
+            return got
+        return body
+
+    out_c, errs_c = _run_ranks(4, body_for("cyclic"), "agc")
+    out_s, errs_s = _run_ranks(4, body_for("swing"), "ags")
+    assert errs_c == [None] * 4 and errs_s == [None] * 4, (errs_c, errs_s)
+    for rank in range(4):
+        for tick in range(3):
+            ref = [arrs[r] + tick for r in range(4)]
+            for a, b, c in zip(out_c[rank][tick], out_s[rank][tick], ref):
+                assert a.tobytes() == b.tobytes() == c.tobytes()
+
+
+def test_swing_allgather_refuses_non_power_of_two():
+    def body(fab, rank):
+        with pytest.raises(ValueError, match="power-of-two"):
+            fab.allgather(0, np.zeros(2, np.uint32), schedule="swing")
+        return "refused"
+
+    out, errs = _run_ranks(3, body, "agrefuse")
+    assert errs == [None] * 3, errs
+    assert out == ["refused"] * 3
+
+
+# -- plan_window hardening (r16 satellite) ------------------------------------
+
+
+def test_plan_window_refuses_non_divisible_n():
+    """Pre-r16 this silently planned over truncated b = n // nprocs
+    blocks, leaving the ring's tail rows owned by nobody."""
+    with pytest.raises(ValueError, match="divide"):
+        plan_window(0, 25, 100, 3)
+    with pytest.raises(ValueError, match="divide"):
+        plan_window(7, 5, 17, 4)
+
+
+def test_window_edges_zero_length_full_ring_large_shift():
+    # zero-length window: empty pieces, empty plan (previously an
+    # internal assert tripped on a degenerate intersect)
+    assert window_pieces(5, 0, 64) == []
+    assert plan_window(5, 0, 64, 4) == []
+    # full-ring window (P=1 uses block == n)
+    assert window_pieces(0, 64, 64) == [(0, 64)]
+    assert window_pieces(10, 64, 64) == [(10, 54), (0, 10)]
+    plan = plan_window(10, 64, 64, 1)
+    assert sum(glen for _, _, glen, _ in plan) == 64
+    # shift >= n and negative shifts reduce mod n
+    assert window_pieces(100, 8, 64) == window_pieces(36, 8, 64)
+    assert plan_window(-28, 8, 64, 4) == plan_window(36, 8, 64, 4)
+    # over-long window is a loud contract violation, not a double-cover
+    with pytest.raises(ValueError, match="outside"):
+        window_pieces(0, 65, 64)
+
+
+def test_plan_window_non_power_of_two_process_count():
+    """The cyclic plan stays correct at P=3 (swing is the one that
+    requires a power of two): full coverage, right owners."""
+    n, nprocs = 96, 3
+    b = n // nprocs
+    for start in (0, 1, 31, 32, 63, 95):
+        plan = plan_window(start, b, n, nprocs)
+        covered = sorted(
+            (woff + i, (glo + i) % n)
+            for _, glo, glen, woff in plan
+            for i in range(glen)
+        )
+        assert [c[0] for c in covered] == list(range(b))
+        for owner, glo, glen, _ in plan:
+            assert owner == glo // b, "piece assigned off its owner block"

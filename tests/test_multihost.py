@@ -86,7 +86,8 @@ def _engine_digest(params, faults, seed, ticks):
     return int(tree_digest(st)), st
 
 
-def _fabric_digests(params, faults, seed, ticks, nprocs, ns, codec=True):
+def _fabric_digests(params, faults, seed, ticks, nprocs, ns, codec=True,
+                    schedule="cyclic", overlap=False):
     from ringpop_tpu.parallel.fabric import Fabric, LocalKV
     from ringpop_tpu.sim.delta_multihost import MultihostDelta
 
@@ -97,7 +98,8 @@ def _fabric_digests(params, faults, seed, ticks, nprocs, ns, codec=True):
     def run(rank):
         try:
             with Fabric(rank, nprocs, kv, namespace=ns, codec=codec) as fab:
-                mh = MultihostDelta(params, fab, seed=seed, faults=faults)
+                mh = MultihostDelta(params, fab, seed=seed, faults=faults,
+                                    schedule=schedule, overlap=overlap)
                 for _ in range(ticks):
                     mh.step()
                 out[rank] = (
@@ -141,6 +143,79 @@ def test_fabric_step_bit_identical_to_engine(nprocs, codec):
     assert {o[0] for o in out} == {ref}
     # coverage is the exact popcount fraction — identical on every rank
     assert len({o[1] for o in out}) == 1
+
+
+@pytest.mark.parametrize("overlap", [False, True], ids=["sequential", "overlap"])
+@pytest.mark.parametrize("schedule", ["cyclic", "swing"])
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_swing_and_overlap_bit_identical_to_engine(nprocs, schedule, overlap):
+    """The r16 acceptance grid: every (schedule, overlap) combination at
+    P in {2, 4} produces the engine digest under victims + loss, codec
+    on — swing relays and cross-tick pipelining are bit-transparent.
+    (The cyclic/sequential corner is the r15 path, re-pinned above.)"""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim.delta import DeltaFaults, DeltaParams
+
+    if schedule == "cyclic" and not overlap:
+        pytest.skip("the r15 corner — covered by the codec twin above")
+    params = DeltaParams(n=256, k=64, rng="counter")
+    up = np.ones(256, bool)
+    up[::11] = False
+    faults = DeltaFaults(up=jnp.asarray(up), drop_rate=jnp.float32(0.07))
+    ref, _ = _engine_digest(params, faults, seed=6, ticks=9)
+    out = _fabric_digests(
+        params, faults, 6, 9, nprocs, f"so{nprocs}{schedule}{int(overlap)}",
+        schedule=schedule, overlap=overlap,
+    )
+    assert {o[0] for o in out} == {ref}
+    assert len({o[1] for o in out}) == 1
+
+
+def test_swing_relay_overhead_priced_at_p4_and_absent_at_p2():
+    """The swing relay's extra wire bytes are REAL accounting, not
+    hidden: at P=2 the swing schedule degenerates to the cyclic messages
+    (identical wire totals); at P=4 relayed pieces cost strictly more
+    raw bytes than the direct cyclic sends — the overhead the simbench
+    artifact prices explicitly."""
+    from ringpop_tpu.sim.delta import DeltaParams
+
+    params = DeltaParams(n=256, k=64, rng="counter")
+    ticks = 6
+    by = {}
+    for nprocs in (2, 4):
+        for schedule in ("cyclic", "swing"):
+            out = _fabric_digests(
+                params, None, 5, ticks, nprocs, f"rp{nprocs}{schedule}",
+                codec=False, schedule=schedule,
+            )
+            by[(nprocs, schedule)] = out[0][4]["raw_bytes_sent"]
+    assert by[(2, "swing")] == by[(2, "cyclic")]
+    assert by[(4, "swing")] > by[(4, "cyclic")]
+
+
+def test_swing_refuses_non_power_of_two_fabric():
+    from ringpop_tpu.parallel.fabric import Fabric, LocalKV
+    from ringpop_tpu.sim.delta import DeltaParams
+    from ringpop_tpu.sim.delta_multihost import MultihostDelta
+
+    params = DeltaParams(n=96, k=64, rng="counter")
+    kv = LocalKV()
+    out = [None] * 3
+
+    def run(rank):
+        with Fabric(rank, 3, kv, namespace="swref") as fab:
+            try:
+                MultihostDelta(params, fab, schedule="swing")
+            except ValueError as e:
+                out[rank] = "power-of-two" in str(e)
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert out == [True, True, True]
 
 
 @pytest.mark.parametrize("nprocs", [1, 2, 4])
@@ -207,10 +282,18 @@ def test_journal_carries_per_tick_deltas_and_ratio():
             assert rec["fabric_raw_sent_delta"] >= rec["fabric_wire_sent_delta"]
             assert ("digest" in rec) == (t % 2 == 1), "light/full digest mix"
             assert "coverage" in rec
+            # r16 observability: schedule name + per-leg drain/overlap
+            # timing ride every record (OBSERVABILITY.md schema rows)
+            assert rec["schedule"] == "cyclic" and rec["overlap"] is False
+            assert set(rec["fabric_leg_ms"]) == {"leg1", "leg2", "reduce"}
+            assert all(v >= 0.0 for v in rec["fabric_leg_ms"].values())
+            assert rec["overlap_hidden_ms"] >= 0.0
         # deltas telescope back to the cumulative counter
         assert sum(r["fabric_wire_sent_delta"] for r in per_tick) == (
             per_tick[-1]["fabric_bytes_sent"]
         )
+        # something actually blocked on the wire over the run
+        assert sum(r["fabric_leg_ms"]["leg1"] for r in per_tick) > 0.0
 
 
 def test_state_reinstall_across_process_counts_resets_codec_epoch():
