@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke multihost-smoke dcn-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke multihost-smoke dcn-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -51,6 +51,16 @@ mc-smoke:
 # asserted here (2-core CI container).
 serve-smoke:
 	$(PY) scripts/serve_smoke.py
+
+# production-fan-in serve-plane gate (r17): forward-then-answer round
+# trip (per-owner coalesced batch -> fused LookupN answer == host walk,
+# ONE RPC per owner), quorum reads under an owner-killing FaultPlan
+# (acks >= ceil((R+1)/2) every wave, recovery scored by chaos.score_blocks),
+# and the P=2 serve mesh digest-equal to the single-process oracle.
+# Correctness only — the throughput curve is the committed SIMBENCH
+# serve_fanin artifact, never asserted on the 2-core container.
+serve-fanin-smoke:
+	$(PY) scripts/serve_fanin_smoke.py
 
 # multi-host DCN-fabric gate (r14): 2 coordinated OS processes through the
 # real jax.distributed bring-up — 1-proc vs 2-proc twin digests must equal

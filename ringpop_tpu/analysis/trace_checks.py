@@ -551,6 +551,16 @@ def build_entrypoints(mesh=None) -> dict:
     out["serve_lookup_n"] = jax.make_jaxpr(
         lambda t, o, c, h: ring_ops._lookup_n_window_padded(t, o, c, h, 3, 16)
     )(sring.tokens, sring.owners, sring.count[0], jnp.asarray(shashes))
+    # the r17 fused LookupN serve dispatch: the windowed scan with the
+    # generation concatenated into the flattened owner matrix — the
+    # program the collector's n>1 flushes and the serve mesh actually
+    # run; 32-bit, callback-free, collective-free (census in
+    # run_hlo_checks) like its n=1 sibling
+    out["serve_lookup_n_fused"] = jax.make_jaxpr(
+        lambda r, ns, h: serve_state._serve_lookup_n_window_fused(
+            r, ns, h, 3, 16
+        )
+    )(sring, jnp.int32(12), jnp.asarray(shashes))
 
     # the r15 multihost device-side window programs: the P=1 full-window
     # gather and the per-leg nonzero-row summary + compaction
@@ -867,6 +877,21 @@ def run_hlo_checks() -> list[Finding]:
             .as_text()
         )
     findings += check_hlo_collective_free("serve_lookup[hlo,dense]", serve_text)
+
+    # r17: the fused LookupN dispatch compiled dense — same collective-
+    # free bar (a collective in the preference-list program would
+    # serialize every mesh rank's answer path behind ICI)
+    with _no_compile_cache():
+        fanin_text = (
+            serve_state._serve_lookup_n_window_fused.lower(
+                sring, jnp.int32(12), jnp.asarray(shashes), n=3, w=16
+            )
+            .compile()
+            .as_text()
+        )
+    findings += check_hlo_collective_free(
+        "serve_lookup_n_fused[hlo,dense]", fanin_text
+    )
 
     # r15: the multihost device-side window programs compiled dense —
     # they run per-process OUTSIDE the mesh, so their census must show

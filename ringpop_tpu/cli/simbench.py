@@ -1457,6 +1457,129 @@ def bench_serve_ring(seed: int, full: bool) -> dict:
     }
 
 
+def bench_serve_fanin(seed: int, full: bool) -> dict:
+    """Serve at production fan-in (r17): the three-legged certificate of
+    the LookupN serve plane.
+
+    1. **mesh** — P∈{1, 2, 4} serve ranks each owning a contiguous ring
+       block (the r14 ``process_block`` rule over the token index space)
+       cross-forward mis-routed keys over the fabric (``exchange_async``
+       + the r15 codec) and answer owner + R successors through the
+       fused LookupN dispatch.  Certificate: every rank's combined
+       (owner, successors, generation) stream digest at every P equals
+       the single-process oracle — which is itself pinned to the pure
+       host ``LookupNUniqueAt`` walk.  The keys/s/host scaling curve and
+       per-host wire bytes are recorded per P (threads on a 2-core
+       container: the curve is honest measurement, not a scaling claim —
+       real-chip pricing is the ksweep ``serve_fanin`` section).
+    2. **forwarding** — the per-owner batch coalescing pricing: mesh
+       messages per rank are 2·(P-1)·rounds regardless of key volume,
+       recorded against the one-message-per-forwarded-key naive plane
+       (strictly below is part of the certificate).
+    3. **quorum** — R-replica reads on LookupN preference lists under a
+       FaultPlan killing owners mid-read (staggered, restarting): acks
+       must stay ≥ ⌈(R+1)/2⌉ on EVERY wave, answers must agree, and the
+       full-replication recovery curve is scored through
+       ``chaos.score_blocks``.
+    """
+    from ringpop_tpu.forward.batch import quorum_chaos_run
+    from ringpop_tpu.serve.mesh import run_serve_mesh
+
+    kw = (
+        dict(n_servers=64, replica_points=100, n=3, streams=4, rounds=6,
+             keys_per_stream=8192)
+        if full
+        else dict(n_servers=16, replica_points=20, n=3, streams=4, rounds=3,
+                  keys_per_stream=2048)
+    )
+    journal = None
+    if _TELEMETRY_PATH is not None:
+        from ringpop_tpu.sim.telemetry import TelemetryJournal
+
+        journal = TelemetryJournal(_TELEMETRY_PATH, append=True)
+        journal.header("serve", "serve_fanin", {"seed": seed, "full": full, **kw})
+
+    try:
+        curve = []
+        oracle_digest = None
+        digests_equal = True
+        messages_ok = True
+        for nprocs in (1, 2, 4):
+            recs = run_serve_mesh(nprocs, seed=seed, **kw)
+            if oracle_digest is None:
+                oracle_digest = recs[0]["digest"]
+            digests_equal = digests_equal and all(
+                r["digest"] == oracle_digest for r in recs
+            )
+            wall = max(r["wall_s"] for r in recs)
+            keys_total = sum(r["keys_total"] for r in recs)
+            wire_mb = [round(r["wire"]["bytes_sent"] / 1e6, 3) for r in recs]
+            msgs = sum(r["messages_sent"] for r in recs)
+            naive = sum(r["messages_naive"] for r in recs)
+            if nprocs > 1:
+                messages_ok = messages_ok and msgs < naive
+            point = {
+                "nprocs": nprocs,
+                "keys_total": keys_total,
+                "wall_s_max": wall,
+                "keys_per_s_aggregate": round(keys_total / max(wall, 1e-9)),
+                "keys_per_s_per_host": round(
+                    keys_total / max(wall, 1e-9) / nprocs
+                ),
+                "keys_forwarded": sum(r["keys_forwarded_out"] for r in recs),
+                "messages": msgs,
+                "messages_naive": naive,
+                "wire_mb_per_host": wire_mb,
+                "raw_mb_per_host": [
+                    round(r["wire"]["raw_bytes_sent"] / 1e6, 3) for r in recs
+                ],
+                "digests": sorted({r["digest"] for r in recs}),
+            }
+            curve.append(point)
+            if journal is not None:
+                journal._write({"kind": "serve_mesh", **point})
+
+        quorum = quorum_chaos_run(
+            n_servers=8, replica_points=16, r=3,
+            keys_per_tick=kw["keys_per_stream"] // 16,
+            horizon=32 if full else 24, seed=seed,
+        )
+        if journal is not None:
+            for blk in quorum["blocks"]:
+                journal._write({"kind": "serve_forward", **blk})
+            journal._write(quorum["score"])
+        quorum_ok = bool(
+            quorum["owners_killed"] and quorum["quorum_held"]
+            and quorum["answers_agree"] and quorum["rpcs"] < quorum["rpcs_naive"]
+        )
+        certified = bool(digests_equal and messages_ok and quorum_ok)
+        ttd = quorum["score"]["time_to_detect_median"]
+        return {
+            "metric": "serve_fanin",
+            "value": curve[-1]["keys_per_s_per_host"],
+            "unit": "keys_per_s_per_host_at_p4",
+            "certified": certified,
+            "oracle_digest": oracle_digest,
+            "digests_equal": digests_equal,
+            "messages_below_naive": messages_ok,
+            "scaling_curve": curve,
+            "lookup_n": kw["n"],
+            "n_servers": kw["n_servers"],
+            "replica_points": kw["replica_points"],
+            "quorum": {
+                k: quorum[k]
+                for k in ("r", "quorum", "n_servers", "owners_killed",
+                          "quorum_held", "answers_agree", "rpcs",
+                          "rpcs_naive", "rpc_ratio")
+            },
+            "quorum_recovery_ticks_median": ttd,
+            "quorum_acks_min": quorum["score"].get("quorum_acks_min"),
+        }
+    finally:
+        if journal is not None:
+            journal.close()
+
+
 def _run_chaos_scenario(scenario: str, plan_name: str, n: int, k: int,
                         horizon: int, seed: int, suspect_ticks: int = 10,
                         journal_every: int = 16) -> dict:
@@ -2077,6 +2200,7 @@ BENCHES = {
     "forward_comparator": bench_forward_comparator,
     "forward_ab": bench_forward_ab,
     "serve_ring": bench_serve_ring,
+    "serve_fanin": bench_serve_fanin,
     "mc_churn": bench_mc_churn,
     "mc_chaos": bench_mc_chaos,
     "partition_lc": bench_partition_lifecycle,

@@ -21,3 +21,36 @@ class EphemeralIdentityError(RingpopError):
 
 class InvalidStateError(RingpopError):
     pass
+
+
+# -- the unified transport error family (r17) ---------------------------------
+#
+# One peer-lifecycle/error model for every transport — the DCN fabric,
+# the serve TCP framing, the shm ring.  Defined HERE (an import-free
+# leaf) so the jax-free surfaces (net/channel.py, forward/batch.py,
+# serve/shm.py — what frontend processes import without paying a
+# backend init) can share the family with parallel/fabric.py, which
+# re-exports them under their historical import path.
+
+
+class FabricError(RuntimeError):
+    """Any fabric-layer (or unified-transport) failure with peer
+    context attached."""
+
+
+class FabricPeerLost(FabricError):
+    """A peer's socket closed mid-run — the peer process died (or shut
+    its transport down) while this side still expected messages from
+    it.  Channel flavor: connect refused / connection dropped."""
+
+
+class FabricTimeout(FabricError):
+    """A live but SILENT peer: nothing arrived (or a send could not
+    drain) within the deadline.  Distinct from a tag desync — the
+    schedule may still agree; the peer is wedged or partitioned."""
+
+
+class FabricDesync(FabricError):
+    """A message arrived with the WRONG tag: the peers' deterministic
+    schedules disagree (a leg skipped or reordered).  Both endpoints
+    are alive — that is what distinguishes this from the two above."""

@@ -6,6 +6,14 @@ from ringpop_tpu.forward.forwarder import (
     set_forwarded_header,
     has_forwarded_header,
 )
+from ringpop_tpu.forward.batch import (
+    BatchForwarder,
+    BlockRouter,
+    HOPS_HEADER,
+    MaxHopsExceededError,
+    QuorumReader,
+    quorum_size,
+)
 from ringpop_tpu.forward.request_sender import DestinationsDivergedError
 
 __all__ = [
@@ -16,4 +24,10 @@ __all__ = [
     "set_forwarded_header",
     "has_forwarded_header",
     "DestinationsDivergedError",
+    "BatchForwarder",
+    "BlockRouter",
+    "HOPS_HEADER",
+    "MaxHopsExceededError",
+    "QuorumReader",
+    "quorum_size",
 ]

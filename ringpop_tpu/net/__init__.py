@@ -2,18 +2,24 @@ from ringpop_tpu.net.channel import (
     CallError,
     RemoteError,
     CallTimeoutError,
+    PeerUnreachableError,
     BaseChannel,
     TCPChannel,
     LocalNetwork,
     LocalChannel,
+    encode_array,
+    decode_array,
 )
 
 __all__ = [
     "CallError",
     "RemoteError",
     "CallTimeoutError",
+    "PeerUnreachableError",
     "BaseChannel",
     "TCPChannel",
     "LocalNetwork",
     "LocalChannel",
+    "encode_array",
+    "decode_array",
 ]

@@ -166,12 +166,48 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
 # when it is JSON (~1.33x the raw bytes, one C-accelerated decode).  The
 # decoder is self-describing on the value type, so mixed-codec
 # client/server pairs interoperate like the frames themselves do.
+#
+# r17 (the unified-transport slice): arrays can additionally ride the
+# FABRIC's r15 wire codec (``parallel/fabric.py`` — zero-row/zero-run
+# suppression with a measured raw fallback): ``encode_array(...,
+# fabric=True)`` wraps the fabric-framed payload in a one-key dict, so
+# ``decode_array`` stays self-describing (a dict value IS a fabric
+# array; bytes/str stay the plain little-endian lanes) and forwarded
+# batches get the serve mesh's codec for free over the SAME endpoints.
+
+_FABRIC_ARRAY_KEY = "_fab"
 
 
-def encode_array(arr, codec: str, dtype: str = "<u4"):
-    """A frame-body value for a numeric array under ``codec``."""
+def _fabric_frame(a) -> bytes:
+    """One array, fabric-framed (``parallel.fabric.frame_array``):
+    byte-identical to what the same array costs inside a fabric
+    exchange message."""
+    from ringpop_tpu.parallel.fabric import frame_array
+
+    return frame_array(a)
+
+
+def _fabric_unframe(data: bytes):
+    from ringpop_tpu.parallel.fabric import unframe_array
+
+    return unframe_array(data)
+
+
+def encode_array(arr, codec: str, dtype: str = "<u4", fabric: bool = False):
+    """A frame-body value for a numeric array under ``codec``.
+    ``fabric=True`` routes the payload through the fabric's r15 wire
+    codec instead of the plain little-endian lane (dtype/shape become
+    self-describing; sparse payloads shrink, dense ones pay only the
+    measured-fallback header)."""
     import numpy as _np
 
+    if fabric:
+        data = _fabric_frame(_np.asarray(arr, dtype=dtype))
+        if codec == "msgpack":
+            return {_FABRIC_ARRAY_KEY: data}
+        import base64 as _b64
+
+        return {_FABRIC_ARRAY_KEY: _b64.b64encode(data).decode("ascii")}
     data = _np.ascontiguousarray(_np.asarray(arr), dtype=dtype).tobytes()
     if codec == "msgpack":
         return data
@@ -181,9 +217,23 @@ def encode_array(arr, codec: str, dtype: str = "<u4"):
 
 
 def decode_array(value, dtype: str = "<u4"):
-    """Inverse of :func:`encode_array` (accepts either representation)."""
+    """Inverse of :func:`encode_array` (accepts every representation —
+    plain bytes, base64 text, or the fabric-coded dict; mixed-codec and
+    mixed-lane client/server pairs interoperate)."""
     import numpy as _np
 
+    if isinstance(value, dict):
+        data = value[_FABRIC_ARRAY_KEY]
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            import base64 as _b64
+
+            data = _b64.b64decode(data)
+        out = _fabric_unframe(bytes(data))
+        # fabric frames carry their own dtype; the caller's expectation
+        # reinterprets (two's-complement view, same as the plain lane's
+        # frombuffer) rather than converting
+        out = out.reshape(-1)
+        return out if out.dtype.str == dtype else out.view(_np.dtype(dtype))
     if isinstance(value, (bytes, bytearray, memoryview)):
         data = bytes(value)
     else:
@@ -193,12 +243,32 @@ def decode_array(value, dtype: str = "<u4"):
     return _np.frombuffer(data, dtype=dtype)
 
 
-class CallError(Exception):
+# the r17 unified error model: channel failures ARE fabric failures —
+# one peer-lifecycle/error family across the serve TCP framing, the shm
+# ring and the DCN fabric, so callers branch on FabricTimeout /
+# FabricPeerLost semantics regardless of which transport carried the
+# request.  The family lives in the import-free leaf ringpop_tpu.errors
+# (NOT parallel.fabric — importing anything under parallel executes its
+# __init__ and drags jax into every frontend that imports this module).
+from ringpop_tpu.errors import (  # noqa: E402
+    FabricError,
+    FabricPeerLost,
+    FabricTimeout,
+)
+
+
+class CallError(FabricError):
     """A call failed to complete (network error, black hole, timeout)."""
 
 
-class CallTimeoutError(CallError):
-    pass
+class CallTimeoutError(CallError, FabricTimeout):
+    """Nothing answered within the deadline — the channel flavor of a
+    silent peer (``FabricTimeout``)."""
+
+
+class PeerUnreachableError(CallError, FabricPeerLost):
+    """Connect refused / connection dropped — the channel flavor of a
+    dead peer (``FabricPeerLost``)."""
 
 
 class RemoteError(CallError):
@@ -266,7 +336,7 @@ class _PeerConn:
             pass
         for fut in self.pending.values():
             if not fut.done():
-                fut.set_exception(CallError("connection closed"))
+                fut.set_exception(PeerUnreachableError("connection closed"))
         self.pending.clear()
 
 
@@ -282,6 +352,15 @@ class TCPChannel(BaseChannel):
         self._conns: dict[str, _PeerConn] = {}
         self._serving_tasks: set[asyncio.Task] = set()
         self._client_writers: set[asyncio.StreamWriter] = set()
+        # frame-level byte accounting (the fabric's wire_stats contract,
+        # transplanted): every frame this endpoint writes, both roles
+        self.bytes_sent = 0
+        self.frames_sent = 0
+
+    def wire_stats(self) -> dict:
+        """Counter snapshot, shaped like ``Fabric.wire_stats`` so serve
+        journals can state per-transport bytes the same way."""
+        return {"bytes_sent": self.bytes_sent, "frames_sent": self.frames_sent}
 
     # -- server side --------------------------------------------------------
 
@@ -359,6 +438,8 @@ class TCPChannel(BaseChannel):
             )
         try:
             writer.write(payload)
+            self.bytes_sent += len(payload)
+            self.frames_sent += 1
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -375,7 +456,7 @@ class TCPChannel(BaseChannel):
                 host, int(port), limit=MAX_FRAME_BYTES
             )
         except OSError as e:
-            raise CallError(f"connect {peer}: {e}") from e
+            raise PeerUnreachableError(f"connect {peer}: {e}") from e
         conn = _PeerConn(reader, writer)
         conn.reader_task = asyncio.ensure_future(self._read_responses(peer, conn))
         self._conns[peer] = conn
@@ -422,10 +503,12 @@ class TCPChannel(BaseChannel):
             raise CallError(f"encode request for {peer}: {type(e).__name__}: {e}") from e
         try:
             conn.writer.write(encoded)
+            self.bytes_sent += len(encoded)
+            self.frames_sent += 1
             await conn.writer.drain()
         except (ConnectionError, OSError) as e:
             conn.pending.pop(rid, None)
-            raise CallError(f"send to {peer}: {e}") from e
+            raise PeerUnreachableError(f"send to {peer}: {e}") from e
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
@@ -496,7 +579,7 @@ class LocalNetwork:
             raise CallTimeoutError(f"{src}->{dst} dropped")
         target = self.channels.get(dst)
         if target is None:
-            raise CallError(f"connect {dst}: connection refused")
+            raise PeerUnreachableError(f"connect {dst}: connection refused")
         try:
             res = await target.dispatch(
                 service, endpoint, json.loads(_encode_frame(body)), dict(headers)

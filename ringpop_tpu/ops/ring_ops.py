@@ -36,14 +36,25 @@ def _as_u32(a: jax.Array) -> jax.Array:
     return a.astype(jnp.uint32)
 
 
+def ring_composite_order(tokens, owners) -> np.ndarray:
+    """Stable argsort by the canonical ``(token << 32 | owner)``
+    composite — THE collision order every host and device ring shares
+    (``hashring._rebuild``'s rule).  Host-side callers that build or
+    transform flat (token, owner) layouts sort through this ONE helper
+    so a tie-break change can never diverge between copies."""
+    comp = (
+        np.asarray(tokens, np.uint64) << np.uint64(32)
+    ) | np.asarray(owners, np.int64).astype(np.uint64)
+    return np.argsort(comp, kind="stable")
+
+
 def build_ring_tokens(servers: list[str], replica_points: int = 100):
     """Host-side construction of the (tokens, owners) arrays for a server
     list — same hash/replica scheme as the host ring
     (``hashring.go:148-154``); native C++ batch hash when available."""
     toks = _ring_tokens(servers, replica_points).reshape(-1).astype(np.uint32)
     owners = np.repeat(np.arange(len(servers), dtype=np.int32), replica_points)
-    composite = toks.astype(np.uint64) << np.uint64(32) | owners.astype(np.uint64)
-    order = np.argsort(composite, kind="stable")
+    order = ring_composite_order(toks, owners)
     return jnp.asarray(toks[order]), jnp.asarray(owners[order])
 
 
@@ -116,6 +127,60 @@ def ring_lookup_n(
         if w >= t or bool((found >= need).all()):
             return out
         w = min(2 * w, t)
+
+
+def host_lookup_n(tokens, owners, key_hashes, n: int, num_servers: int) -> np.ndarray:
+    """Host-side exact N-unique-owner walk, batched over keys (parity:
+    ``hashring/rbtree.go:262-288`` LookupNUniqueAt + wraparound) — the
+    oracle every device LookupN flavor is pinned against, and the serve
+    tier's ≤64-key host-mirror fast lane (``RingService.dispatch_direct``
+    answers point requests from the committed generation's mirror through
+    this walk, bit-identical to the device dispatch by the property-suite
+    pin).  Returns int32[B, n], -1 padded when fewer than ``n`` unique
+    owners exist."""
+    tokens = np.asarray(tokens, dtype=np.uint32)
+    owners = np.asarray(owners, dtype=np.int32)
+    hashes = np.asarray(key_hashes).astype(np.uint32)
+    b = int(hashes.shape[0])
+    n = max(n, 0)
+    out = np.full((b, n), -1, np.int32)
+    t = int(tokens.shape[0])
+    if t == 0 or n == 0:
+        return out
+    need = min(n, num_servers) if num_servers > 0 else n
+    starts = np.searchsorted(tokens, hashes, side="left").astype(np.int64)
+    # windowed walk with host-side doubling (the device rescue's shape):
+    # per key, only a w ≈ 4n candidate window is ever materialized — at
+    # 100 vnodes/server one window satisfies virtually every key, and
+    # the fast-lane cost stays O(B·w), independent of ring size (a
+    # full-ring owners scan per call would make a single point lookup
+    # O(T) — a ~700x latency cliff at 1M vnodes)
+    remaining = np.arange(b)
+    w = min(max(4 * n, 16), t)
+    while remaining.size:
+        offs = (starts[remaining, None] + np.arange(w)) % t
+        cand = owners[offs]  # [R, w]
+        final = w >= t
+        unfinished = []
+        for row, i in enumerate(remaining):
+            seen: set[int] = set()
+            k = 0
+            for o in cand[row].tolist():
+                if o not in seen:
+                    seen.add(o)
+                    if k < n:
+                        out[i, k] = o
+                    k += 1
+                    if k >= need:
+                        break
+            if k < need and not final:
+                out[i, :] = -1  # partial prefix: rescan at a wider window
+                unfinished.append(i)
+        if final:
+            break
+        remaining = np.asarray(unfinished, np.int64)
+        w = min(2 * w, t)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,13 +1,27 @@
-from ringpop_tpu.parallel.mesh import (
-    make_mesh,
-    shard_delta_state,
-    sharded_delta_step,
-    with_exchange_mesh,
-)
+"""Sharding + multi-host plane.
 
-__all__ = [
-    "make_mesh",
-    "shard_delta_state",
-    "sharded_delta_step",
-    "with_exchange_mesh",
-]
+Lazy exports (PEP 562, same pattern as the serve package):
+``parallel.mesh`` pulls jax at import, but ``parallel.fabric`` is
+numpy-only by design — the r17 unified-transport slice has JAX-FREE
+frontend surfaces (``net/channel.py``'s fabric array lane,
+``serve/shm.py``) reach fabric codec helpers at runtime, so importing
+this package must not execute the jax-laden mesh module eagerly."""
+
+_EXPORTS = {
+    "make_mesh": "ringpop_tpu.parallel.mesh",
+    "shard_delta_state": "ringpop_tpu.parallel.mesh",
+    "sharded_delta_step": "ringpop_tpu.parallel.mesh",
+    "with_exchange_mesh": "ringpop_tpu.parallel.mesh",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+__all__ = list(_EXPORTS)

@@ -95,25 +95,15 @@ CODEC_NAMES = {CODEC_RAW: "raw", CODEC_ROWS: "rows",
                CODEC_RUNS: "runs", CODEC_XOR: "xor"}
 
 
-class FabricError(RuntimeError):
-    """Any fabric-layer failure with rank/peer context attached."""
-
-
-class FabricPeerLost(FabricError):
-    """A peer's socket closed mid-run — the peer process died (or shut
-    its fabric down) while this rank still expected messages from it."""
-
-
-class FabricTimeout(FabricError):
-    """A live but SILENT peer: nothing arrived (or a send could not
-    drain) within ``timeout_ms``.  Distinct from a tag desync — the
-    schedule may still agree; the peer is wedged or partitioned."""
-
-
-class FabricDesync(FabricError):
-    """A message arrived with the WRONG tag: the peers' deterministic
-    schedules disagree (a leg skipped or reordered).  Both endpoints are
-    alive — that is what distinguishes this from the two above."""
+# the error family lives in the import-free leaf ``ringpop_tpu.errors``
+# (r17: shared with the jax-free channel/shm/forward surfaces); re-
+# exported here under the historical import path every caller uses
+from ringpop_tpu.errors import (  # noqa: F401  (re-export)
+    FabricDesync,
+    FabricError,
+    FabricPeerLost,
+    FabricTimeout,
+)
 
 
 class Encoded(NamedTuple):
@@ -326,6 +316,32 @@ def decode_array(
         ).tobytes()
         return np.frombuffer(raw, dtype).reshape(shape).copy()
     raise FabricError(f"unknown wire codec byte {codec}")
+
+
+def frame_array(a: np.ndarray) -> bytes:
+    """One array as a self-contained fabric frame: the per-array header
+    (codec byte, dtype, shape) + best-encoding payload — byte-identical
+    to what the same array costs inside an exchange message.  The r17
+    unified-transport hook: ``net.channel`` rides the r15 codec through
+    this for frame-body array values."""
+    enc = encode_array(np.ascontiguousarray(a))
+    dt = enc.dtype.str.encode()
+    shape = np.asarray(enc.shape, ">u8").tobytes()
+    return (
+        _AHDR.pack(enc.codec, len(dt), len(enc.shape), len(enc.payload))
+        + dt + shape + enc.payload
+    )
+
+
+def unframe_array(data: bytes) -> np.ndarray:
+    """Exact inverse of :func:`frame_array`."""
+    codec, dtl, ndim, nbytes = _AHDR.unpack_from(data, 0)
+    off = _AHDR.size
+    dt = data[off : off + dtl].decode()
+    off += dtl
+    shape = tuple(np.frombuffer(data, ">u8", count=ndim, offset=off).astype(int))
+    off += 8 * ndim
+    return decode_array(codec, np.dtype(dt), shape, data[off : off + nbytes])
 
 
 class LocalKV:

@@ -3,7 +3,14 @@
 
 ``read``/``write`` fan a request out to the N owners of a key and succeed
 when R/W responses arrive; fanout is Parallel, SerialSequential or
-SerialBalanced (``replicator.go:40-52``).  N/R/W default to 3/1/3."""
+SerialBalanced (``replicator.go:40-52``).  N/R/W default to 3/1/3.
+
+The serve plane's hash-batch analog is
+``ringpop_tpu.forward.batch.QuorumReader`` (r17): same
+group-by-destination rule as :meth:`Replicator._group_replicas`, but
+over uint32 hash batches with ONE coalesced RPC per owner per wave and
+the majority bar ⌈(R+1)/2⌉.  Semantic changes to grouping or ack policy
+should be mirrored between the two planes."""
 
 from __future__ import annotations
 

@@ -30,6 +30,12 @@ Pieces:
   random replica placement.
 * :mod:`~ringpop_tpu.serve.bench` — the multi-process paired A/B driver
   simbench's ``serve_ring`` scenario and ``make serve-smoke`` share.
+* :mod:`~ringpop_tpu.serve.mesh` — the r17 multi-host serve mesh: P
+  serve ranks each own a contiguous ring block (the r14
+  ``process_block`` rule) and cross-forward mis-routed keys over the
+  DCN fabric, answering LookupN preference lists through the fused
+  dispatch — every (owner, successors, generation) tuple digest-equal
+  to the single-process oracle at any P.
 """
 
 _EXPORTS = {
@@ -38,7 +44,11 @@ _EXPORTS = {
     "ring_commit": "ringpop_tpu.serve.state",
     "serve_lookup": "ringpop_tpu.serve.state",
     "serve_lookup_fused": "ringpop_tpu.serve.state",
+    "serve_lookup_n": "ringpop_tpu.serve.state",
+    "serve_lookup_n_fused": "ringpop_tpu.serve.state",
     "RingService": "ringpop_tpu.serve.service",
+    "ServeMesh": "ringpop_tpu.serve.mesh",
+    "run_serve_mesh": "ringpop_tpu.serve.mesh",
     "ServeClient": "ringpop_tpu.serve.client",
     "HostBisectFrontend": "ringpop_tpu.serve.client",
     "ShmServer": "ringpop_tpu.serve.shm",

@@ -11,13 +11,21 @@ by diameter and spread, gated by the same key-movement-under-churn metric
 the ``ring1m`` churn-rebalance harness measures, so a candidate can never
 win by sacrificing the consistent-hashing property the ring exists for.
 
-Candidate family: per-(server, replica) re-mixes ``mix32(base ^ salt_c)``
-of the default farm tokens.  Candidate 0 is the UNMODIFIED default
-placement, and each candidate's tokens depend only on (server address,
-replica index, salt) — membership churn never moves a surviving server's
-tokens under any fixed candidate, so the scoring differences are pure
-placement quality.  Scores per candidate (all computed on device, vmapped
-over the candidate axis):
+Candidate family (r17 widened): per-(server, replica) re-mixes
+``mix32(base ^ salt_c)`` of the default farm tokens, PLUS
+diameter-guided LOCAL MOVES (the DGRO-paper analog of local search
+steps): for each move count ``m`` in ``local_moves``, the tokens
+adjacent to the ``m`` SMALLEST arcs relocate to the midpoints of the
+``m`` LARGEST arcs — shrinking the ring diameter by spending tokens
+whose removal costs least.  A move is recorded as a sticky
+(server address, replica index) → token OVERRIDE chosen once at scoring
+time and replayed VERBATIM on later membership changes, so — exactly
+like the salt family — a surviving server's tokens never move under a
+fixed candidate and churn movement stays pure placement quality (a dead
+server's overrides vanish with its tokens; consistent hashing is
+preserved by construction, asserted by the ``excess`` score).
+Candidate 0 is the UNMODIFIED default placement.  Scores per candidate
+(all computed on device, vmapped over the candidate axis):
 
 * ``movement`` — fraction of probe keys whose owner changes when a churn
   cohort is removed (the ring1m rebalance metric).  Minimal movement
@@ -55,20 +63,21 @@ def _candidate_tokens(base: jax.Array, salt: jax.Array) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=())
-def _score_candidates(base, owners, salts, probes, cohort):
+def _score_candidates(cand_tokens, owners, probes, cohort):
     """Per-candidate (movement, excess, imbalance, diameter) — one
     batched program over the candidate axis.
 
-    base: uint32[T] default tokens (owner-major, replica-minor order);
-    owners: int32[T]; salts: uint32[C]; probes: uint32[P];
+    cand_tokens: uint32[C, T] candidate token values in the flat
+    owner-major, replica-minor layout (salt re-mixes and local-move
+    overrides alike — the scorer is family-agnostic);
+    owners: int32[T]; probes: uint32[P];
     cohort: bool[S] — servers removed by the churn probe.
     """
-    t = base.shape[0]
+    t = cand_tokens.shape[1]
     n_servers = cohort.shape[0]
     space = jnp.float32(2.0**32)
 
-    def one(salt):
-        toks = _candidate_tokens(base, salt)
+    def one(toks):
         # stable argsort == the host composite (token, owner) order:
         # the flat layout is owner-ascending, so ties keep owner order
         order = jnp.argsort(toks, stable=True)
@@ -101,15 +110,78 @@ def _score_candidates(base, owners, salts, probes, cohort):
             diameter = jnp.float32(1.0)
         return movement, excess, imbalance, diameter
 
-    return jax.vmap(one)(salts)
+    return jax.vmap(one)(cand_tokens)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _materialize(base, owners, salt):
-    """The chosen candidate's (sorted tokens, sorted owners)."""
-    toks = _candidate_tokens(base, salt)
-    order = jnp.argsort(toks, stable=True)
-    return toks[order], owners[order]
+def _flat_tokens(base, salt: int) -> np.ndarray:
+    """Host copy of one salt candidate's tokens in the flat layout."""
+    return np.asarray(_candidate_tokens(base, jnp.uint32(salt)))
+
+
+def _apply_moves(flat: np.ndarray, owners: np.ndarray, m: int):
+    """Diameter-guided local moves on one candidate's flat tokens: the
+    tokens bounding the ``m`` smallest arcs move to the midpoints of the
+    ``m`` largest arcs.  Returns (new flat tokens, overrides) where
+    overrides maps FLAT index -> new token value — the caller re-keys by
+    (server, replica) identity for sticky replay."""
+    from ringpop_tpu.ops.ring_ops import ring_composite_order
+
+    t = flat.shape[0]
+    m = int(min(m, max(t // 2 - 1, 0)))
+    if m == 0:
+        return flat, {}
+    order = ring_composite_order(flat, owners)
+    st = flat[order]
+    gaps = np.empty(t, np.uint64)  # gap i: arc ABOVE sorted token i
+    gaps[:-1] = st[1:].astype(np.uint64) - st[:-1].astype(np.uint64)
+    gaps[-1] = (np.uint64(1 << 32) + st[0].astype(np.uint64)
+                - st[-1].astype(np.uint64))
+    big = np.argsort(gaps, kind="stable")[-m:]          # arcs to fill
+    small = np.argsort(gaps, kind="stable")[: 2 * m]    # donor pool
+    # donors: the token CLOSING each small arc (its own arc is tiny, so
+    # relocating it moves the least key mass) — skipping any donor that
+    # bounds a chosen large arc
+    banned = set(big.tolist()) | {int((b + 1) % t) for b in big}
+    donors = [int((s + 1) % t) for s in small if int((s + 1) % t) not in banned]
+    donors = donors[:m]
+    out = flat.copy()
+    overrides = {}
+    for d_sorted, g in zip(donors, sorted(big.tolist(), key=lambda i: -int(gaps[i]))):
+        mid = (st[g].astype(np.uint64) + gaps[g] // np.uint64(2)) & np.uint64(
+            0xFFFFFFFF
+        )
+        fi = int(order[d_sorted])  # back to the flat (server, replica) slot
+        out[fi] = np.uint32(mid)
+        overrides[fi] = int(mid)
+    return out, overrides
+
+
+def _materialize_flat(flat: np.ndarray, owners: np.ndarray):
+    """(sorted tokens, sorted owners) of one candidate's flat tokens —
+    the host composite (token, owner) collision order
+    (``ring_ops.ring_composite_order``, the one shared rule)."""
+    from ringpop_tpu.ops.ring_ops import ring_composite_order
+
+    order = ring_composite_order(flat, owners)
+    return flat[order].astype(np.uint32), owners[order].astype(np.int32)
+
+
+def _apply_overrides(
+    flat: np.ndarray, servers: list[str], replica_points: int, moves: dict
+) -> np.ndarray:
+    """Replay sticky ``(server, replica) -> token`` overrides onto the
+    flat token layout of the CURRENT server set — overrides of departed
+    servers vanish with their tokens, surviving ones keep their exact
+    values (zero replay movement by construction)."""
+    if not moves:
+        return flat
+    index = {srv: i for i, srv in enumerate(servers)}
+    out = flat.copy()
+    for (srv, rep), tok in moves.items():
+        i = index.get(srv)
+        if i is not None and 0 <= rep < replica_points:
+            out[i * replica_points + rep] = np.uint32(tok)
+    return out
 
 
 def dgro_place(
@@ -117,37 +189,66 @@ def dgro_place(
     replica_points: int,
     *,
     candidates: int = 8,
+    local_moves: tuple = (1, 2, 4, 8),
     probes: int = 1 << 15,
     churn_frac: float = 0.01,
     seed: int = 0,
     fixed_salt: int | None = None,
+    fixed_moves: dict | None = None,
 ):
     """(tokens uint32[T], owners int32[T], report) — the DGRO pass.
 
-    ``fixed_salt`` replays a previously chosen candidate without
-    re-scoring — the sticky mode ``RingStore`` uses after its first
-    placement so membership churn never flips candidates mid-flight
+    ``fixed_salt``/``fixed_moves`` replay a previously chosen candidate
+    without re-scoring — the sticky mode ``RingStore`` uses after its
+    first placement so membership churn never flips candidates mid-flight
     (a flip would move every token, exactly what the movement gate
-    exists to prevent).
+    exists to prevent).  ``local_moves`` widens the family with
+    diameter-guided local token moves on top of the default placement
+    (``()`` restores the salt-only r13 family).
     """
     s = len(servers)
     base = jnp.asarray(
         ring_tokens(servers, replica_points).reshape(-1).astype(np.uint32)
     )
-    owners = jnp.asarray(
-        np.repeat(np.arange(s, dtype=np.int32), replica_points)
-    )
+    owners_np = np.repeat(np.arange(s, dtype=np.int32), replica_points)
+    owners = jnp.asarray(owners_np)
     if fixed_salt is not None:
-        st, so = _materialize(base, owners, jnp.uint32(fixed_salt))
+        flat = _flat_tokens(base, fixed_salt)
+        flat = _apply_overrides(flat, servers, replica_points, fixed_moves or {})
+        st, so = _materialize_flat(flat, owners_np)
         return (
-            np.asarray(st),
-            np.asarray(so),
-            {"salt": int(fixed_salt), "rescored": False},
+            st,
+            so,
+            {
+                "salt": int(fixed_salt),
+                "moves": dict(fixed_moves or {}),
+                "rescored": False,
+            },
         )
     rng = np.random.default_rng(seed)
     salt_arr = (np.arange(candidates, dtype=np.uint64) * _SALT_STRIDE).astype(
         np.uint32
     )
+    # the family: salt re-mixes (candidate 0 = the reference placement),
+    # then diameter-guided local-move variants of the DEFAULT placement
+    family: list[dict] = [{"salt": int(v), "moves": {}} for v in salt_arr]
+    flats = [_flat_tokens(base, int(v)) for v in salt_arr]
+    base_flat = flats[0]
+    for mcount in local_moves:
+        moved, ov = _apply_moves(base_flat, owners_np, int(mcount))
+        if not ov:
+            continue
+        flats.append(moved)
+        family.append(
+            {
+                "salt": 0,
+                "moves": {
+                    (servers[fi // replica_points], fi % replica_points): tok
+                    for fi, tok in ov.items()
+                },
+                "local_moves": int(mcount),
+            }
+        )
     probe_arr = rng.integers(0, 2**32, size=probes, dtype=np.uint32)
     m = max(1, int(round(churn_frac * s))) if s > 1 else 0
     cohort = np.zeros(s, bool)
@@ -156,7 +257,7 @@ def dgro_place(
     movement, excess, imbalance, diameter = (
         np.asarray(a)
         for a in _score_candidates(
-            base, owners, jnp.asarray(salt_arr), jnp.asarray(probe_arr),
+            jnp.asarray(np.stack(flats)), owners, jnp.asarray(probe_arr),
             jnp.asarray(cohort),
         )
     )
@@ -165,12 +266,16 @@ def dgro_place(
     eligible = movement <= movement[0] + 1e-9
     score = np.where(eligible, imbalance + diameter, np.inf)
     chosen = int(np.argmin(score))
-    st, so = _materialize(base, owners, jnp.uint32(salt_arr[chosen]))
+    st, so = _materialize_flat(flats[chosen], owners_np)
     report = {
         "chosen": chosen,
-        "salt": int(salt_arr[chosen]),
+        "salt": family[chosen]["salt"],
+        "moves": family[chosen]["moves"],
+        "local_moves": family[chosen].get("local_moves", 0),
+        "family": len(family),
         "rescored": True,
         "candidates": candidates,
+        "move_candidates": len(family) - candidates,
         "probes": probes,
         "churn_cohort": int(m),
         "movement": [round(float(v), 6) for v in movement],
@@ -181,8 +286,10 @@ def dgro_place(
         "movement_chosen": round(float(movement[chosen]), 6),
         "imbalance_random": round(float(imbalance[0]), 4),
         "imbalance_chosen": round(float(imbalance[chosen]), 4),
+        "diameter_random": round(float(diameter[0]), 6),
+        "diameter_chosen": round(float(diameter[chosen]), 6),
     }
-    return np.asarray(st), np.asarray(so), report
+    return st, so, report
 
 
 def key_movement(

@@ -39,7 +39,12 @@ import numpy as np
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.net.channel import decode_array, encode_array
-from ringpop_tpu.serve.state import RingStore, serve_lookup_fused, serve_lookup_n
+from ringpop_tpu.ops.ring_ops import host_lookup_n
+from ringpop_tpu.serve.state import (
+    RingStore,
+    serve_lookup_fused,
+    serve_lookup_n_fused,
+)
 from ringpop_tpu.util.metrics import Histogram
 
 _logger = logging_mod.logger("serve")
@@ -265,37 +270,30 @@ class RingService:
         self._schedule_flush(immediate=True)
 
     def dispatch_direct(self, hashes, n: int, callback) -> None:
-        """The degenerate-case fast lane: ONE small request, nothing else
-        pending — answered from the HOST MIRROR of the committed
-        generation (``RingStore.snapshot_host``), bit-identical to the
-        device ring by the property-suite pin, without paying a device
-        round trip a single key cannot amortize (a jit dispatch alone
-        costs ~100 µs on this container; the batch path exists precisely
-        to spread that over thousands of keys).  n>1 point requests still
-        ride the device preference-list program.  Telemetered as a flush
-        of one request, so the B=1 stream shows up in the same
-        batch-size/queue-wait histograms."""
+        """The degenerate-case fast lane: ONE small (≤64-key) request,
+        nothing else pending — answered from the HOST MIRROR of the
+        committed generation (``RingStore.snapshot_host``), bit-identical
+        to the device ring by the property-suite pin, without paying a
+        device round trip a single key cannot amortize (a jit dispatch
+        alone costs ~100 µs on this container; the batch path exists
+        precisely to spread that over thousands of keys).  n>1 point
+        requests answer from the SAME mirror through the exact
+        ``host_lookup_n`` walk (the LookupNUniqueAt parity oracle), so the
+        fast lane returns the same (owner, successors) tuple the fused
+        device dispatch would.  Telemetered as a flush of one request, so
+        the B=1 stream shows up in the same batch-size/queue-wait
+        histograms."""
         t0 = time.perf_counter()
+        toks, owns, gen, n_servers = self.store.snapshot_host()
         if n == 1:
-            toks, owns, gen = self.store.snapshot_host()
             if toks.shape[0] == 0:
                 rows = np.full(len(hashes), -1, np.int32)
             else:
                 idx = np.searchsorted(toks, np.asarray(hashes, np.uint32), side="left")
                 rows = owns[np.where(idx == toks.shape[0], 0, idx)]
-            callback(rows, gen)
         else:
-            for attempt in range(5):
-                ring, gen, n_servers = self.store.snapshot()
-                try:
-                    owners_dev, gen_dev = serve_lookup_n(
-                        ring, n_servers, jnp.asarray(hashes), n
-                    )
-                    callback(np.asarray(owners_dev), int(np.asarray(gen_dev)[0]))
-                    break
-                except RuntimeError as e:
-                    if not _is_deleted_buffer(e) or attempt == 4:
-                        raise
+            rows = host_lookup_n(toks, owns, hashes, n, n_servers)
+        callback(rows, gen)
         self.telemetry.flush_event(
             keys=len(hashes), requests=1, waits_us=[0.0],
             dispatch_us=(time.perf_counter() - t0) * 1e6, gen=gen,
@@ -410,22 +408,21 @@ class RingService:
         for attempt in range(5):
             ring, _gen, n_servers = self.store.snapshot()
             try:
+                # fused transfer either way: owners + generation in one
+                # device array (generation in the tail slot), split
+                # host-side after a single sync — n=1 rides the plain
+                # fused program, n>1 the fused preference-list windows
                 if n == 1:
-                    # fused transfer: owners + generation in one device
-                    # array, split host-side after a single sync
-                    # (gen_dev=None marks it)
-                    owners_dev, gen_dev = serve_lookup_fused(ring, dev_hashes), None
+                    owners_dev = serve_lookup_fused(ring, dev_hashes)
                 else:
-                    owners_dev, gen_dev = serve_lookup_n(
-                        ring, n_servers, dev_hashes, n
-                    )
+                    owners_dev = serve_lookup_n_fused(ring, n_servers, dev_hashes, n)
                 if total <= self.inline_resolve_max:
                     # small flush: the device answer is microseconds away
                     # and two executor hand-offs would dominate it
-                    self._resolve(reqs, owners_dev, gen_dev, total, n, inline=True)
+                    self._resolve(reqs, owners_dev, total, n, inline=True)
                 else:
                     task = loop.run_in_executor(
-                        None, self._resolve, reqs, owners_dev, gen_dev, total, n
+                        None, self._resolve, reqs, owners_dev, total, n
                     )
                     task.add_done_callback(self._log_resolve_error)
                 return _gen
@@ -441,20 +438,20 @@ class RingService:
             _logger.error(f"serve flush resolve failed: {exc!r}")
 
     def _resolve(
-        self, reqs, owners_dev, gen_dev, total: int, n: int, inline: bool = False
+        self, reqs, owners_dev, total: int, n: int, inline: bool = False
     ) -> None:
         """Block on the device result and scatter rows back to request
         futures — on the loop thread directly (``inline``) or from the
-        executor (thread-safe via call_soon_threadsafe).  ``gen_dev=None``
-        means ``owners_dev`` is the fused [B+1] vector with the generation
-        in its tail slot."""
+        executor (thread-safe via call_soon_threadsafe).  ``owners_dev``
+        is the fused vector with the generation in its tail slot: [B+1]
+        for n=1, [B*n+1] flattened rows for preference lists."""
         try:
-            if gen_dev is None:
-                host = np.asarray(owners_dev)
-                owners, gen = host[:total], int(host[-1])
+            host = np.asarray(owners_dev)
+            gen = int(host[-1])
+            if n == 1:
+                owners = host[:total]
             else:
-                owners = np.asarray(owners_dev)[:total]
-                gen = int(np.asarray(gen_dev)[0])
+                owners = host[: total * n].reshape(total, n)
         except RuntimeError as e:
             if inline or not _is_deleted_buffer(e):
                 raise

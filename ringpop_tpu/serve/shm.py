@@ -37,6 +37,7 @@ from typing import Optional
 import numpy as np
 
 from ringpop_tpu import logging as logging_mod
+from ringpop_tpu.errors import FabricPeerLost, FabricTimeout
 
 _logger = logging_mod.logger("serve.shm")
 
@@ -302,14 +303,28 @@ class ShmClient:
         hdr[_N] = np.uint32(n)
         req = np.uint32(int(hdr[_REQ_SEQ]) + 1)
         hdr[_REQ_SEQ] = req
-        self._sock.send(b"\x01")
+        try:
+            self._sock.send(b"\x01")
+        except OSError as e:
+            # the wakeup socket refusing the datagram means the server
+            # process died (its unix socket is gone) — the shm flavor of
+            # a dead fabric peer
+            raise FabricPeerLost(
+                f"shm serve server unreachable at its wakeup socket ({e})"
+            ) from e
         t0 = time.perf_counter()
         deadline = t0 + self.timeout
         spin_until = t0 + (self.spin_us if count <= 64 else 50.0) / 1e6
         while hdr[_RESP_SEQ] != req:
             now = time.perf_counter()
             if now > deadline:
-                raise TimeoutError("shm lookup timed out")
+                # the unified (r17) transport error family: a silent shm
+                # server is the same failure class as a silent fabric or
+                # channel peer — FabricTimeout everywhere
+                raise FabricTimeout(
+                    f"shm lookup timed out after {self.timeout}s — server "
+                    "wedged or gone (slot never answered)"
+                )
             if now > spin_until:
                 time.sleep(1e-4)
         if int(hdr[_STATUS]) != STATUS_OK:

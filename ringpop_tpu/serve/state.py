@@ -37,6 +37,7 @@ import numpy as np
 from ringpop_tpu.events import RingChangedEvent
 from ringpop_tpu.hashring import HashRing
 from ringpop_tpu.ops.ring_ops import (
+    _lookup_n_window_padded,
     pad_ring_arrays,
     ring_lookup_n_padded,
     ring_lookup_padded,
@@ -118,6 +119,51 @@ def serve_lookup_n(ring: DeviceRing, num_servers, key_hashes: jax.Array, n: int)
     )
 
 
+@functools.partial(jax.jit, static_argnames=("n", "w"))
+def _serve_lookup_n_window_fused(
+    ring: DeviceRing, num_servers: jax.Array, key_hashes: jax.Array, n: int, w: int
+):
+    """One fused window pass of the LookupN serve dispatch: the padded
+    windowed scan (``ops/ring_ops._lookup_n_window_padded``) with the
+    generation CONCATENATED into the flattened owner matrix — one device
+    array, one host transfer, the exact analog of ``serve_lookup_fused``
+    for preference lists.  Returns ``(int32[B*n + 1] fused, bool
+    satisfied)``; the caller's host loop doubles ``w`` until satisfied
+    (same rescue contract as ``ring_lookup_n_padded``)."""
+    out, found = _lookup_n_window_padded(
+        ring.tokens, ring.owners, ring.count[0], key_hashes, n, w
+    )
+    fused = jnp.concatenate([out.reshape(-1), ring.gen.astype(jnp.int32)])
+    return fused, (found >= jnp.minimum(n, num_servers)).all()
+
+
+def serve_lookup_n_fused(
+    ring: DeviceRing, num_servers, key_hashes: jax.Array, n: int
+) -> jax.Array:
+    """:func:`serve_lookup_n` with the generation FUSED into the owner
+    vector: int32[B*n + 1], rows flattened row-major, generation in the
+    last slot — the collector's n>1 flushes ride this so owner tuples and
+    the membership generation arrive in ONE transfer after a single sync
+    (the r13 fused-dispatch design extended to LookupN).  EXACT: the same
+    window-doubling rescue as ``ring_lookup_n_padded`` (each window size a
+    cached jit specialization, the doubling decided on the host), pinned
+    against the host ``LookupNUniqueAt`` walk by the property suite."""
+    c = int(ring.tokens.shape[0])
+    b = int(key_hashes.shape[0])
+    if c == 0 or n <= 0:
+        return jnp.concatenate(
+            [jnp.full(b * max(n, 0), -1, jnp.int32), ring.gen.astype(jnp.int32)]
+        )
+    num = jnp.asarray(num_servers, jnp.int32)
+    w = min(max(4 * n, 16), c)
+    while True:
+        fused, ok = _serve_lookup_n_window_fused(ring, num, key_hashes, n, w)
+        # w >= capacity >= count covers the whole live ring: exact
+        if w >= c or bool(ok):
+            return fused
+        w = min(2 * w, c)
+
+
 class RingStore:
     """Host-side owner of the DeviceRing: membership in, generations out.
 
@@ -186,12 +232,17 @@ class RingStore:
             salt = getattr(self, "_dgro_salt", None)
             if salt is not None:
                 kw["fixed_salt"] = salt
+                # sticky local-move overrides replay verbatim alongside
+                # the salt: surviving (server, replica) tokens keep their
+                # exact values, departed servers' overrides lapse
+                kw["fixed_moves"] = getattr(self, "_dgro_moves", {})
             toks32, owners32, report = dgro_place(
                 servers, self.ring.replica_points, **kw
             )
             if salt is None:
                 self.placement_report = report
             self._dgro_salt = report["salt"]
+            self._dgro_moves = report.get("moves", {})
             return toks32, owners32
         return toks.astype(np.uint32), owners.astype(np.int32)
 
@@ -277,11 +328,12 @@ class RingStore:
         with self._lock:
             return self.device, self.gen, self.ring.server_count()
 
-    def snapshot_host(self) -> tuple[np.ndarray, np.ndarray, int]:
-        """(host tokens, host owners, generation) — the committed
-        generation's placed arrays, for the point-lookup fast lane."""
+    def snapshot_host(self) -> tuple[np.ndarray, np.ndarray, int, int]:
+        """(host tokens, host owners, generation, n_servers) — the
+        committed generation's placed arrays, for the point-lookup fast
+        lane (n=1 searchsorted and the n>1 ``host_lookup_n`` walk)."""
         with self._lock:
-            return self.host_tokens, self.host_owners, self.gen
+            return self.host_tokens, self.host_owners, self.gen, self.ring.server_count()
 
     def servers_at(self, gen: int) -> Optional[list[str]]:
         """Server list of a recent generation (None if aged out)."""

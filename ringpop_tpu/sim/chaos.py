@@ -717,6 +717,18 @@ def score_blocks(
         "final_detect_frac": detect[-1] if detect else None,
         "rejoin_convergence_ticks": rejoin,
     }
+    # quorum-read journals (r17, forward/batch.py): blocks carrying the
+    # replica-read fields get their worst-case quorum summary in the
+    # verdict — the serve plane's "reads still ack at ⌈(R+1)/2⌉ while
+    # owners are dead" bar, scored next to the recovery metrics above
+    qblocks = [b for b in blocks if "quorum_ok_frac" in b]
+    if qblocks:
+        out["quorum_ok_frac_min"] = min(
+            float(b["quorum_ok_frac"]) for b in qblocks
+        )
+        out["quorum_acks_min"] = min(
+            int(b.get("quorum_acks_min", 0)) for b in qblocks
+        )
     if scenario_id is not None:
         # batched-fleet journals: which member of the stacked plan this
         # verdict scores (same id the fleet's block records carry)

@@ -69,6 +69,18 @@ story"):
   pricing of the same schedules is the ksweep ``swing_exchange``
   section, behind the TPU gate.)
 
+- (r17) the production-fan-in serve plane: ``serve_fanin`` — also
+  host-level (SIMBENCH_r11.json), judged with or without a ksweep
+  capture.  The serve model says the P∈{1,2,4} mesh answers every
+  (owner, successors, generation) tuple digest-identical to the
+  single-process oracle, the forwarding plane coalesces so message
+  count is O(owners) — STRICTLY below one-per-forwarded-key naive —
+  and quorum replica reads hold ⌈(R+1)/2⌉ acks while a FaultPlan kills
+  owners mid-read.  Bit-unequal digests, per-key RPC count not
+  strictly below naive, or a lost quorum REFUTES.  (The real-chip
+  keys/s pricing of the same plane is the ksweep ``serve_fanin``
+  section, behind the TPU gate.)
+
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
 """
@@ -209,6 +221,59 @@ def judge_swing_overlap():
     )
 
 
+def judge_serve_fanin():
+    """The r17 fan-in serve-plane verdict from the committed
+    SIMBENCH_r11.json — host-certifiable, judged with or without a
+    ksweep capture.  Returns a (name, ok, detail) tuple, or None when
+    the artifact does not exist."""
+    path = os.path.join(REPO, "SIMBENCH_r11.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return ("serve fan-in plane", None, f"unreadable SIMBENCH_r11.json: {e}")
+    sc = next(
+        (s for s in data.get("scenarios", [])
+         if str(s.get("metric", "")).startswith("serve_fanin")),
+        None,
+    )
+    if sc is None:
+        return ("serve fan-in plane", None,
+                "SIMBENCH_r11.json carries no serve_fanin scenario")
+    q = sc.get("quorum") or {}
+    curve = sc.get("scaling_curve") or []
+    multi = [p for p in curve if p.get("nprocs", 1) > 1]
+    rpc_ok = bool(multi) and all(
+        p.get("messages") is not None and p.get("messages_naive") is not None
+        and p["messages"] < p["messages_naive"]
+        for p in multi
+    )
+    quorum_ok = bool(
+        q.get("owners_killed") and q.get("quorum_held")
+        and q.get("answers_agree")
+        and q.get("rpcs") is not None and q.get("rpcs_naive") is not None
+        and q["rpcs"] < q["rpcs_naive"]
+    )
+    ok = bool(sc.get("digests_equal")) and rpc_ok and quorum_ok
+    curve_s = ", ".join(
+        f"P={p.get('nprocs')}: {p.get('keys_per_s_per_host')}/s/host "
+        f"({p.get('messages')} msgs vs {p.get('messages_naive')} naive)"
+        for p in curve
+    )
+    return (
+        f"serve fan-in plane (n={sc.get('n_servers')}x"
+        f"{sc.get('replica_points')} vnodes, R={sc.get('lookup_n')})",
+        ok,
+        f"digests_equal={sc.get('digests_equal')} (oracle "
+        f"{sc.get('oracle_digest')}); {curve_s}; quorum "
+        f"{q.get('quorum')}/{q.get('r')} held={q.get('quorum_held')} under "
+        f"owner kills={q.get('owners_killed')} at rpc ratio "
+        f"{q.get('rpc_ratio')} (strictly-below-naive required)",
+    )
+
+
 def _print_solo(host_verdicts) -> int:
     """Render the host-level verdicts (dcn_wire r15, swing_overlap r16)
     when no on-chip capture is judgeable — these claims never wait on
@@ -225,17 +290,17 @@ def _print_solo(host_verdicts) -> int:
         judged = judged or ok is True
     if bad:
         print("VERDICT: committed SIMBENCH artifacts REFUTE the host-level "
-              "wire/schedule model")
+              "wire/schedule/serve model")
         return 2
     if judged:
-        print("VERDICT: host-level wire/schedule claims CERTIFY (on-chip "
-              "model still unjudged)")
+        print("VERDICT: host-level wire/schedule/serve claims CERTIFY "
+              "(on-chip model still unjudged)")
         return 0
     return 1
 
 
 def main() -> int:
-    host = [judge_dcn_wire(), judge_swing_overlap()]
+    host = [judge_dcn_wire(), judge_swing_overlap(), judge_serve_fanin()]
     path = sys.argv[1] if len(sys.argv) > 1 else newest_ksweep()
     if not path:
         print("no ksweep capture found (run make tpu-watch and wait for a window)")
@@ -449,6 +514,30 @@ def main() -> int:
              f"{sl['bisect_qps_per_process']} keys/s per process "
              f"(amortization {sl.get('amortization')}x), "
              f"bit_equal={sl.get('bit_equal')}")
+        )
+    # the r17 fused LookupN serve dispatch on real HW: bit-equal to the
+    # host LookupNUniqueAt walk (generation riding the same transfer) and
+    # >= 2x a host walk process per key, same bar as serve_lookup — the
+    # preference-list flavor of the serving premise
+    sf = cap.get("serve_fanin") or {}
+    if "error" in sf:
+        verdicts.append(("serve fan-in LookupN dispatch", None, sf["error"]))
+    elif sf.get("device_qps") is not None and sf.get(
+        "host_walk_qps_per_process"
+    ) is not None:
+        ok = (
+            bool(sf.get("bit_equal")) and bool(sf.get("gen_in_tail"))
+            and sf["device_qps"] >= 2.0 * sf["host_walk_qps_per_process"]
+        )
+        verdicts.append(
+            (f"serve fan-in LookupN dispatch (batch={sf.get('batch')}, "
+             f"R={sf.get('n')}, {sf.get('n_servers')}x"
+             f"{sf.get('replica_points')} vnodes)",
+             ok,
+             f"device {sf['device_qps']} vs host walk "
+             f"{sf['host_walk_qps_per_process']} keys/s per process "
+             f"(amortization {sf.get('amortization')}x), "
+             f"bit_equal={sf.get('bit_equal')} gen_in_tail={sf.get('gen_in_tail')}")
         )
     prof = next(
         ((p, budget) for p, budget in

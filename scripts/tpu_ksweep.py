@@ -917,6 +917,59 @@ def main() -> None:
         out.setdefault("serve_lookup", {})["error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
 
+    # -- 5c: serve_fanin — the r17 fused LookupN serve dispatch (owners +
+    # R successors + generation, one transfer) on real HW, vs the host
+    # LookupNUniqueAt walk per key, bit_equal per tuple.  The fan-in
+    # claim: one amortized preference-list dispatch prices R successors
+    # at nearly the single-owner dispatch's cost; the keys/s here is the
+    # per-HOST number the serve mesh's scaling curve multiplies.  Judged
+    # by certify_cost_model behind the TPU gate (the host-level mesh
+    # digest certificate is the committed SIMBENCH_r11.json).
+    try:
+        from ringpop_tpu.ops.ring_ops import host_lookup_n
+        from ringpop_tpu.serve.state import RingStore, serve_lookup_n_fused
+
+        n_srv, rp, rn = 4096, 256, 3
+        srv = [f"10.0.{i // 256}.{i % 256}:3000" for i in range(n_srv)]
+        sec = {"n_servers": n_srv, "replica_points": rp, "n": rn}
+        out["serve_fanin"] = sec
+        store = RingStore(srv, replica_points=rp)
+        sring, _gen, ns = store.snapshot()
+        sb = 262_144
+        sec["batch"] = sb
+        shashes = np.random.default_rng(2).integers(
+            0, 2**32, size=sb, dtype=np.uint32
+        )
+        dev_h = jnp.asarray(shashes)
+        fused = serve_lookup_n_fused(sring, ns, dev_h, rn)
+        jax.block_until_ready(fused)  # compile + warm every window
+        sreps = max(reps, 3)
+        t0 = time.perf_counter()
+        for _ in range(sreps):
+            fused = serve_lookup_n_fused(sring, ns, dev_h, rn)
+        host = np.asarray(fused)  # includes the host sync
+        dt = (time.perf_counter() - t0) / sreps
+        sec["device_qps"] = round(sb / dt, 0)
+        sec["device_ms_per_batch"] = round(dt * 1e3, 3)
+        sec["gen_in_tail"] = int(host[-1]) == store.gen
+        ht, ho, _hg, hns = store.snapshot_host()
+        nb = 8192  # the python walk needs no 262k keys to price
+        want = host_lookup_n(ht, ho, shashes[:nb], rn, hns)
+        t0 = time.perf_counter()
+        host_lookup_n(ht, ho, shashes[:nb], rn, hns)
+        sec["host_walk_qps_per_process"] = round(
+            nb / (time.perf_counter() - t0), 0
+        )
+        sec["bit_equal"] = bool(
+            np.array_equal(host[: nb * rn].reshape(nb, rn), want)
+        )
+        sec["amortization"] = round(
+            sec["device_qps"] / max(sec["host_walk_qps_per_process"], 1), 1
+        )
+    except Exception as e:  # pragma: no cover
+        out.setdefault("serve_fanin", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
     # -- 6: Pallas FarmHash vs jnp lowering ---------------------------------
     try:
         from ringpop_tpu.hashing.farm import pack_strings

@@ -242,6 +242,151 @@ def test_signed_dtype_hashes_route_like_uint32():
     assert np.array_equal(pa, b)
 
 
+# -- the serve-path LookupN satellites (r17): the fused dispatch and the
+# host-mirror fast lane vs the LookupNUniqueAt walk oracle -------------------
+
+
+def _device_ring(tokens, owners, extra_cap=5, gen=7):
+    from ringpop_tpu.serve.state import device_ring
+
+    return device_ring(tokens, owners, tokens.shape[0] + extra_cap, gen=gen)
+
+
+def test_serve_fused_lookup_n_matches_walk_oracle_adversarial():
+    """The fused serve dispatch (owners + generation, one device array)
+    must equal ring_lookup_n, host_lookup_n AND the inline walk oracle on
+    adversarial rings — duplicate/adjacent tokens, long same-owner runs,
+    wraparound keys."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+    from ringpop_tpu.serve.state import serve_lookup_n_fused
+
+    rng = np.random.default_rng(44)
+    for trial in range(4):
+        t = int(rng.integers(3, 40))
+        ns = int(rng.integers(1, 6))
+        tokens, owners = _adversarial_ring(rng, t, ns)
+        keys = _probe_keys(rng, tokens)
+        ring = _device_ring(tokens, owners, extra_cap=int(rng.integers(0, 9)))
+        for n in (1, 2, ns, ns + 2):
+            fused = np.asarray(
+                serve_lookup_n_fused(ring, ns, jnp.asarray(keys), n)
+            )
+            assert fused[-1] == 7  # the generation rides the same transfer
+            got = fused[:-1].reshape(keys.shape[0], n)
+            exact = np.asarray(
+                ring_lookup_n(jnp.asarray(tokens), jnp.asarray(owners),
+                              jnp.asarray(keys), n, ns)
+            )
+            host = host_lookup_n(tokens, owners, keys, n, ns)
+            assert np.array_equal(got, exact), (trial, n)
+            assert np.array_equal(got, host), (trial, n)
+            for i, h in enumerate(keys.tolist()):
+                assert list(got[i]) == _walk_oracle(tokens, owners, h, n, ns)
+
+
+def test_serve_fused_r_exceeds_live_count():
+    """R > live server count: the fused dispatch pads with -1 after every
+    unique owner, exactly like the host walk."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+    from ringpop_tpu.serve.state import serve_lookup_n_fused
+
+    tokens = np.array([10, 20, 30, 40], np.uint32)
+    owners = np.array([0, 1, 0, 1], np.int32)
+    ring = _device_ring(tokens, owners)
+    keys = np.array([5, 25, 45], np.uint32)
+    fused = np.asarray(serve_lookup_n_fused(ring, 2, jnp.asarray(keys), 5))
+    got = fused[:-1].reshape(3, 5)
+    assert np.array_equal(got, host_lookup_n(tokens, owners, keys, 5, 2))
+    assert (got[:, 2:] == -1).all()  # only 2 unique owners exist
+
+
+def test_serve_fused_all_but_one_owner_dead():
+    """All-but-one owner dead: after removing every other server from a
+    live RingStore, every preference list collapses to [survivor, -1...],
+    for every key including wraparound — through the serve path."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+    from ringpop_tpu.serve.state import RingStore, serve_lookup_n_fused
+
+    servers = [f"10.9.1.{i}:3000" for i in range(6)]
+    store = RingStore(servers, replica_points=8)
+    store.update(remove=servers[1:])
+    ring, gen, ns = store.snapshot()
+    assert ns == 1
+    keys = np.array([0, 1, 2**31, 2**32 - 1, 1234567], np.uint32)
+    fused = np.asarray(serve_lookup_n_fused(ring, ns, jnp.asarray(keys), 3))
+    got = fused[:-1].reshape(keys.shape[0], 3)
+    assert fused[-1] == gen
+    assert (got[:, 0] == 0).all()  # the lone survivor renumbers to id 0
+    assert (got[:, 1:] == -1).all()
+    ht, ho, hg, hns = store.snapshot_host()
+    assert np.array_equal(got, host_lookup_n(ht, ho, keys, 3, hns))
+
+
+def test_serve_fused_pad_token_valued_keys():
+    """Keys hashing to PAD_TOKEN exactly: with a live token of that value
+    the walk starts there; without one it wraps to live token 0 — the
+    fused path must never answer a pad owner."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+    from ringpop_tpu.serve.state import serve_lookup_n_fused
+
+    keys = np.array([PAD_TOKEN, PAD_TOKEN - 1], np.uint32)
+    with_hit = np.array([5, 900, PAD_TOKEN], np.uint32)
+    owners = np.array([0, 1, 2], np.int32)
+    ring = _device_ring(with_hit, owners)
+    fused = np.asarray(serve_lookup_n_fused(ring, 3, jnp.asarray(keys), 2))
+    got = fused[:-1].reshape(2, 2)
+    assert np.array_equal(got, host_lookup_n(with_hit, owners, keys, 2, 3))
+    assert list(got[0]) == [2, 0]  # real token == PAD_TOKEN wins side=left
+    without = np.array([5, 900], np.uint32)
+    ring2 = _device_ring(without, owners[:2])
+    fused2 = np.asarray(serve_lookup_n_fused(ring2, 2, jnp.asarray(keys), 2))
+    got2 = fused2[:-1].reshape(2, 2)
+    assert np.array_equal(got2, host_lookup_n(without, owners[:2], keys, 2, 2))
+    assert list(got2[0]) == [0, 1]  # wrapped to live token 0, never a pad
+
+
+def test_serve_fused_forced_window_overflow_rescue():
+    """A ring dominated by one owner's long run forces the first window
+    (4n) to find fewer than the required unique owners — the fused path's
+    host loop must double the window and still answer exactly."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+    from ringpop_tpu.serve.state import serve_lookup_n_fused
+
+    t = 96
+    owners = np.zeros(t, np.int32)
+    owners[-3:] = [1, 2, 3]  # the other owners hide past a 93-token run
+    tokens = (np.arange(t, dtype=np.uint32) * np.uint32(1000) + np.uint32(7))
+    ring = _device_ring(tokens, owners, extra_cap=11)
+    keys = np.array([0, 5, 500, 93_000], np.uint32)
+    n = 4
+    fused = np.asarray(serve_lookup_n_fused(ring, 4, jnp.asarray(keys), n))
+    got = fused[:-1].reshape(keys.shape[0], n)
+    assert np.array_equal(got, host_lookup_n(tokens, owners, keys, n, 4))
+    for i, h in enumerate(keys.tolist()):
+        assert list(got[i]) == _walk_oracle(tokens, owners, h, n, 4)
+
+
+def test_host_lookup_n_oracle_matches_inline_walk():
+    """host_lookup_n (the batched host oracle the serve fast lane answers
+    from) is itself pinned to the reference walk on adversarial rings."""
+    from ringpop_tpu.ops.ring_ops import host_lookup_n
+
+    rng = np.random.default_rng(45)
+    for _ in range(4):
+        t = int(rng.integers(2, 32))
+        ns = int(rng.integers(1, 5))
+        tokens, owners = _adversarial_ring(rng, t, ns)
+        keys = _probe_keys(rng, tokens)
+        for n in (1, 3, ns + 1):
+            got = host_lookup_n(tokens, owners, keys, n, ns)
+            for i, h in enumerate(keys.tolist()):
+                assert list(got[i]) == _walk_oracle(tokens, owners, h, n, ns)
+    # empty ring / n=0 degenerate shapes
+    empty = host_lookup_n(np.empty(0, np.uint32), np.empty(0, np.int32),
+                          np.array([1], np.uint32), 2, 0)
+    assert empty.shape == (1, 2) and (empty == -1).all()
+
+
 def test_lookup_matches_live_hash_ring():
     """End to end: the padded device ring built from a real HashRing's
     token arrays answers every key like ring.lookup (including keys

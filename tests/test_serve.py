@@ -19,6 +19,7 @@ from ringpop_tpu.serve.state import (
     serve_lookup,
     serve_lookup_fused,
     serve_lookup_n,
+    serve_lookup_n_fused,
 )
 
 SERVERS = [f"10.7.0.{i}:3000" for i in range(24)]
@@ -115,7 +116,7 @@ def test_store_generation_ring_buffer_ages_out():
 def test_store_host_mirror_matches_device():
     store = RingStore(SERVERS, replica_points=10)
     store.update(add=["z:9"])
-    toks, owns, gen = store.snapshot_host()
+    toks, owns, gen, _ns = store.snapshot_host()
     probe = _hashes(256, seed=3)
     idx = np.searchsorted(toks, probe, side="left")
     host = owns[np.where(idx == toks.shape[0], 0, idx)]
@@ -270,10 +271,14 @@ def test_dispatch_direct_matches_collector_and_telemeters():
     oracle = HostBisectFrontend(SERVERS, 10).lookup_hashes(h)
     assert np.array_equal(got["rows"], oracle) and got["gen"] == 0
     assert journal.records[-1]["kind"] == "serve"
-    # n>1 rides the device preference-list program
+    # n>1 answers from the SAME host mirror through the exact LookupN
+    # walk — the tuple must match the fused device dispatch bit-for-bit
     svc.dispatch_direct(h, 2, lambda rows, gen: got.update(rows2=rows))
     assert got["rows2"].shape == (8, 2)
     assert np.array_equal(got["rows2"][:, 0], oracle)
+    ring, _g, ns = store.snapshot()
+    fused = np.asarray(serve_lookup_n_fused(ring, ns, jnp.asarray(h), 2))
+    assert np.array_equal(got["rows2"], fused[:-1].reshape(8, 2))
 
 
 def test_ring_update_journal_and_stats():
@@ -420,7 +425,7 @@ def test_dgro_store_serves_correctly_and_stays_sticky():
     probe = _hashes(256, seed=17)
     ring, gen, _ = store.snapshot()
     dev = np.asarray(serve_lookup(ring, jnp.asarray(probe))[0])
-    ht, ho, hg = store.snapshot_host()
+    ht, ho, hg, _hns = store.snapshot_host()
     idx = np.searchsorted(ht, probe, side="left")
     assert np.array_equal(dev, ho[np.where(idx == ht.shape[0], 0, idx)])
     # membership churn must replay the SAME candidate (sticky salt)
@@ -428,6 +433,59 @@ def test_dgro_store_serves_correctly_and_stays_sticky():
     assert store._dgro_salt == salt
     ring2, gen2, _ = store.snapshot()
     assert gen2 == 1
+
+
+def test_dgro_local_move_family_diameter_guided():
+    """The r17 widened family: local-move candidates exist alongside the
+    salt re-mixes, each strictly shrinks the default placement's ring
+    diameter (that is what the moves are FOR), keeps churn movement at
+    candidate 0's level (sticky overrides — replay moves nothing), and
+    stays consistent-hashing-clean (zero excess)."""
+    from ringpop_tpu.serve.placement import dgro_place
+
+    toks, owns, rep = dgro_place(SERVERS, 50, candidates=4,
+                                 local_moves=(2, 4, 8), probes=1 << 13,
+                                 churn_frac=0.05, seed=2)
+    assert rep["family"] == 7 and rep["move_candidates"] == 3
+    d0 = rep["diameter"][0]
+    m0 = rep["movement"][0]
+    for c in range(4, 7):  # the move candidates ride after the salts
+        assert rep["diameter"][c] < d0
+        assert rep["movement"][c] <= m0 + 1e-9  # gate-eligible
+        assert rep["excess_movement"][c] == 0.0
+    # more moves -> no larger diameter (monotone guidance)
+    assert rep["diameter"][6] <= rep["diameter"][4]
+    assert rep["movement_chosen"] <= rep["movement_random"] + 1e-9
+
+
+def test_dgro_local_move_sticky_replay_and_store_churn():
+    """A chosen move candidate replays bit-identically through
+    (fixed_salt, fixed_moves), and a RingStore under membership churn
+    keeps every surviving override's token value unchanged."""
+    from ringpop_tpu.serve.placement import dgro_place
+
+    servers = SERVERS[:8]
+    toks, owns, rep = dgro_place(servers, 20, candidates=1,
+                                 local_moves=(4,), probes=1 << 12, seed=5)
+    # candidates=1 leaves only the default + the move variant; the move
+    # variant wins on diameter at equal movement
+    assert rep["local_moves"] == 4 and len(rep["moves"]) == 4
+    t2, o2, rep2 = dgro_place(servers, 20, fixed_salt=rep["salt"],
+                              fixed_moves=rep["moves"])
+    assert np.array_equal(toks, t2) and np.array_equal(owns, o2)
+    assert not rep2["rescored"]
+
+    store = RingStore(servers, replica_points=20, placement="dgro",
+                      placement_kw=dict(candidates=1, local_moves=(4,),
+                                        probes=1 << 12, seed=5))
+    moves = dict(store._dgro_moves)
+    assert moves == rep["moves"]
+    store.update(add=["mv:1"], remove=[servers[0]])
+    assert store._dgro_moves == moves  # sticky across churn
+    ht, ho, hg, _ = store.snapshot_host()
+    surviving = {k: v for k, v in moves.items() if k[0] != servers[0]}
+    for (_srv, _rep), tok in surviving.items():
+        assert np.uint32(tok) in ht  # survivor overrides kept verbatim
 
 
 def test_dgro_candidate_zero_is_default_placement():

@@ -1,0 +1,128 @@
+"""Multi-host serve mesh (serve/mesh.py, r17): block ownership,
+cross-forwarded LookupN answering over the fabric, digest certificates."""
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.serve.mesh import (
+    ServeMesh,
+    _digest_chain,
+    _stream_hashes,
+    run_serve_mesh,
+)
+
+CFG = dict(n_servers=8, replica_points=20, rounds=2, keys_per_stream=256, seed=3)
+
+
+def _oracle_digest(n_servers, replica_points, n, streams, rounds,
+                   keys_per_stream, seed, gen=0):
+    """The single-process oracle computed OUTSIDE the mesh entirely: the
+    host LookupNUniqueAt walk per key, digest-chained per stream."""
+    from ringpop_tpu.hashing import fingerprint32
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens, host_lookup_n
+
+    servers = [f"10.21.{i // 256}.{i % 256}:3000" for i in range(n_servers)]
+    toks, owns = build_ring_tokens(servers, replica_points)
+    tokens = np.asarray(toks, np.uint32)
+    owners = np.asarray(owns, np.int32)
+    digests = {}
+    for s in range(streams):
+        d = 0
+        for rnd in range(rounds):
+            hashes = _stream_hashes(seed, s, rnd, keys_per_stream)
+            rows = host_lookup_n(tokens, owners, hashes, n, n_servers)
+            d = _digest_chain(d, hashes, rows, gen)
+        digests[s] = d
+    return fingerprint32(
+        b"".join(digests[s].to_bytes(4, "little") for s in range(streams))
+    )
+
+
+def test_mesh_p1_matches_host_walk_oracle():
+    """P=1 (no forwarding at all) must reproduce the pure host-walk
+    oracle digest — pins the fused device dispatch end-to-end."""
+    recs = run_serve_mesh(1, n=3, streams=4, **CFG)
+    want = _oracle_digest(CFG["n_servers"], CFG["replica_points"], 3, 4,
+                          CFG["rounds"], CFG["keys_per_stream"], CFG["seed"])
+    assert recs[0]["digest"] == want
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_mesh_digest_equals_single_process_oracle(nprocs):
+    """The tentpole certificate: every (owner, successors, generation)
+    tuple answered by the P-rank mesh digests equal to the P=1 oracle."""
+    oracle = run_serve_mesh(1, n=3, streams=4, **CFG)[0]["digest"]
+    recs = run_serve_mesh(nprocs, n=3, streams=4, **CFG)
+    for rec in recs:
+        assert rec["digest"] == oracle
+        # and every rank agrees on every stream digest
+        assert rec["stream_digests"] == recs[0]["stream_digests"]
+
+
+def test_mesh_message_count_is_o_owners_not_o_keys():
+    recs = run_serve_mesh(2, n=3, streams=4, **CFG)
+    for rec in recs:
+        # 2 legs x (P-1) peers x rounds, regardless of key volume
+        assert rec["messages_sent"] == 2 * 1 * CFG["rounds"]
+        assert rec["keys_forwarded_out"] > rec["messages_sent"]
+        assert rec["messages_sent"] < rec["messages_naive"]
+
+
+def test_mesh_wire_accounting_contract():
+    """P=1 moves zero wire bytes; P>1 records split wire/raw counters
+    with wire <= raw (the codec may only ever shrink)."""
+    rec1 = run_serve_mesh(1, n=3, streams=4, **CFG)[0]
+    assert rec1["wire"]["bytes_sent"] == 0
+    for rec in run_serve_mesh(4, n=3, streams=4, **CFG):
+        w = rec["wire"]
+        assert w["bytes_sent"] > 0 and w["bytes_recv"] > 0
+        assert w["bytes_sent"] <= w["raw_bytes_sent"]
+        assert w["bytes_recv"] <= w["raw_bytes_recv"]
+
+
+def test_mesh_block_ownership_covers_ring_exactly():
+    """The process_block rule over the token index space: blocks tile
+    [0, count) contiguously, and rank_of_hashes lands every key inside
+    the owning rank's block."""
+    from ringpop_tpu.forward.batch import rank_of_hashes
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+    from ringpop_tpu.parallel.partition import process_block
+
+    servers = [f"10.21.0.{i}:3000" for i in range(8)]
+    toks, _ = build_ring_tokens(servers, 20)
+    tokens = np.asarray(toks, np.uint32)
+    count = tokens.shape[0]
+    blocks = [process_block(count, r, 4) for r in range(4)]
+    assert blocks[0][0] == 0 and blocks[-1][1] == count
+    for (_, hi), (lo, _) in zip(blocks, blocks[1:]):
+        assert hi == lo
+    rng = np.random.default_rng(0)
+    hashes = rng.integers(0, 2**32, size=512, dtype=np.uint32)
+    ranks = rank_of_hashes(tokens, hashes, 4)
+    idx = np.searchsorted(tokens, hashes, side="left")
+    idx = np.where(idx >= count, 0, idx)
+    for h_idx, r in zip(idx, ranks):
+        lo, hi = blocks[r]
+        assert lo <= h_idx < hi
+
+
+def test_mesh_refuses_non_divisible_workload_and_ring():
+    with pytest.raises(ValueError):
+        run_serve_mesh(3, n=3, streams=4, **CFG)  # streams % P != 0
+    # token count must divide too (process_block's rigidity): 8*20=160
+    # tokens over 7 ranks — refused loudly at construction
+    from ringpop_tpu.parallel.fabric import LocalKV
+
+    with pytest.raises(ValueError):
+        ServeMesh(0, 7, [f"s{i}:1" for i in range(8)], replica_points=20,
+                  streams=7, kv=LocalKV())
+
+
+def test_mesh_codec_off_digest_identical():
+    """The r15 codec is transport-transparent: codec-off mesh answers the
+    same digests (the wire may only cost more)."""
+    on = run_serve_mesh(2, n=3, streams=4, codec=True, **CFG)
+    off = run_serve_mesh(2, n=3, streams=4, codec=False, **CFG)
+    assert on[0]["digest"] == off[0]["digest"]
+    for a, b in zip(on, off):
+        assert a["wire"]["bytes_sent"] <= b["wire"]["bytes_sent"]

@@ -1,0 +1,243 @@
+"""The r17 unified-transport slice: serve TCP framing + shm on the
+fabric's codec/error core (one peer-lifecycle/error model, the r15 codec
+available to forwarded batches, byte accounting preserved)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.net.channel import (
+    CallError,
+    CallTimeoutError,
+    LocalChannel,
+    LocalNetwork,
+    PeerUnreachableError,
+    TCPChannel,
+    decode_array,
+    encode_array,
+)
+from ringpop_tpu.parallel.fabric import (
+    FabricError,
+    FabricPeerLost,
+    FabricTimeout,
+)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# -- one error family ---------------------------------------------------------
+
+
+def test_frontend_surfaces_stay_jax_free():
+    """The unified error family must NOT cost frontends the jax import:
+    channel / forward.batch / shm / serve.client import clean in a fresh
+    interpreter (the family lives in the import-free ringpop_tpu.errors
+    leaf, not parallel.fabric)."""
+    import os
+    import subprocess
+    import sys
+
+    probes = [
+        ("import", m, f"import {m}, sys; "
+                      "raise SystemExit(1 if 'jax' in sys.modules else 0)")
+        for m in (
+            "ringpop_tpu.net.channel",
+            "ringpop_tpu.forward.batch",
+            "ringpop_tpu.serve.shm",
+            "ringpop_tpu.serve.client",
+            "ringpop_tpu.parallel.fabric",  # numpy-only; parallel/__init__ is lazy
+        )
+    ] + [
+        # the fabric ARRAY LANE must stay jax-free AT RUNTIME too — a
+        # frontend decoding a {'_fab': ...} value must not pay (or even
+        # need) the jax import
+        ("runtime", "fabric array lane",
+         "import sys, numpy as np; "
+         "from ringpop_tpu.net.channel import encode_array, decode_array; "
+         "v = encode_array(np.arange(512, dtype=np.uint32), 'json', fabric=True); "
+         "assert (decode_array(v) == np.arange(512)).all(); "
+         "raise SystemExit(1 if 'jax' in sys.modules else 0)"),
+    ]
+    for kind, name, code in probes:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert r.returncode == 0, f"{name} pulled jax ({kind})"
+
+
+def test_channel_errors_are_fabric_errors():
+    """Branching on the fabric family covers every transport: channel
+    timeouts ARE FabricTimeout, dead channel peers ARE FabricPeerLost."""
+    assert issubclass(CallError, FabricError)
+    assert issubclass(CallTimeoutError, FabricTimeout)
+    assert issubclass(PeerUnreachableError, FabricPeerLost)
+
+
+def test_local_network_dead_peer_is_peer_lost():
+    net = LocalNetwork()
+    chan = LocalChannel(net, "a:1")
+    with pytest.raises(FabricPeerLost):
+        _run(chan.call("gone:1", "svc", "/ep", {}))
+
+
+def test_local_network_black_hole_is_fabric_timeout():
+    net = LocalNetwork()
+    chan = LocalChannel(net, "a:1")
+    LocalChannel(net, "b:1").register("svc", "/ep", lambda b, h: {})
+    net.black_hole("b:1")
+    with pytest.raises(FabricTimeout):
+        _run(chan.call("b:1", "svc", "/ep", {}, timeout=0.01))
+
+
+def test_tcp_connect_refused_is_peer_lost():
+    async def main():
+        chan = TCPChannel(app="t")
+        with pytest.raises(FabricPeerLost):
+            await chan.call("127.0.0.1:1", "svc", "/ep", {}, timeout=0.5)
+
+    _run(main())
+
+
+def test_shm_client_timeout_is_fabric_timeout():
+    """A posted slot nobody answers times out as FabricTimeout — the shm
+    flavor of a silent fabric peer."""
+    import os
+    import socket
+    import tempfile
+
+    from ringpop_tpu.serve.shm import ShmClient, ShmRing
+
+    ring = ShmRing(slots=2, key_cap=64, max_n=2, create=True)
+    sock_path = os.path.join(tempfile.gettempdir(), f"rp-test-{os.getpid()}.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    srv.bind(sock_path)
+    try:
+        client = ShmClient(ring.name, sock_path, 0, slots=2, key_cap=64,
+                           max_n=2, timeout=0.05, spin_us=10.0)
+        with pytest.raises(FabricTimeout):
+            client.lookup_hashes(np.array([1, 2], np.uint32))
+        client.close()
+    finally:
+        srv.close()
+        os.unlink(sock_path)
+        ring.close(unlink=True)
+
+
+def test_shm_client_dead_server_socket_is_peer_lost():
+    import os
+    import socket
+    import tempfile
+
+    from ringpop_tpu.serve.shm import ShmClient, ShmRing
+
+    ring = ShmRing(slots=2, key_cap=64, max_n=2, create=True)
+    sock_path = os.path.join(tempfile.gettempdir(), f"rp-dead-{os.getpid()}.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    srv.bind(sock_path)
+    client = ShmClient(ring.name, sock_path, 0, slots=2, key_cap=64,
+                       max_n=2, timeout=0.05, spin_us=10.0)
+    srv.close()
+    os.unlink(sock_path)  # the server process "died"
+    try:
+        with pytest.raises(FabricPeerLost):
+            client.lookup_hashes(np.array([1], np.uint32))
+    finally:
+        client.close()
+        ring.close(unlink=True)
+
+
+# -- the r15 codec on channel arrays ------------------------------------------
+
+
+@pytest.mark.parametrize("codec", ["json", "msgpack"])
+def test_fabric_array_lane_round_trips_bit_identical(codec):
+    """Arrays through the fabric lane decode bit-identical under both
+    frame codecs, for sparse (ROWS/RUNS-winning) and dense payloads."""
+    rng = np.random.default_rng(0)
+    sparse = np.zeros((64, 16), np.uint32)
+    sparse[3] = rng.integers(0, 2**32, 16, dtype=np.uint32)
+    dense1d = rng.integers(0, 2**32, 257, dtype=np.uint32)
+    for arr in (sparse.reshape(-1), dense1d, np.zeros(0, np.uint32)):
+        val = encode_array(arr, codec, "<u4", fabric=True)
+        back = decode_array(val, "<u4")
+        assert back.dtype == np.uint32
+        assert np.array_equal(back, arr.reshape(-1))
+        assert back.tobytes() == arr.tobytes()
+    # int32 owner vectors too
+    owners = rng.integers(-1, 64, 4096).astype(np.int32)
+    back = decode_array(encode_array(owners, codec, "<i4", fabric=True), "<i4")
+    assert np.array_equal(back, owners)
+
+
+def test_fabric_lane_shrinks_sparse_payloads():
+    """The accounting contract: a mostly-zero array costs LESS on the
+    wire through the fabric lane than the plain lane (the codec engaged),
+    and a random dense one costs at most the raw fallback + header."""
+    sparse = np.zeros(1 << 14, np.uint32)
+    sparse[7] = 123
+    plain = encode_array(sparse, "msgpack", "<u4")
+    fab = encode_array(sparse, "msgpack", "<u4", fabric=True)
+    assert len(fab["_fab"]) < len(plain) / 10
+    dense = np.random.default_rng(1).integers(0, 2**32, 1 << 14, dtype=np.uint32)
+    fabd = encode_array(dense, "msgpack", "<u4", fabric=True)
+    assert len(fabd["_fab"]) <= len(dense.tobytes()) + 64
+
+
+def test_fabric_lane_through_live_channel_and_forwarder():
+    """End-to-end: a BatchForwarder with fabric_arrays=True against an
+    unmodified lookup endpoint — the decoder's self-description makes
+    the lanes interoperate; answers bit-identical to the plain lane."""
+    from ringpop_tpu.forward.batch import BatchForwarder
+
+    net = LocalNetwork()
+    srv = LocalChannel(net, "s:1")
+    tokens = np.sort(
+        np.random.default_rng(2).choice(2**32 - 1, 64, replace=False).astype(np.uint32)
+    )
+    owners = (np.arange(64) % 8).astype(np.int32)
+
+    async def handle(body, headers):
+        h = decode_array(body["h"], "<u4")
+        idx = np.searchsorted(tokens, h, side="left")
+        idx = np.where(idx >= 64, 0, idx)
+        return {"o": encode_array(owners[idx], "json", "<i4"), "gen": 1}
+
+    srv.register("serve", "/lookup", handle)
+    client = LocalChannel(net, "c:1")
+    hashes = np.random.default_rng(3).integers(0, 2**32, 512, dtype=np.uint32)
+
+    plain_rows, _ = _run(
+        BatchForwarder(client).forward_batch("s:1", hashes)
+    )
+    fab_rows, _ = _run(
+        BatchForwarder(client, fabric_arrays=True).forward_batch("s:1", hashes)
+    )
+    assert np.array_equal(plain_rows, fab_rows)
+
+
+def test_tcp_channel_wire_accounting():
+    """TCPChannel counts every frame it writes, both roles — the
+    fabric's wire_stats contract on the serve framing."""
+
+    async def main():
+        server = TCPChannel(app="srv")
+        server.register("svc", "/echo", lambda b, h: {"x": b.get("x")})
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="cli")
+        for i in range(3):
+            await client.call(addr, "svc", "/echo", {"x": i}, timeout=5)
+        cs, ss = client.wire_stats(), server.wire_stats()
+        await client.close()
+        await server.close()
+        assert cs["frames_sent"] == 3 and ss["frames_sent"] == 3
+        assert cs["bytes_sent"] > 0 and ss["bytes_sent"] > 0
+
+    _run(main())
