@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke mc-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -34,6 +34,16 @@ telemetry-smoke:
 # scored JSONL journal round-trip.
 chaos-smoke:
 	$(PY) scripts/chaos_smoke.py
+
+# topology-plane gate (sim/topology.py): tiny 2-rack/2-zone tree —
+# compile (blocked ids, monotone drop table, penalty-free tree emits NO
+# legs), scored-fleet round-trip with per-tier telemetry (journal tier
+# keys + per-tier ttd/false-positive split on every score; zone loss
+# must NOT read as independent crashes), sharded==unsharded digest twin
+# on the 4x2 virtual mesh, and the constant-tree jaxpr identity with
+# the flat fault-plan step.
+topo-smoke:
+	$(PY) scripts/topo_smoke.py
 
 # batched chaos-fleet gate (sim/scenarios.py, r12): tiny churn x loss
 # grid through the stacked-FaultPlan Monte-Carlo fleet — B=1 member must

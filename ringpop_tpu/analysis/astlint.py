@@ -144,7 +144,13 @@ def _fixture_slug(relpath: str) -> str | None:
 # to): chaos-host-sync pins RPA103 catching a host-synced faults_at — the
 # chaos plane's one banned implementation shape (a concretized tick
 # turns the device-resident timeline into a per-tick host round-trip).
-FIXTURE_SLUG_ALIASES = {"chaos-host-sync": "host-sync-in-jit"}
+FIXTURE_SLUG_ALIASES = {
+    "chaos-host-sync": "host-sync-in-jit",
+    # the topology plane's shape of the same hazard: a host-synced tier
+    # lookup inside the jitted step (sim/topology.py compiles host-side
+    # ONCE; evaluation must stay device-pure)
+    "topo-host-sync": "host-sync-in-jit",
+}
 
 
 def _rule_applies(rule: str, relpath: str) -> bool:

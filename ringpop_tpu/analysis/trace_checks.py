@@ -298,15 +298,30 @@ def _no_compile_cache():
     attribution (observed: a clean fixture served its trip twin's
     peer-choice metadata once a prior test dropped the cache's
     min-compile-time threshold to zero).  Phase attribution is only
-    trustworthy on a fresh compile."""
+    trustworthy on a fresh compile.
+
+    The enable flag alone is NOT sufficient on this jax version once a
+    cache dir has been configured and USED in the process: the cache
+    singleton binds its directory at first use (the same trap
+    ``accel._xla_target_bits`` documents) and a dir-backed entry written
+    by an earlier session can still serve its alien phase metadata —
+    observed as phantom peer-choice collectives in the fleet census with
+    a warm repo cache.  So the dir is unset AND the singleton reset for
+    the censused compile, then restored (and reset again) on exit."""
     import jax
+    from jax._src import compilation_cache as _cc
 
     old = jax.config.jax_enable_compilation_cache
+    old_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_enable_compilation_cache", False)
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()  # unbind the first-use-bound directory
     try:
         yield
     finally:
         jax.config.update("jax_enable_compilation_cache", old)
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        _cc.reset_cache()  # rebind lazily on the next ordinary compile
 
 
 def census_of_text(hlo_text: str) -> dict:
@@ -468,6 +483,25 @@ def _stacked_plan(n):
 
     return chaos.stack_plans(
         [_chaos_plan(n), chaos.scenario_plan("churn", n, seed=1, horizon=64)]
+    )
+
+
+def _topo_plan(n):
+    """The topology-enabled chaos plan (sim/topology.py): the every-leg
+    chaos plan PLUS the compiled rack/zone/region tier legs (penalized,
+    so they genuinely trace) and the traced suspicion-timeout override —
+    the program whose fault-plan phase RPJ203/RPJ206 must census
+    collective-free with the blocked one-hot tier expansion inside it."""
+    import jax.numpy as jnp
+
+    from ringpop_tpu.sim import chaos, topology
+
+    topo = topology.default_topology(n)
+    assert topo.has_penalties(), "the lint plan must trace the tier legs"
+    return chaos._merge_plans(
+        _chaos_plan(n),
+        topo.plan_legs(),
+        chaos.FaultPlan(suspect_ticks=jnp.asarray(7, jnp.int32)),
     )
 
 
@@ -634,6 +668,18 @@ def build_entrypoints(mesh=None) -> dict:
         lambda s, p: delta.step(dparams, s, p)
     )(dstate, plan)
 
+    # the topology-enabled chaos step (the tentpole of the topology
+    # round): the same engine driven by a plan that additionally carries
+    # the compiled rack/zone/region tier legs + the traced
+    # suspect_ticks override.  The tier-table expansion runs under the
+    # fault-plan scope, which must stay collective-free (RPJ203 here,
+    # compiled-census RPJ206 in run_hlo_checks); 32-bit and
+    # callback-free like its flat sibling.
+    tplan = _topo_plan(_N)
+    out["lifecycle_step_topo"] = jax.make_jaxpr(
+        lambda s, p: lifecycle.step(lparams, s, p)
+    )(lstate, tplan)
+
     # the batched chaos-MC step (r12): B heterogeneous stacked FaultPlans
     # vmapped over (plan, state) — the Monte-Carlo fleet's program.  Every
     # invariant must hold UNDER the batching transform: fault-plan phase
@@ -720,6 +766,7 @@ def run_trace_checks() -> list[Finding]:
         "detect_walk",
         "lifecycle_step_chaos",
         "delta_step_chaos",
+        "lifecycle_step_topo",
     ):
         findings += check_structural_equivalence(name, dense[name], sharded[name])
     # r11: the pipelined sharded step must be skeleton-equal to the
@@ -838,10 +885,19 @@ def run_hlo_checks() -> list[Finding]:
             chaos_text = (
                 blk.lower(state, _chaos_plan(_HLO_N), ticks=1).compile().as_text()
             )
+            # the topology-enabled compile: the blocked one-hot tier
+            # expansion runs under the fault-plan scope — a
+            # partitioner-introduced collective there (e.g. the tier
+            # table replicating mid-phase) is exactly what this census
+            # exists to catch
+            topo_text = (
+                blk.lower(state, _topo_plan(_HLO_N), ticks=1).compile().as_text()
+            )
     finally:
         lifecycle._SPARSE_TOPK_MIN_N = old_min_n
     findings += check_hlo_confinement("lifecycle_step[hlo,sharded]", text)
     findings += check_hlo_confinement("lifecycle_step_chaos[hlo,sharded]", chaos_text)
+    findings += check_hlo_confinement("lifecycle_step_topo[hlo,sharded]", topo_text)
 
     # r12: the BATCHED chaos-MC block compiled over the same mesh (batch
     # axis replicated, node/rumor sharded as canonical — the fleet ksweep

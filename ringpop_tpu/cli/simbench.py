@@ -59,6 +59,7 @@ import argparse
 import functools as _functools
 import json
 import time
+from typing import Optional
 
 
 def _emit(result: dict) -> None:
@@ -1318,18 +1319,27 @@ def bench_mc_chaos(seed: int, full: bool) -> dict:
 # -- chaos-plane scenarios (sim/chaos.py) ------------------------------------
 
 
-def _chaos_sharded_twin(name: str, seed: int, n=4096, k=64, ticks=24, horizon=64) -> dict:
+def _chaos_sharded_twin(name: str, seed: int, n=4096, k=64, ticks=24, horizon=64,
+                        builder: str = "chaos") -> dict:
     """Certify the scenario's FaultPlan partition-invariant: run the SAME
-    plan (same builder, ``chaos.scenario_plan``) unsharded and over the
-    4×2 virtual mesh in a child process (the 8-device CPU mesh needs
-    ``xla_force_host_platform_device_count`` before backend init) and
-    compare state digests + every leaf.  Small config on purpose — the
-    certificate is about the chaos-enabled program, which is
-    shape-uniform in n."""
+    plan (same builder — ``chaos.scenario_plan``, or the topology
+    family's ``topology.topo_scenario_plan`` with ``builder="topo"``)
+    unsharded and over the 4×2 virtual mesh in a child process (the
+    8-device CPU mesh needs ``xla_force_host_platform_device_count``
+    before backend init) and compare state digests + every leaf.  Small
+    config on purpose — the certificate is about the chaos-enabled
+    program, which is shape-uniform in n."""
     import os
     import subprocess
     import sys
 
+    if builder == "topo":
+        plan_expr = (
+            "__import__('ringpop_tpu.sim.topology', fromlist=['x'])"
+            f".topo_scenario_plan({name!r}, n, seed=seed, horizon={horizon})"
+        )
+    else:
+        plan_expr = f"chaos.scenario_plan({name!r}, n, seed=seed, horizon={horizon})"
     code = f"""
 import os, json, functools
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -1344,7 +1354,7 @@ from ringpop_tpu.sim import chaos, lifecycle, telemetry
 from ringpop_tpu.parallel.mesh import with_exchange_mesh
 
 n, k, ticks, seed = {n}, {k}, {ticks}, {seed}
-plan = chaos.scenario_plan({name!r}, n, seed=seed, horizon={horizon})
+plan = {plan_expr}
 params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=6, rng="counter")
 blk = jax.jit(functools.partial(lifecycle._run_block, params), static_argnames="ticks")
 ref = blk(lifecycle.init_state(params, seed=seed), plan, ticks=ticks)
@@ -1656,6 +1666,158 @@ def bench_asym_partition(seed: int, full: bool) -> dict:
     n = 50_000 if full else 4096
     return _run_chaos_scenario("asym_partition", "asym", n, 64, horizon=256,
                                seed=seed)
+
+
+def bench_topo_chaos(seed: int, full: bool) -> dict:
+    """Topology-realistic fault overlays (the topology-round tentpole):
+    the correlated-failure scenario family — per-zone loss, per-rack
+    switch flap, symmetric + one-way WAN partitions, and matched
+    independent-crash controls, every member riding the compiled
+    rack/zone/region tier legs (``sim/topology.py``) — scored through
+    the B≥32 batched fleet with per-tier telemetry.
+
+    Three certificates in one scenario:
+
+    1. **The fleet run** — B stacked plans through ``scored_fleet`` with
+       the per-tier suspicion counters armed: every verdict carries
+       ``suspects_by_tier`` / ``false_positive_by_tier`` /
+       ``time_to_detect_by_tier`` (the per-tier split the acceptance
+       bar names).
+    2. **Correlated ≠ independent** — the discriminator the uniform-loss
+       grid cannot express: a zone cut leaves no live same-rack/
+       same-zone observers, so its suspicion flow arrives only from
+       across the boundary, while the SAME number of independent
+       crashes draws near-tier suspicion everywhere.  Recorded as the
+       near-tier (same-rack + cross-rack) share of suspicion flow per
+       event family; ``distinguishes`` = the independent control's
+       near share strictly exceeds the zone cut's.
+    3. **Sharded twin** — the canonical ``smoke`` topology plan run
+       unsharded vs the 4×2 virtual mesh (child process), digests +
+       every leaf bit-equal: the tier legs are partition-invariant like
+       every other fault leg.
+    """
+    import numpy as np
+
+    from ringpop_tpu.sim import chaos, scenarios, topology
+    from ringpop_tpu.sim.lifecycle import LifecycleParams
+
+    n = 4096 if full else 512
+    k = 32
+    horizon = 256
+    reps = 2 if full else 1
+    topo = topology.default_topology(n)
+    plans, meta = topology.topo_scenario_specs(
+        topo, seed=seed, horizon=horizon, reps=reps
+    )
+    stacked = chaos.stack_plans(plans)
+    b = chaos.plan_batch_size(stacked)
+    seeds = [seed + i for i in range(len(plans))]
+    params = LifecycleParams(n=n, k=k, suspect_ticks=10, rng="counter")
+
+    sink = _telemetry_sink(
+        "topo_chaos", "lifecycle",
+        {"n": n, "k": k, "seed": seed, "b": b,
+         "tree": {"regions": topo.spec.regions,
+                  "zones": topo.spec.total_zones,
+                  "racks": topo.spec.total_racks},
+         "tier_drop": [float(x) for x in topo.tier_drop]},
+    )
+    # sink may stay None (no --telemetry): scored_fleet collects its own
+    # per-scenario blocks for scoring — an unread in-memory sink would
+    # only add per-block host work inside the timed fleet window
+    try:
+        t0 = time.perf_counter()
+        scores = scenarios.scored_fleet(
+            params, stacked, meta, seeds, horizon=horizon, journal_every=16,
+            sink=sink, scenario="topo_chaos",
+        )
+        fleet_s = time.perf_counter() - t0
+    finally:
+        _close_sink(sink)
+
+    # -- the per-tier split must be present on every verdict ------------------
+    split_present = all(
+        isinstance(s.get("suspects_by_tier"), dict)
+        and isinstance(s.get("false_positive_by_tier"), dict)
+        and isinstance(s.get("time_to_detect_by_tier"), dict)
+        for s in scores
+    )
+
+    # -- correlated vs independent: near-tier suspicion share ------------------
+    def near_share(score) -> Optional[float]:
+        by_tier = score.get("suspects_by_tier") or {}
+        total = float(sum(by_tier.values()))
+        if total <= 0:
+            return None
+        return (by_tier.get("same_rack", 0) + by_tier.get("cross_rack", 0)) / total
+
+    def family(event: str) -> list:
+        vals = [
+            near_share(s) for s, m in zip(scores, meta) if m["event"] == event
+        ]
+        return [v for v in vals if v is not None]
+
+    zone_near = family("zone_loss")
+    ind_near = family("independent")
+    zone_med = float(np.median(zone_near)) if zone_near else None
+    ind_med = float(np.median(ind_near)) if ind_near else None
+    distinguishes = (
+        zone_med is not None and ind_med is not None and ind_med > zone_med
+    )
+
+    # per-family median time-to-detect (the crash families share the
+    # schedule, so this is the correlated-vs-independent latency story)
+    def fam_ttd(event: str):
+        vals = [
+            s["time_to_detect_median"]
+            for s, m in zip(scores, meta)
+            if m["event"] == event and s.get("time_to_detect_median") is not None
+        ]
+        return float(np.median(vals)) if vals else None
+
+    # the one-way WAN window must attribute its refutations to the
+    # unreachable direction (the asym semantics through the topology
+    # builder)
+    wan_scores = [s for s, m in zip(scores, meta) if m["event"] == "wan_oneway"]
+    wan_split_ok = all(
+        s.get("refutations_unreachable_dir") is not None
+        and s.get("refutations_reachable_dir") is not None
+        for s in wan_scores
+    )
+
+    twin = _chaos_sharded_twin("smoke", seed, builder="topo")
+    return {
+        "metric": f"topo_chaos_n{n}_b{b}",
+        "value": round(fleet_s, 2),
+        "unit": "s_fleet_wall",
+        "n_nodes": n,
+        "k": k,
+        "b": b,
+        "horizon": horizon,
+        "tree": {
+            "regions": topo.spec.regions,
+            "zones": topo.spec.total_zones,
+            "racks": topo.spec.total_racks,
+            "tier_drop": [round(float(x), 6) for x in topo.tier_drop],
+        },
+        "events": sorted({m["event"] for m in meta}),
+        "per_tier_split_present": split_present,
+        "near_tier_share": {
+            "zone_loss_median": zone_med,
+            "independent_median": ind_med,
+        },
+        "distinguishes_correlated": bool(distinguishes),
+        "ttd_median_by_event": {
+            ev: fam_ttd(ev)
+            for ev in ("zone_loss", "independent", "wan", "wan_oneway")
+        },
+        "wan_direction_split_present": bool(wan_split_ok),
+        "sharded_digest_equal": twin.get("equal"),
+        "sharded_twin": twin,
+        "certified": bool(
+            split_present and distinguishes and wan_split_ok and twin.get("equal")
+        ),
+    }
 
 
 def bench_multihost16m(seed: int, full: bool) -> dict:
@@ -2212,6 +2374,7 @@ BENCHES = {
     "churn100k": bench_churn100k,
     "flap1k": bench_flap1k,
     "asym_partition": bench_asym_partition,
+    "topo_chaos": bench_topo_chaos,
 }
 
 

@@ -62,19 +62,27 @@ PARTITION_RULES: list[tuple[str, P]] = [
     # big per-(node, rumor) planes — packed planes shard WORDS, unpacked
     # planes shard SLOTS (packbits.check_rumor_shardable is the k rule)
     (r"(^|/)(learned|pcount|ride_ok|piggybacked|expired)$", P("node", "rumor")),
-    # per-node vectors (engine state, telemetry masks, fault legs)
+    # topology tier ids: int32[TIER_LEVELS, N] — the node axis is LAST
+    # (sim/topology.py), so the rule shards axis 1 and replicates the
+    # tiny fixed level axis
+    (r"(^|/)(tier_ids)$", P(None, "node")),
+    # per-node vectors (engine state, telemetry masks, fault legs); the
+    # per-tier suspicion counters are [N, N_TIERS] — P("node") shards
+    # their node axis and replicates the 4-wide tier axis
     (
         r"(^|/)(base_status|base_inc|base_present|base_pending|base_deadline"
         r"|self_inc|pings|ping_reqs|probes_failed|incarnation_bumps"
         r"|base_timer_fires|up|base_up|group|drop_node|crash_tick"
-        r"|restart_tick|flap_period|flap_phase|flap_down)$",
+        r"|restart_tick|flap_period|flap_phase|flap_down"
+        r"|suspects_by_tier|false_suspects_by_tier)$",
         P("node"),
     ),
     # rumor-table vectors
     (r"(^|/)(r_subject|r_inc|r_status|r_deadline|timer_fires)$", P("rumor")),
     # everything else replicates: tick/key scalars, decl_* placement
     # vectors ([M] = alloc budget, replicated post-merge), heal_attempts,
-    # drop_rate, part_from/part_until, the tiny reach[G, G] matrix
+    # drop_rate, part_from/part_until, the tiny reach[G, G] matrix, the
+    # [4] tier_drop table and the suspect_ticks scalar
 ]
 
 

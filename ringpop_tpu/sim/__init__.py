@@ -43,11 +43,20 @@ protocol period at once:
   Monte-Carlo fleet.
 
 * :mod:`ringpop_tpu.sim.scenarios` — the scenario-grid compiler on top:
-  sweep a parameter grid (churn dose × loss × partition width, with
-  suspicion timeout as a static outer axis) into stacked plans, run ONE
+  sweep a parameter grid (churn dose × loss × partition width ×
+  suspicion timeout × topology overlay) into stacked plans, run ONE
   AOT-warm-started batched program, reduce the batched telemetry
   journal into per-scenario verdicts and 2-D response surfaces
   (``simbench mc_chaos``).
+
+* :mod:`ringpop_tpu.sim.topology` — the topology compiler: a
+  declarative rack/zone/region tree with per-edge latency/loss compiled
+  host-side to per-node tier-id arrays + a per-tier drop table
+  (cross-boundary probe-timeout inflation as tier loss), evaluated
+  inside the jitted step by shard-local blocked one-hot gathers — no
+  dense [G, G] product — plus the correlated-failure scenario family
+  (zone loss, switch flap, one-way WAN partition) that batches through
+  the fleet and scores with per-tier breakdowns.
 
 Fault injection is first-class: partition group arrays (symmetric or
 directed via ``reach[G, G]``), scalar and per-node drop probabilities,
@@ -61,8 +70,12 @@ from ringpop_tpu.sim.delta import DeltaSim, DeltaParams
 from ringpop_tpu.sim.lifecycle import LifecycleSim, LifecycleParams
 from ringpop_tpu.sim.montecarlo import MonteCarlo, detection_latency_distribution
 from ringpop_tpu.sim.chaos import FaultPlan, faults_at, score_blocks, stack_plans
+from ringpop_tpu.sim.topology import Topology, TopologySpec, compile_topology
 
 __all__ = [
+    "Topology",
+    "TopologySpec",
+    "compile_topology",
     "FullViewSim",
     "FullViewParams",
     "DeltaSim",

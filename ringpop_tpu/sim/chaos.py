@@ -66,7 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.sim.delta import DeltaFaults
+from ringpop_tpu.sim.delta import N_TIERS, TIER_LEVELS, TIER_NAMES, DeltaFaults
 
 # "this never happens" tick sentinel (same convention as the engines'
 # NO_DEADLINE): comparisons against it are always false for real ticks
@@ -94,7 +94,10 @@ class FaultPlan(NamedTuple):
     ``[part_from, part_until)``; outside the window every node reports
     group -1 (unpartitioned), so a split/heal is one plan, not a
     host-side fault swap.  Loss legs (``drop_rate``/``drop_node``) are
-    time-invariant and pass through.
+    time-invariant and pass through, as are the topology legs
+    (``tier_ids``/``tier_drop``, compiled by ``sim/topology.py``) and the
+    traced suspicion-timeout override (``suspect_ticks``; -1 = use the
+    engine's static param — the value-neutral stacked default).
 
     Ticks are in the engine clock: the plan is evaluated at
     ``state.tick`` as the step ENTERS (tick t's exchange sees
@@ -113,6 +116,9 @@ class FaultPlan(NamedTuple):
     reach: Optional[jax.Array] = None  # bool[G, G] directed reachability
     drop_rate: Optional[jax.Array] = None  # float32[] scalar loss
     drop_node: Optional[jax.Array] = None  # float32[N] per-node loss
+    tier_ids: Optional[jax.Array] = None  # int32[TIER_LEVELS, N] topology ids
+    tier_drop: Optional[jax.Array] = None  # float32[N_TIERS] per-tier loss
+    suspect_ticks: Optional[jax.Array] = None  # int32[] traced timeout (-1 = params)
 
     def at_tick(self, tick) -> DeltaFaults:
         """The duck-typed seam ``delta.resolve_faults`` dispatches on."""
@@ -161,6 +167,9 @@ def faults_at(plan: FaultPlan, tick) -> DeltaFaults:
             drop_rate=plan.drop_rate,
             drop_node=plan.drop_node,
             reach=plan.reach,
+            tier_ids=plan.tier_ids,
+            tier_drop=plan.tier_drop,
+            suspect_ticks=plan.suspect_ticks,
         )
 
 
@@ -175,7 +184,110 @@ def constant_plan(faults: DeltaFaults) -> FaultPlan:
         reach=faults.reach,
         drop_rate=faults.drop_rate,
         drop_node=faults.drop_node,
+        tier_ids=faults.tier_ids,
+        tier_drop=faults.tier_drop,
+        suspect_ticks=faults.suspect_ticks,
     )
+
+
+# -- plan validation (host-side, at build time) -------------------------------
+
+
+def validate_plan(plan: FaultPlan) -> FaultPlan:
+    """Host-side structural validation of a (solo or stacked) plan —
+    called by every builder in this module and ``sim/topology.py``, and
+    public for hand-built plans.
+
+    The load-bearing checks:
+
+    * ``reach`` must be SQUARE and BOOLEAN — a float or ragged matrix
+      would be consumed as truthy garbage by the gather;
+    * every ``group`` id must index inside the ``reach`` extent — an
+      oversized id silently clamps into someone else's row under jax
+      gather semantics (connecting groups the scenario keeps apart),
+      which is exactly the failure mode a loud build-time error beats;
+    * the topology legs come as a pair with the FIXED shapes the engines
+      trace (``tier_ids`` int32[3, N], ``tier_drop`` float32[4] in
+      [0, 1]);
+    * ``suspect_ticks`` is a positive timeout or the -1 "use params"
+      sentinel — 0 or below-(-1) would silently fire every suspicion
+      immediately / never.
+
+    Traced leaves skip validation (the checks are about plan-BUILD time;
+    a plan constructed under jit is the engine's own doing).  Returns the
+    plan so builders can ``return validate_plan(...)``.
+    """
+    import jax.core as _core
+
+    leaves = [v for v in plan if v is not None]
+    if any(isinstance(v, _core.Tracer) for v in leaves):
+        return plan
+
+    def _np(x):
+        return np.asarray(x)
+
+    if plan.reach is not None:
+        reach = _np(plan.reach)
+        if reach.ndim not in (2, 3) or reach.shape[-1] != reach.shape[-2]:
+            raise ValueError(
+                f"reach must be a square [G, G] matrix (stacked: [B, G, G]); "
+                f"got shape {reach.shape}"
+            )
+        if reach.dtype != np.bool_:
+            raise ValueError(
+                f"reach must be boolean (directed reachability verdicts); "
+                f"got dtype {reach.dtype} — cast explicitly if you mean it"
+            )
+    if plan.group is not None:
+        group = _np(plan.group)
+        if group.size and int(group.min()) < -1:
+            raise ValueError(
+                f"group ids must be >= -1 (-1 = unpartitioned); "
+                f"min is {int(group.min())}"
+            )
+        if plan.reach is not None and group.size:
+            g_extent = int(_np(plan.reach).shape[-1])
+            g_max = int(group.max())
+            if g_max >= g_extent:
+                raise ValueError(
+                    f"group id {g_max} is out of range for the "
+                    f"[{g_extent}, {g_extent}] reach matrix — an oversized "
+                    "id would silently clamp into another group's row at "
+                    "evaluation time"
+                )
+    if (plan.tier_ids is None) != (plan.tier_drop is None):
+        raise ValueError(
+            "topology legs come as a pair: tier_ids (int32[3, N]) and "
+            "tier_drop (float32[4])"
+        )
+    if plan.tier_ids is not None:
+        ids = _np(plan.tier_ids)
+        if ids.shape[-2] != TIER_LEVELS:
+            raise ValueError(
+                f"tier_ids must carry the fixed {TIER_LEVELS}-level "
+                f"rack/zone/region hierarchy on axis -2; got shape {ids.shape}"
+            )
+        table = _np(plan.tier_drop)
+        if table.shape[-1] != N_TIERS:
+            raise ValueError(
+                f"tier_drop must have one entry per tier distance "
+                f"({N_TIERS}: {', '.join(TIER_NAMES)}); got shape {table.shape}"
+            )
+        if table.size and (float(table.min()) < 0.0 or float(table.max()) > 1.0):
+            raise ValueError(
+                f"tier_drop entries are loss probabilities in [0, 1]; "
+                f"got range [{float(table.min())}, {float(table.max())}]"
+            )
+    if plan.suspect_ticks is not None:
+        st = _np(plan.suspect_ticks)
+        if bool(((st < 1) & (st != -1)).any()):
+            raise ValueError(
+                "suspect_ticks must be >= 1 (or the -1 'use params' "
+                f"sentinel); got {st.tolist() if st.ndim else int(st)}"
+            )
+    if plan.flap_period is not None and plan.flap_down is None:
+        raise ValueError("flap_period without flap_down: how long is a flap?")
+    return plan
 
 
 # -- scenario builders (host-side; dense device arrays out) -------------------
@@ -281,7 +393,7 @@ def _merge_plans(*plans: FaultPlan) -> FaultPlan:
             if merged.get(field) is not None:
                 raise ValueError(f"leg {field!r} set by more than one plan")
             merged[field] = value
-    return FaultPlan(**merged)
+    return validate_plan(FaultPlan(**merged))
 
 
 def scenario_plan(name: str, n: int, seed: int = 0, horizon: int = 256) -> FaultPlan:
@@ -290,7 +402,7 @@ def scenario_plan(name: str, n: int, seed: int = 0, horizon: int = 256) -> Fault
     sharded-twin subprocess, and the tests all construct the identical
     plan.  Schedules scale with ``horizon`` (the run's tick budget)."""
     if name == "churn":
-        return churn_plan(
+        return validate_plan(churn_plan(
             n,
             n_churn=max(8, n // 100),
             n_permanent=max(2, n // 400),
@@ -299,7 +411,7 @@ def scenario_plan(name: str, n: int, seed: int = 0, horizon: int = 256) -> Fault
             waves=4,
             down_ticks=max(16, horizon // 4),
             seed=seed,
-        )
+        ))
     if name == "flap":
         return _merge_plans(
             flap_plan(
@@ -376,6 +488,9 @@ PLAN_LEG_NDIM = {
     "reach": 2,
     "drop_rate": 0,
     "drop_node": 1,
+    "tier_ids": 2,
+    "tier_drop": 1,
+    "suspect_ticks": 0,
 }
 
 
@@ -445,6 +560,20 @@ def _leg_default(field: str, n: Optional[int], groups: int):
         return jnp.asarray(0.0, jnp.float32)
     if field == "drop_node":
         return jnp.zeros((n,), jnp.float32)
+    if field == "tier_ids":
+        # a flat topology: every node shares every id, so any pair is
+        # tier 0 — and the zero table below never drops a leg anyway
+        return jnp.zeros((TIER_LEVELS, n), jnp.int32)
+    if field == "tier_drop":
+        # all-zero table: the tier coin (its own stateless draw site —
+        # sim/delta.py tier_pair_drop) passes every leg, so a member
+        # defaulted here is bit-identical to its topology-less solo run
+        return jnp.zeros((N_TIERS,), jnp.float32)
+    if field == "suspect_ticks":
+        # -1 = "use the engine's static params.suspect_ticks" (the
+        # engines select on the sentinel, so the default member keeps its
+        # solo timeout bit-for-bit)
+        return jnp.asarray(-1, jnp.int32)
     raise ValueError(f"unknown plan leg {field!r}")
 
 
@@ -477,16 +606,18 @@ def stack_plans(plans) -> FaultPlan:
     if not plans:
         raise ValueError("stack_plans needs at least one plan")
     for p in plans:
+        validate_plan(p)
         for field, value in zip(p._fields, p):
             if value is not None and _leg_rank(field, value):
                 raise ValueError(f"stack_plans takes SOLO plans; {field!r} is already stacked")
-    # n inferred from any per-node leg; only needed when one must be defaulted
+    # n inferred from any per-node leg (tier_ids carries the node axis
+    # last); only needed when one must be defaulted
     n = next(
         (
-            int(v.shape[0])
+            int(v.shape[-1]) if f == "tier_ids" else int(v.shape[0])
             for p in plans
             for f, v in zip(p._fields, p)
-            if v is not None and PLAN_LEG_NDIM[f] == 1
+            if v is not None and (PLAN_LEG_NDIM[f] == 1 or f == "tier_ids")
         ),
         None,
     )
@@ -512,7 +643,7 @@ def stack_plans(plans) -> FaultPlan:
                 for v in values
             ]
         else:
-            if n is None and PLAN_LEG_NDIM[field] == 1:
+            if n is None and (PLAN_LEG_NDIM[field] == 1 or field == "tier_ids"):
                 raise ValueError(
                     f"cannot default per-node leg {field!r}: no member names n"
                 )
@@ -728,6 +859,56 @@ def score_blocks(
         )
         out["quorum_acks_min"] = min(
             int(b.get("quorum_acks_min", 0)) for b in qblocks
+        )
+    # topology journals (sim/topology.py; blocks carry the per-tier
+    # suspicion-flow keys of tier-armed telemetry): the per-tier verdict
+    # breakdown the correlated-failure scenarios are scored on.  A zone
+    # cut and 100 independent crashes produce the same global counters;
+    # the tier split is what tells them apart — correlated loss has no
+    # live same-rack observers left to accuse, so its suspicion flow
+    # arrives only from across the boundary.
+    tier_keys = [nm.replace("-", "_") for nm in TIER_NAMES]
+    tblocks = [b for b in blocks if f"suspects_{tier_keys[0]}" in b]
+    if tblocks:
+        out["suspects_by_tier"] = {
+            k: int(sum(b.get(f"suspects_{k}", 0) for b in tblocks))
+            for k in tier_keys
+        }
+        # declare-time ground truth (the plan knows who was up), not the
+        # refutation arithmetic above: a declaration about a LIVE target
+        # is a false positive the moment it is made
+        out["false_positive_by_tier"] = {
+            k: int(sum(b.get(f"false_suspects_{k}", 0) for b in tblocks))
+            for k in tier_keys
+        }
+        # per-tier time-to-detect: how long after the first fault event
+        # the failure becomes VISIBLE at each tier distance (first block
+        # with suspicion flow at that tier) — block-granular like every
+        # other latency here
+        anchor = min(
+            (e["tick"] for e in events if e["kind"] in ("crash", "partition", "flap")),
+            default=None,
+        )
+        ttd_tier: dict = {}
+        for k in tier_keys:
+            first = None
+            if anchor is not None:
+                for b in tblocks:
+                    if int(b["tick"]) >= anchor and float(b.get(f"suspects_{k}", 0)) > 0:
+                        first = int(b["tick"]) - anchor
+                        break
+            ttd_tier[k] = first
+        out["time_to_detect_by_tier"] = ttd_tier
+    # directed-partition journals: refutations split by whether the
+    # refuting subject sits in the unreachable direction of the window
+    # (telemetry.fetch attributes by the plan's static group/reach legs)
+    dblocks = [b for b in blocks if "refuted_unreachable_dir" in b]
+    if dblocks:
+        out["refutations_unreachable_dir"] = int(
+            sum(b.get("refuted_unreachable_dir", 0) for b in dblocks)
+        )
+        out["refutations_reachable_dir"] = int(
+            sum(b.get("refuted_reachable_dir", 0) for b in dblocks)
         )
     if scenario_id is not None:
         # batched-fleet journals: which member of the stacked plan this

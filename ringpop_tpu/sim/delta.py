@@ -66,6 +66,18 @@ class DeltaState(NamedTuple):
 # from max_p-1, so the usable cap is 126, not 127 — shared by every engine
 INT8_SAFE_MAX_P = 126
 
+# -- topology tiers (the sim/topology.py compile target) ----------------------
+# The deployment hierarchy is FIXED at three levels — rack within zone
+# within region — so every topology leg has a STATIC shape (``tier_ids``
+# is int32[3, N], ``tier_drop`` float32[4]) and heterogeneous scenarios
+# stack into one dense fleet axis without shape negotiation (a flat
+# topology just repeats ids across levels).  The tier of an (a → b) leg
+# is the number of levels whose ids differ — a tree property: same rack
+# ⇒ same zone ⇒ same region, so the sum IS the boundary count.
+TIER_LEVELS = 3
+N_TIERS = TIER_LEVELS + 1
+TIER_NAMES = ("same-rack", "cross-rack", "cross-zone", "cross-region")
+
 
 def resolve_max_p(n: int, p_factor: int, max_p: Optional[int]) -> int:
     """SWIM dissemination bound maxP = pFactor·⌈log10(n+1)⌉ unless overridden
@@ -148,6 +160,22 @@ class DeltaFaults:
       also how the chaos plane expresses slow-node probe-timeout
       inflation: an ack that tends to arrive after the timeout is a lost
       leg with that probability (``sim/chaos.py``).
+    * ``tier_ids``/``tier_drop`` — the topology legs (compiled by
+      ``sim/topology.py``): per-node rack/zone/region ids plus a tiny
+      per-tier loss table indexed by the (a → b) leg's tier distance
+      (:func:`tier_pair_drop`) — per-TIER probe-timeout inflation
+      generalizing the slow-node inflation above (a cross-zone ack that
+      tends to arrive after the timeout IS a lost leg at that boundary).
+      The tier coin is a SEPARATE stateless draw site (``rng="counter"``
+      only), so a member whose table is all-zero — the stacked-fleet
+      default — draws coins that always pass and stays bit-identical to
+      a member with no topology legs at all.
+    * ``suspect_ticks`` — traced suspicion-timeout override (int32
+      scalar; -1 = "use the static ``params.suspect_ticks``", the
+      value-neutral stacked default).  ``None`` compiles out to the
+      exact static program; a concrete value makes the suspicion-timeout
+      axis batchable through the Monte-Carlo fleet like every other
+      plan leg.
     """
 
     up: Optional[jax.Array] = None  # bool[N]
@@ -155,24 +183,30 @@ class DeltaFaults:
     drop_rate: Optional[jax.Array] = None  # float32[] (traced; None = no loss)
     drop_node: Optional[jax.Array] = None  # float32[N] per-node loss
     reach: Optional[jax.Array] = None  # bool[G, G] directed group reachability
+    tier_ids: Optional[jax.Array] = None  # int32[TIER_LEVELS, N] rack/zone/region
+    tier_drop: Optional[jax.Array] = None  # float32[N_TIERS] per-tier loss
+    suspect_ticks: Optional[jax.Array] = None  # int32[] traced timeout (-1 = params)
 
 
 # registered WITH keys so path-aware tree walks (the canonical partition
 # table in parallel/partition.py matches leaves by name) see field names
 # instead of flat indices; flatten order and aux are unchanged, so every
 # existing tree_map/vmap treatment is identical
+_FAULT_FIELDS = (
+    "up", "group", "drop_rate", "drop_node", "reach",
+    "tier_ids", "tier_drop", "suspect_ticks",
+)
+
 jax.tree_util.register_pytree_with_keys(
     DeltaFaults,
     lambda f: (
         tuple(
             (jax.tree_util.GetAttrKey(n), getattr(f, n))
-            for n in ("up", "group", "drop_rate", "drop_node", "reach")
+            for n in _FAULT_FIELDS
         ),
         None,
     ),
-    lambda aux, c: DeltaFaults(
-        up=c[0], group=c[1], drop_rate=c[2], drop_node=c[3], reach=c[4]
-    ),
+    lambda aux, c: DeltaFaults(**dict(zip(_FAULT_FIELDS, c))),
 )
 
 
@@ -231,6 +265,55 @@ def leg_survives(faults: DeltaFaults, u, a, b):
     if faults.drop_rate is not None:
         keep = keep * (1.0 - jnp.float32(faults.drop_rate))
     return u < keep
+
+
+# -- topology tier evaluation -------------------------------------------------
+
+
+def check_tier_legs(faults: DeltaFaults) -> bool:
+    """Static (trace-time) gate for the topology legs: both present (a
+    topology) or both absent (flat — the legs compile out entirely).
+    One alone is a construction error, refused loudly."""
+    has_ids = getattr(faults, "tier_ids", None) is not None
+    has_drop_t = getattr(faults, "tier_drop", None) is not None
+    if has_ids != has_drop_t:
+        raise ValueError(
+            "topology legs come as a pair: tier_ids (int32[3, N]) and "
+            "tier_drop (float32[4]) — one without the other is a "
+            "construction error (sim/topology.py compiles both)"
+        )
+    return has_ids
+
+
+def tier_pair(faults: DeltaFaults, a, b) -> jax.Array:
+    """int32 tier distance of the (a → b) leg: how many hierarchy levels
+    the pair's ids differ in — 0 same-rack, 1 cross-rack/same-zone, 2
+    cross-zone/same-region, 3 cross-region (``TIER_NAMES``).  The two id
+    gathers are the same class of row lookup the partition legs already
+    do (``group[a]``/``group[b]``) and ride the caller's phase scope;
+    the sum is elementwise."""
+    ids = faults.tier_ids
+    da = jnp.take(ids, a, axis=-1)  # [TIER_LEVELS, *a.shape]
+    db = jnp.take(ids, b, axis=-1)
+    return (da != db).astype(jnp.int32).sum(axis=0)
+
+
+def tier_pair_drop(faults: DeltaFaults, a, b) -> jax.Array:
+    """float32 per-leg loss probability from the tiny per-tier table —
+    the blocked one-hot gather form (sum of ``(tier == t) · table[t]``
+    over the static tier count) instead of a dense [G, G] product, per
+    the sparse-GNN-on-dense-hardware pattern (PAPERS.md 1906.11786).
+    The expansion is elementwise in the node lane, so the ``fault-plan``
+    scope it runs under stays collective-free under any mesh (jaxlint
+    RPJ203/RPJ206 forbid a collective there)."""
+    t = tier_pair(faults, a, b)
+    with jax.named_scope("fault-plan"):
+        drop = jnp.zeros(t.shape, jnp.float32)
+        for ti in range(N_TIERS):
+            drop = drop + jnp.where(
+                t == ti, jnp.asarray(faults.tier_drop, jnp.float32)[..., ti], 0.0
+            )
+    return drop
 
 
 def init_state(params: DeltaParams, seed: int = 0, sources: Optional[np.ndarray] = None) -> DeltaState:
@@ -321,6 +404,21 @@ def step(params: DeltaParams, state: DeltaState, faults: DeltaFaults = DeltaFaul
                 else jax.random.uniform(k_drop, (n,))
             )
             conn &= leg_survives(faults, drop_u, i_all, targets)
+        if check_tier_legs(faults):
+            # topology tier loss (sim/topology.py): a SEPARATE stateless
+            # coin per leg, so an all-zero table — the stacked-fleet
+            # default — passes every draw and perturbs nothing (other
+            # sites' streams are independent by construction).  An extra
+            # threefry split would shift every downstream draw instead,
+            # so the topology legs require the counter family.
+            if not use_counter:
+                raise ValueError(
+                    "topology tier legs need rng='counter': their loss "
+                    "coin is an extra stateless draw site; under threefry "
+                    "the extra split would shift every other draw"
+                )
+            topo_u = _prng.draw_uniform(cseed, ctick, _prng.D_TOPO, i_all)
+            conn &= topo_u >= tier_pair_drop(faults, i_all, targets)
 
     with jax.named_scope("rumor-exchange"):
         if shift_mode:

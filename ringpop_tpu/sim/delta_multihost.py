@@ -95,11 +95,15 @@ def _check_supported(params: DeltaParams, faults) -> None:
         getattr(faults, "group", None) is not None
         or getattr(faults, "drop_node", None) is not None
         or getattr(faults, "reach", None) is not None
+        or getattr(faults, "tier_ids", None) is not None
+        or getattr(faults, "tier_drop", None) is not None
+        or getattr(faults, "suspect_ticks", None) is not None
         or hasattr(faults, "at_tick")
     ):
         raise NotImplementedError(
             "multihost delta bridge supports faults=None or up/drop_rate "
-            "legs; group/reach/drop_node/FaultPlan run on the mesh path"
+            "legs; group/reach/drop_node/topology-tier/suspect_ticks/"
+            "FaultPlan run on the mesh path"
         )
 
 

@@ -438,6 +438,19 @@ def as_fullview_faults(faults) -> Faults:
             "fullview cannot express directed reach / per-node drop — "
             "those legs exist only in the delta/lifecycle engines"
         )
+    if (
+        getattr(faults, "tier_ids", None) is not None
+        or getattr(faults, "suspect_ticks", None) is not None
+    ):
+        # same rule for the topology round's legs: per-tier loss and the
+        # traced suspicion timeout have no fullview counterpart (its
+        # suspect_ticks is static aux), so silently dropping them would
+        # simulate a different model
+        raise ValueError(
+            "fullview cannot express topology tier legs or a traced "
+            "suspect_ticks override — those exist only in the "
+            "delta/lifecycle engines"
+        )
     rate = getattr(faults, "drop_rate", None)
     return Faults(
         up=faults.up,

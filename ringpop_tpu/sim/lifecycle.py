@@ -66,12 +66,15 @@ import numpy as np
 
 from ringpop_tpu.sim.delta import (
     DeltaFaults,
+    check_tier_legs as _check_tier_legs,
     clamped_max_p,
     has_drop as _has_drop,
     leg_survives as _leg_survives,
     pair_connected as _pair_connected,
     resolve_faults as _resolve_faults,
     resolve_max_p,
+    tier_pair as _tier_pair,
+    tier_pair_drop as _tier_pair_drop,
     until_loop,
 )
 from ringpop_tpu.sim.packbits import (
@@ -449,6 +452,25 @@ def step(
 
         up = faults.up if faults.up is not None else jnp.ones(n, bool)
 
+        # topology legs present?  (static; the flat path compiles out)
+        has_topo = _check_tier_legs(faults)
+        if has_topo and not use_counter:
+            raise ValueError(
+                "topology tier legs need rng='counter': their loss coin is "
+                "an extra stateless draw site; under threefry the extra "
+                "split would shift every other draw"
+            )
+        # suspicion timeout: the static param unless the fault model
+        # carries the traced override leg (suspect_ticks; -1 = the
+        # value-neutral stacked default meaning "use the param").  None
+        # traces to the exact static program — what keeps the frozen
+        # goldens green without recapture.
+        if faults.suspect_ticks is None:
+            susp_ticks = params.suspect_ticks
+        else:
+            leg = jnp.asarray(faults.suspect_ticks, jnp.int32)
+            susp_ticks = jnp.where(leg < 0, jnp.int32(params.suspect_ticks), leg)
+
         active = state.r_subject >= 0
         rkey = jnp.where(active, _key_of(state.r_inc, state.r_status), jnp.int32(-1))
         # segment id n == dump bucket for free slots
@@ -511,6 +533,12 @@ def step(
                 else jax.random.uniform(k_drop, (n,))
             )
             conn &= _leg_survives(faults, drop_u, i_all, targets)
+        if has_topo:
+            # per-tier leg loss (sim/topology.py): its own stateless coin,
+            # so an all-zero table — the stacked-fleet default — passes
+            # every draw and the member stays bit-identical to a flat one
+            topo_u = _prng.draw_uniform(cseed, ctick, _prng.D_TOPO, i_all)
+            conn &= topo_u >= _tier_pair_drop(faults, i_all, targets)
         delivered = conn & wants
 
         # -- piggyback exchange: request leg + response leg ---------------------
@@ -849,6 +877,13 @@ def step(
                 pd_ack_u = _prng.draw_uniform(
                     cseed, ctick, _prng.D_PEER_DROP_ACK + pcols, i_all[:, None]
                 )
+            if has_topo:
+                topo_req_u = _prng.draw_uniform(
+                    cseed, ctick, _prng.D_TOPO_PEER_REQ + pcols, i_all[:, None]
+                )
+                topo_ack_u = _prng.draw_uniform(
+                    cseed, ctick, _prng.D_TOPO_PEER_ACK + pcols, i_all[:, None]
+                )
         else:
             k_peers, k_pd1, k_pd2 = jax.random.split(k_peers, 3)
             peer_choices = jax.random.randint(
@@ -902,6 +937,13 @@ def step(
             peer_reaches &= peer_ok & _leg_survives(
                 faults, pd_ack_u, peer_choices, targets_b
             )
+        if has_topo:
+            # the indirect legs cross tier boundaries of their own: the
+            # (i → peer) and (peer → target) hops each pay the tier table
+            peer_ok &= topo_req_u >= _tier_pair_drop(faults, i_bcast, peer_choices)
+            peer_reaches &= peer_ok & (
+                topo_ack_u >= _tier_pair_drop(faults, peer_choices, targets_b)
+            )
         reached = peer_reaches.any(axis=1)
         inconclusive = (~peer_ok).all(axis=1)
         declare = probing & ~reached & ~inconclusive
@@ -927,7 +969,7 @@ def step(
         new_inc = _inc_of(jnp.maximum(cand_vals, 0))
         new_dl = jnp.where(
             new_status == SUSPECT,
-            state.tick + params.suspect_ticks,
+            state.tick + susp_ticks,
             jnp.where(
                 new_status == FAULTY,
                 state.tick + params.faulty_ticks,
@@ -1033,8 +1075,21 @@ def step(
             t_sent_w, t_resp_w = sent_w, resp_w
         else:
             t_sent_w, t_resp_w = pack_bool(sent_b), pack_bool(resp_b)
+        # per-tier suspicion flow (armed via telemetry.zeros(tiers=True)
+        # and a topology-carrying plan): the tier of each (accuser →
+        # target) pair and the plan's ground-truth liveness of the
+        # target — both read off intermediates the tick already has, so
+        # telemetry-on stays bit-identical to off
+        declared = declared_tier = declared_up = None
+        if telemetry.suspects_by_tier is not None and has_topo:
+            declared = decl_ok
+            declared_tier = _tier_pair(faults, i_all, targets)
+            declared_up = up[targets]
         telemetry = _tm.accumulate(
             telemetry,
+            declared=declared,
+            declared_tier=declared_tier,
+            declared_up=declared_up,
             delivered=delivered,
             probing=probing,
             ping_req_legs=jnp.where(
@@ -1561,7 +1616,7 @@ class LifecycleSim:
     1M; meant for the small-config smoke)."""
 
     def __init__(self, n: int, seed: int = 0, telemetry=None, journal_views: bool = False,
-                 aot: Optional[str] = None, **kw):
+                 aot: Optional[str] = None, telemetry_tiers: bool = False, **kw):
         from ringpop_tpu.sim import telemetry as _tm
 
         self.params = LifecycleParams(n=n, **kw)
@@ -1582,7 +1637,12 @@ class LifecycleSim:
         self.telemetry_sink = None
         self.journal_views = journal_views
         if telemetry:
-            self.telemetry = _tm.zeros(self.params)
+            # telemetry_tiers arms the per-tier suspicion counters (extra
+            # [N, 4] accumulators + 8 record keys) — only meaningful when
+            # runs carry a topology plan; off by default so the armed
+            # pytree (and every compiled program keyed on it) is unchanged
+            # for every existing caller
+            self.telemetry = _tm.zeros(self.params, tiers=telemetry_tiers)
             self.telemetry_sink = telemetry if callable(telemetry) else None
             self._fetch = jax.jit(_tm.fetch)
             self._digest = jax.jit(_tm.tree_digest)

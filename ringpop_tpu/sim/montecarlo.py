@@ -61,7 +61,16 @@ def init_replicas(params: LifecycleParams, seeds: Sequence[int]):
 # solo (unbatched) ndim per DeltaFaults leaf — a leaf with one more axis
 # carries a leading replica axis and maps over it (chaos.PLAN_LEG_NDIM is
 # the FaultPlan analog)
-_DELTA_FAULTS_NDIM = {"up": 1, "group": 1, "drop_rate": 0, "drop_node": 1, "reach": 2}
+_DELTA_FAULTS_NDIM = {
+    "up": 1,
+    "group": 1,
+    "drop_rate": 0,
+    "drop_node": 1,
+    "reach": 2,
+    "tier_ids": 2,
+    "tier_drop": 1,
+    "suspect_ticks": 0,
+}
 
 
 def _faults_axes(faults):
@@ -259,6 +268,7 @@ class MonteCarlo:
         seeds: Sequence[int],
         telemetry: bool = False,
         aot: Optional[str] = None,
+        telemetry_tiers: bool = False,
     ):
         self.params = params
         self.seeds = list(seeds)
@@ -273,7 +283,9 @@ class MonteCarlo:
         if telemetry:
             from ringpop_tpu.sim import telemetry as _tm
 
-            tz = _tm.zeros(params)
+            # telemetry_tiers arms the per-tier suspicion counters for
+            # topology-carrying fleets (see telemetry.zeros)
+            tz = _tm.zeros(params, tiers=telemetry_tiers)
             b = len(self.seeds)
             self.telemetry = jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (b,) + x.shape), tz

@@ -64,9 +64,12 @@ D_DROP = 3  # per-node packet-loss coin on the direct probe
 D_HEAL_A = 4  # healer endpoint a (scalar)
 D_HEAL_B = 5  # healer endpoint b (scalar)
 D_HEAL_U = 6  # healer attempt coin (scalar)
+D_TOPO = 7  # per-node topology tier-loss coin on the direct probe
 D_PEER = 1 * D_COLUMN_SPAN  # + column j: indirect-probe peer choice [N, P]
 D_PEER_DROP_REQ = 2 * D_COLUMN_SPAN  # + column j: ping-req request-leg loss [N, P]
 D_PEER_DROP_ACK = 3 * D_COLUMN_SPAN  # + column j: ping-req ack-leg loss [N, P]
+D_TOPO_PEER_REQ = 4 * D_COLUMN_SPAN  # + column j: tier-loss coin, ping-req request leg
+D_TOPO_PEER_ACK = 5 * D_COLUMN_SPAN  # + column j: tier-loss coin, ping-req ack leg
 
 
 def fold_key(key) -> jax.Array:

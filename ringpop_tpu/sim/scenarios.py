@@ -26,10 +26,18 @@ Grid axes and where they live:
   cover the loss-free row too.
 * **partition width** — optional symmetric split window (minority
   fraction per scenario; width 0 = no partition leg for that member).
-* **suspicion timeout** — STATIC (``LifecycleParams.suspect_ticks`` is
-  compile-time), so it sweeps as an outer host loop: one compiled
-  program per timeout value, everything else batched inside it
-  (``sweep_static``).
+* **suspicion timeout** — BATCHED since the topology round: the traced
+  ``suspect_ticks`` plan leg (engines select the static param on the -1
+  sentinel, so a member without the leg is bit-identical to the old
+  static path) rides the ``suspects=`` grid axis inside one compiled
+  program.  ``sweep_static`` remains for genuinely compile-time
+  parameters.
+
+* **topology overlays** — the ``overlays=`` axis merges
+  ``sim/topology.py`` scenario plans (zone loss, switch flap, WAN
+  partition, each with its rack/zone/region tier legs) into grid
+  members, so correlated-failure families sweep through the same
+  batched fleet.
 
 The scored path (``scored_fleet``) carries the r7 telemetry counters
 under the batch axis and reduces them per scenario with ONE device fetch
@@ -88,52 +96,81 @@ def scenario_grid(
     doses: Sequence[int],
     losses: Sequence[float] = (0.0,),
     parts: Sequence[float] = (0.0,),
+    suspects: Sequence[Optional[int]] = (None,),
+    overlays: Optional[Sequence[tuple[str, Optional[FaultPlan]]]] = None,
     churn_seed: int = 1234,
     part_from: int = 0,
     part_until: Optional[int] = None,
 ) -> tuple[FaultPlan, list[dict]]:
-    """Compile a (loss × part × churn-dose) grid into ONE stacked plan
-    plus its meta table.
+    """Compile a (overlay × suspicion-timeout × loss × part × churn-dose)
+    grid into ONE stacked plan plus its meta table.
 
     Returns ``(plan, meta)``: ``plan`` is the ``[B, ...]`` stacked
-    FaultPlan (B = len(losses)·len(parts)·len(doses), loss-major /
-    dose-minor), ``meta[i]`` carries ``scenario_id``, the grid
-    coordinates (``churn``/``loss``/``part``) and ``dose_index`` —
-    callers seed scenario i with ``base_seed + dose_index`` so every
-    loss/part row reuses the churn slice's (seed, dose) pairing.  Churn
-    masks are drawn once per dose (``churn_dose_masks``) and shared
-    across rows; a non-zero ``part`` adds a symmetric split window
-    ``[part_from, part_until)`` over the first ``part`` fraction of
-    nodes."""
+    FaultPlan (B = the axis product, loss-major / dose-minor inside each
+    overlay/timeout cell), ``meta[i]`` carries ``scenario_id``, the grid
+    coordinates (``churn``/``loss``/``part``, plus ``suspect``/``overlay``
+    when those axes are swept) and ``dose_index`` — callers seed scenario
+    i with ``base_seed + dose_index`` so every row reuses the churn
+    slice's (seed, dose) pairing.  Churn masks are drawn once per dose
+    (``churn_dose_masks``) and shared across rows; a non-zero ``part``
+    adds a symmetric split window ``[part_from, part_until)`` over the
+    first ``part`` fraction of nodes.
+
+    The two post-r12 axes:
+
+    * ``suspects`` — the suspicion timeout, BATCHED: each value rides the
+      traced ``suspect_ticks`` plan leg (None = the engine's static
+      param, via the -1 stacked sentinel), so the timeout axis runs
+      inside ONE compiled program where it used to be a static outer
+      loop (``sweep_static`` remains for compile-time parameters proper).
+    * ``overlays`` — ``(label, plan-or-None)`` pairs merged into every
+      member: the topology axis (``sim/topology.py`` scenario plans —
+      zone loss, switch flap, WAN partition, with their tier legs) or
+      any other leg family the base grid doesn't set.  Leg collisions
+      (e.g. an overlay partition against ``parts`` > 0) are refused
+      loudly by ``chaos._merge_plans``.
+    """
     masks = churn_dose_masks(n, victims, doses, churn_seed)
     plans, meta = [], []
-    for loss in losses:
-        for part in parts:
-            for j, dose in enumerate(doses):
-                legs = dict(
-                    base_up=jnp.asarray(masks[j]),
-                    drop_rate=jnp.asarray(np.float32(loss)),
-                )
-                if part > 0:
-                    group = np.zeros(n, np.int32)
-                    group[: int(part * n)] = 1
-                    legs.update(
-                        group=jnp.asarray(group),
-                        part_from=jnp.asarray(np.int32(part_from)),
-                        part_until=jnp.asarray(
-                            np.int32(part_until if part_until is not None else chaos.NO_TICK)
-                        ),
-                    )
-                plans.append(FaultPlan(**legs))
-                meta.append(
-                    {
-                        "scenario_id": len(meta),
-                        "churn": int(dose),
-                        "loss": float(loss),
-                        "part": float(part),
-                        "dose_index": j,
-                    }
-                )
+    for olabel, overlay in (overlays if overlays is not None else ((None, None),)):
+        for suspect in suspects:
+            for loss in losses:
+                for part in parts:
+                    for j, dose in enumerate(doses):
+                        legs = dict(
+                            base_up=jnp.asarray(masks[j]),
+                            drop_rate=jnp.asarray(np.float32(loss)),
+                        )
+                        if part > 0:
+                            group = np.zeros(n, np.int32)
+                            group[: int(part * n)] = 1
+                            legs.update(
+                                group=jnp.asarray(group),
+                                part_from=jnp.asarray(np.int32(part_from)),
+                                part_until=jnp.asarray(
+                                    np.int32(part_until if part_until is not None else chaos.NO_TICK)
+                                ),
+                            )
+                        if suspect is not None:
+                            legs["suspect_ticks"] = jnp.asarray(
+                                np.int32(suspect)
+                            )
+                        member = FaultPlan(**legs)
+                        if overlay is not None:
+                            member = chaos._merge_plans(member, overlay)
+                        plans.append(member)
+                        m = {
+                            "scenario_id": len(meta),
+                            "churn": int(dose),
+                            "loss": float(loss),
+                            "part": float(part),
+                            "dose_index": j,
+                        }
+                        if tuple(suspects) != (None,):
+                            m["suspect"] = None if suspect is None else int(suspect)
+                        if overlays is not None:
+                            m["overlay"] = olabel
+                        meta.append(m)
     return chaos.stack_plans(plans), meta
 
 
@@ -145,11 +182,13 @@ def grid_seeds(meta: list[dict], base_seed: int) -> list[int]:
 
 
 def sweep_static(values: Sequence[int], run_fn) -> dict:
-    """The static outer axis (suspicion timeout): ``run_fn(value)`` once
-    per value — one compiled program each, everything else batched inside
-    it.  Returns {value: result}.  Exists so the grid vocabulary names
-    ALL four axes even though one cannot ride the batch dimension (a
-    compile-time constant is a different program by definition)."""
+    """A static outer axis: ``run_fn(value)`` once per value — one
+    compiled program each, everything else batched inside it.  Returns
+    {value: result}.  The suspicion timeout no longer needs this (the
+    traced ``suspect_ticks`` leg batches it — ``scenario_grid(suspects=
+    ...)``); it stays for genuinely compile-time parameters (k, maxP,
+    exchange flavor) and as the A/B baseline the traced-timeout tests
+    pin against."""
     return {int(v): run_fn(int(v)) for v in values}
 
 
@@ -230,7 +269,12 @@ def scored_fleet(
     verdict carrying its grid coordinates.  ``sink`` (a
     ``telemetry.TelemetrySink`` or None) receives every per-scenario
     block record and, when it journals, every score record."""
-    mc = MonteCarlo(params, seeds, telemetry=True)
+    # a topology-carrying plan arms the per-tier suspicion counters, so
+    # its verdicts get the per-tier ttd/false-positive breakdowns
+    mc = MonteCarlo(
+        params, seeds, telemetry=True,
+        telemetry_tiers=plan.tier_ids is not None,
+    )
     blocks: list[list[dict]] = [[] for _ in meta]
     ticks_left = horizon
     while ticks_left > 0:

@@ -47,7 +47,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ringpop_tpu.sim.delta import DeltaFaults, converged_fraction, resolve_faults
+from ringpop_tpu.sim.delta import (
+    N_TIERS,
+    TIER_NAMES,
+    DeltaFaults,
+    converged_fraction,
+    resolve_faults,
+)
+
+# record-key suffixes for the per-tier counters ("same_rack", ...) — the
+# JSON-friendly underscore form of delta.TIER_NAMES, shared by fetch, the
+# stats bridge and chaos.score_blocks
+TIER_KEYS = tuple(name.replace("-", "_") for name in TIER_NAMES)
 from ringpop_tpu.sim.packbits import flat_index_u32, mix32, n_words
 from ringpop_tpu.swim.member import ALIVE, FAULTY, SUSPECT, TOMBSTONE
 
@@ -81,6 +92,14 @@ class TelemetryState(NamedTuple):
     # scalars
     heal_attempts: jax.Array  # int32[]: partition-healer pair swaps tried
     ticks: jax.Array  # int32[]: ticks accumulated since the last fetch
+    # OPTIONAL per-tier suspicion flow (topology plane, sim/topology.py):
+    # None unless armed via ``zeros(params, tiers=True)`` — the None legs
+    # are static structure, so every telemetry program that existed
+    # before the topology plane traces unchanged.  [N, N_TIERS] so the
+    # per-tick update stays elementwise (node-sharded axis 0); fetch owns
+    # the reduction to the 4 per-tier scalars.
+    suspects_by_tier: Optional[jax.Array] = None  # int32[N, 4]: declarations by tier
+    false_suspects_by_tier: Optional[jax.Array] = None  # int32[N, 4]: target was live
 
 
 def placement_budget(params) -> int:
@@ -90,13 +109,25 @@ def placement_budget(params) -> int:
     return min(params.alloc_per_tick, params.k, params.n)
 
 
-def zeros(params) -> TelemetryState:
-    """A zeroed accumulator for a ``LifecycleParams`` config."""
+def zeros(params, tiers: bool = False) -> TelemetryState:
+    """A zeroed accumulator for a ``LifecycleParams`` config.  ``tiers``
+    arms the per-tier suspicion counters (topology runs); the default
+    leaves them None so the pytree — and every program keyed on its
+    structure — is exactly the pre-topology one."""
     n, k = params.n, params.k
     w = n_words(k)
     m = placement_budget(params)
     i32 = jnp.int32
+    tier_kw = (
+        {
+            "suspects_by_tier": jnp.zeros((n, N_TIERS), i32),
+            "false_suspects_by_tier": jnp.zeros((n, N_TIERS), i32),
+        }
+        if tiers
+        else {}
+    )
     return TelemetryState(
+        **tier_kw,
         pings=jnp.zeros((n,), i32),
         ping_reqs=jnp.zeros((n,), i32),
         probes_failed=jnp.zeros((n,), i32),
@@ -129,14 +160,35 @@ def accumulate(
     place: jax.Array,  # bool[M]
     new_status: jax.Array,  # int8[M]
     heal_attempt: Optional[jax.Array],  # bool[] or None (healer disabled)
+    declared: Optional[jax.Array] = None,  # bool[N] suspicion declarers (placed)
+    declared_tier: Optional[jax.Array] = None,  # int32[N] accuser→target tier
+    declared_up: Optional[jax.Array] = None,  # bool[N] target live per the plan
 ) -> TelemetryState:
     """One tick's worth of counter updates — every op elementwise, so the
     partitioner adds no collectives to the step (see module docstring).
     Called by ``lifecycle.step`` with intermediates the tick already has;
-    the popcounts read planes that are materialized regardless."""
+    the popcounts read planes that are materialized regardless.
+
+    The ``declared*`` triple feeds the OPTIONAL per-tier suspicion
+    counters (armed accumulators + a topology-carrying plan; see
+    ``zeros(tiers=True)``): each declarer whose suspect rumor placed this
+    tick counts into its accuser→target tier bucket, and — when the plan
+    says the target was actually live — into the false-positive bucket
+    too.  A one-hot product over the static tier count, elementwise like
+    everything else here."""
     i32 = jnp.int32
     pop = jax.lax.population_count
+    s_tier, f_tier = tel.suspects_by_tier, tel.false_suspects_by_tier
+    if s_tier is not None and declared is not None:
+        onehot = (
+            declared[:, None]
+            & (declared_tier[:, None] == jnp.arange(N_TIERS, dtype=jnp.int32)[None, :])
+        ).astype(i32)
+        s_tier = s_tier + onehot
+        f_tier = f_tier + onehot * declared_up[:, None].astype(i32)
     return TelemetryState(
+        suspects_by_tier=s_tier,
+        false_suspects_by_tier=f_tier,
         pings=tel.pings + delivered.astype(i32),
         ping_reqs=tel.ping_reqs + ping_req_legs,
         probes_failed=tel.probes_failed + probing.astype(i32),
@@ -209,6 +261,12 @@ def fetch(
     it in a cached jit.  A time-varying ``chaos.FaultPlan`` is resolved
     at the state's tick, so the census/detect_frac gauges describe the
     fault model in force at fetch time."""
+    # the UNRESOLVED model's static partition legs: a plan's group/reach
+    # are time-invariant, so attribution by them stays defined even when
+    # the fetch tick falls outside the split window (the resolved group
+    # reads -1 there and every post-heal refutation would go unattributed)
+    raw_group = getattr(faults, "group", None)
+    raw_reach = getattr(faults, "reach", None)
     faults = resolve_faults(faults, state.tick)
     f32 = jnp.float32
     record = {
@@ -239,6 +297,39 @@ def fetch(
         "heal_attempts": tel.heal_attempts,
         "tick": state.tick,
     }
+    if tel.suspects_by_tier is not None:
+        # per-tier suspicion flow (topology plane): 4 + 4 scalar keys —
+        # scalars rather than one [4] column so the batched-fleet split
+        # (``split_batched``) and the journal schema stay flat
+        s = tel.suspects_by_tier.sum(axis=0, dtype=f32)
+        fpos = tel.false_suspects_by_tier.sum(axis=0, dtype=f32)
+        for ti, key in enumerate(TIER_KEYS):
+            record[f"suspects_{key}"] = s[ti]
+            record[f"false_suspects_{key}"] = fpos[ti]
+    if raw_group is not None and raw_reach is not None:
+        # directed-partition attribution (chaos asym scenarios): split the
+        # block's refutations by whether the refuting subject sits in the
+        # unreachable DIRECTION of a one-way window — a group g some
+        # other group a cannot send to while g can still send to a (the
+        # asymmetric shape; that sink side is where false accusations
+        # pile up).  The asymmetry requirement matters in stacked fleets:
+        # a symmetric member materializes the identity-reach default
+        # (``chaos._leg_default``), whose blockages are all MUTUAL — a
+        # direction-less partition must report zero unreachable-dir, not
+        # claim every refutation for a direction it doesn't have.
+        reach_b = jnp.asarray(raw_reach, bool)
+        one_way = ~reach_b & jnp.swapaxes(reach_b, -1, -2)  # a can't reach g, g reaches a
+        blocked = one_way.any(axis=-2)  # [G]: g sits in some one-way sink
+        g = jnp.asarray(raw_group, jnp.int32)
+        flag = (g >= 0) & jnp.take(
+            blocked, jnp.maximum(g, 0), axis=-1
+        )
+        record["refuted_unreachable_dir"] = jnp.where(
+            flag, tel.incarnation_bumps, 0
+        ).sum(dtype=f32)
+        record["refuted_reachable_dir"] = jnp.where(
+            ~flag, tel.incarnation_bumps, 0
+        ).sum(dtype=f32)
     record.update(_census(state, faults))
     fresh = jax.tree.map(jnp.zeros_like, tel)
     return record, fresh
@@ -485,6 +576,14 @@ STAT_KEYS = {
     "rumors_active": ("gauge", "rumors.active"),
     "detect_frac": ("gauge", "detection.fraction"),
 }
+
+# topology-plane block keys (present only on tier-armed topology runs) —
+# surfaced under ringpop.sim.topo.* (OBSERVABILITY.md key table)
+for _tk, _dash in zip(TIER_KEYS, TIER_NAMES):
+    STAT_KEYS[f"suspects_{_tk}"] = ("incr", f"topo.suspects.{_dash}")
+    STAT_KEYS[f"false_suspects_{_tk}"] = ("incr", f"topo.false-suspects.{_dash}")
+STAT_KEYS["refuted_unreachable_dir"] = ("incr", "topo.refuted.unreachable-dir")
+STAT_KEYS["refuted_reachable_dir"] = ("incr", "topo.refuted.reachable-dir")
 
 
 def emit_stats(reporter, record: dict, prefix: str = SIM_STAT_PREFIX) -> None:

@@ -41,6 +41,13 @@ story"):
   both warm.  The model says the fleet amortizes per-dispatch overhead,
   so it must be no slower per tick; slower REFUTES the fleet lowering,
   as does any scenario's final state diverging from its solo run.
+- (topology round) the tier machinery A/B: ``topo_chaos`` — the
+  topology-enabled chaos tick (rack/zone/region tier legs FORCED with a
+  zero drop table, so every tier coin passes) vs the flat chaos tick.
+  The separate-coin model says zero-table legs change no values —
+  bit-unequal REFUTES the lowering — and the tier evaluation (id
+  gathers + blocked one-hot table expansion + coin sites) must cost
+  <= 10% over the flat tick on real hardware.
 - (r13) the serve tier's shared-ring dispatch: ``serve_lookup`` — the
   capacity-padded fused lookup program (owners + generation, one
   transfer) over a 1M-vnode ring vs the per-process host bisect walk,
@@ -468,6 +475,28 @@ def main() -> int:
              f"cyclic {cy} vs swing {sw_ms} vs overlap {ov_ms} ms/tick, "
              f"relay raw ratio {sx.get('relay_raw_ratio')}x, "
              f"bit_equal={sx.get('bit_equal')}")
+        )
+    # the topology round's tier machinery: the topology-enabled chaos
+    # tick (tier legs forced, zero drop table) vs the flat chaos tick.
+    # The separate-coin construction says zero-table tier legs change NO
+    # values — bit-unequal refutes the lowering — and the tier
+    # evaluation (id gathers + blocked one-hot expansion + coin sites)
+    # must stay noise against the packed-plane passes on real hardware.
+    tc = cap.get("topo_chaos") or {}
+    if "error" in tc:
+        verdicts.append(("topology tier machinery", None, tc["error"]))
+    elif tc.get("topo_ms_per_tick_median") is not None and tc.get(
+        "flat_ms_per_tick_median"
+    ) is not None:
+        t_ms, f_ms = tc["topo_ms_per_tick_median"], tc["flat_ms_per_tick_median"]
+        ok = bool(tc.get("bit_equal")) and t_ms <= f_ms * 1.10
+        verdicts.append(
+            (f"topology tier machinery (n={tc.get('n')}, "
+             f"{tc.get('racks')} racks, sharded={tc.get('sharded')})",
+             ok,
+             f"topo {t_ms} vs flat {f_ms} ms/tick "
+             f"(overhead {tc.get('overhead_pct')}%), "
+             f"bit_equal={tc.get('bit_equal')}")
         )
     # the r12 batched chaos fleet: B stacked-FaultPlan scenarios as one
     # vmapped program vs the same B stepped sequentially (both warm — the

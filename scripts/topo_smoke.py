@@ -1,0 +1,186 @@
+"""topo-smoke — the CI gate for the topology plane (sim/topology.py).
+
+Runs the tiny 2-rack/2-zone tree end to end and asserts:
+
+1. **compile** — contiguous blocked tier ids, a monotone tier-drop
+   table, and the penalty-free tree compiling to NO tier legs at all;
+2. **scored fleet round-trip** — a small correlated-failure family
+   (zone loss / switch flap / independent control) through the stacked
+   Monte-Carlo fleet with per-tier telemetry armed: the journal blocks
+   carry the ``suspects_*``/``false_suspects_*`` tier keys, every score
+   record carries the per-tier ttd/false-positive split, and the
+   correlated member's near-tier suspicion share stays below the
+   independent control's (a zone cut must NOT read as independent
+   crashes);
+3. **sharded == unsharded digest twin** — the canonical ``smoke``
+   topology plan over the 4×2 virtual mesh in a child process, digests
+   + every leaf bit-equal;
+4. **constant-tree jaxpr identity** — a zero-penalty tree's scenario
+   traces to the IDENTICAL jaxpr as the flat fault-plan step (the
+   tier legs compile out; no golden recapture needed).
+
+Exit 0 on success, 1 with a diagnosis on any failure.  Wired into
+``make test`` next to chaos-smoke.
+
+Usage:
+    python scripts/topo_smoke.py [--out /tmp/topo_smoke.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="journal path (default: temp file)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from ringpop_tpu.sim import chaos, lifecycle, scenarios, telemetry, topology
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    configure_compile_cache()
+
+    failures: list[str] = []
+    n, k, seed, horizon = 256, 32, 0, 128
+
+    # -- 1: compile the tiny 2-rack/2-zone tree ------------------------------
+    spec = topology.TopologySpec(
+        regions=1, zones_per_region=2, racks_per_zone=2,
+        zone_link=topology.TierLink(rtt_ms=2.0, loss=0.01),
+    )
+    topo = topology.compile_topology(spec, n)
+    rack, zone = topo.tier_ids[0], topo.tier_ids[1]
+    if not (np.all(np.diff(rack) >= 0) and len(np.unique(rack)) == 4):
+        failures.append(f"rack ids not contiguous blocks: {np.unique(rack)}")
+    if not np.all(np.diff(topo.tier_drop.astype(np.float64)) >= 0):
+        failures.append(f"tier_drop not monotone: {topo.tier_drop}")
+    if topo.tier_drop[2] <= 0:
+        failures.append("cross-zone tier carries no penalty — the spec set one")
+    flat = topology.compile_topology(
+        topology.TopologySpec(regions=1, zones_per_region=2, racks_per_zone=2), n
+    )
+    if any(v is not None for v in flat.plan_legs()):
+        failures.append("penalty-free tree emitted tier legs (must compile out)")
+
+    # -- 2: scored fleet round-trip ------------------------------------------
+    path = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="toposmoke_"), "topo_smoke.jsonl"
+    )
+    first, heal = 4, horizon // 2
+    plans = [
+        chaos._merge_plans(
+            topology.zone_loss_plan(topo, 1, at=first, heal=heal), topo.plan_legs()
+        ),
+        chaos._merge_plans(
+            topology.switch_flap_plan(topo, 0, period=12, down=3, start=first),
+            topo.plan_legs(),
+        ),
+        chaos._merge_plans(
+            topology.independent_crash_plan(
+                topo, int(topo.nodes_in_zone(1).size), at=first, heal=heal, seed=seed
+            ),
+            topo.plan_legs(),
+        ),
+    ]
+    meta = [
+        {"scenario_id": 0, "event": "zone_loss"},
+        {"scenario_id": 1, "event": "switch_flap"},
+        {"scenario_id": 2, "event": "independent"},
+    ]
+    stacked = chaos.stack_plans(plans)
+    params = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=8, rng="counter")
+    with telemetry.TelemetryJournal(path) as journal:
+        journal.header("lifecycle", "topo-smoke", {"n": n, "k": k, "seed": seed})
+        sink = telemetry.TelemetrySink(journal=journal)
+        scores = scenarios.scored_fleet(
+            params, stacked, meta, [seed, seed + 1, seed + 2],
+            horizon=horizon, journal_every=16, sink=sink, scenario="topo_smoke",
+        )
+
+    records = telemetry.read_journal(path)
+    blocks = [r for r in records if r.get("kind") == "block"]
+    score_recs = [r for r in records if r.get("kind") == "score"]
+    tier_keys = [f"suspects_{t}" for t in telemetry.TIER_KEYS] + [
+        f"false_suspects_{t}" for t in telemetry.TIER_KEYS
+    ]
+    if not blocks or not all(all(tk in b for tk in tier_keys) for b in blocks):
+        failures.append("journal blocks missing the per-tier suspicion keys")
+    if len(score_recs) != 3:
+        failures.append(f"expected 3 score records, journal has {len(score_recs)}")
+    for s in scores:
+        for key in ("suspects_by_tier", "false_positive_by_tier",
+                    "time_to_detect_by_tier"):
+            if not isinstance(s.get(key), dict):
+                failures.append(f"score {s.get('scenario_id')} missing {key}")
+
+    def near_share(score):
+        bt = score.get("suspects_by_tier") or {}
+        total = float(sum(bt.values()))
+        if total <= 0:
+            return None
+        return (bt.get("same_rack", 0) + bt.get("cross_rack", 0)) / total
+
+    z, ind = near_share(scores[0]), near_share(scores[2])
+    if ind is None or ind <= 0:
+        failures.append(
+            f"independent control raised no near-tier suspicion (share={ind}) — "
+            "the discriminator is vacuous"
+        )
+    elif z is not None and z >= ind:
+        failures.append(
+            f"zone loss near-tier share {z} not below independent control {ind} "
+            "— the correlated event reads as independent crashes"
+        )
+
+    # -- 3: sharded == unsharded digest twin ---------------------------------
+    from ringpop_tpu.cli.simbench import _chaos_sharded_twin
+
+    # k=64: the 4×2 twin mesh shards 32-slot packed words over a 2-way
+    # rumor axis (packbits.check_rumor_shardable)
+    twin = _chaos_sharded_twin("smoke", seed, n=512, k=64, ticks=24,
+                               horizon=64, builder="topo")
+    if not twin.get("equal"):
+        failures.append(f"sharded twin diverged: {twin}")
+
+    # -- 4: constant-tree jaxpr identity -------------------------------------
+    state = lifecycle.init_state(params, seed=seed)
+    const_plan = topology.topo_scenario_plan("flat", n, seed=seed, horizon=horizon)
+    hand_plan = topology.zone_loss_plan(
+        flat, zone=1, at=max(4, horizon // 32), heal=horizon // 2
+    )
+    ja = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, const_plan)
+    jb = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, hand_plan)
+    if str(ja) != str(jb):
+        failures.append(
+            "constant (penalty-free) topology does NOT trace to the flat "
+            "fault-plan jaxpr — the tier legs failed to compile out"
+        )
+
+    if failures:
+        print("topo-smoke FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(
+        f"topo-smoke OK: tree compiled (tier_drop={topo.tier_drop.tolist()}), "
+        f"{len(blocks)} journal blocks + {len(score_recs)} scores with per-tier "
+        f"split (near-tier share zone={z} vs independent={round(ind, 4)}), "
+        f"sharded twin digest {twin['digest_sharded']} == unsharded, "
+        "constant-tree jaxpr identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,11 @@ every section so a mid-run tunnel death still leaves partial evidence):
    FaultPlan evaluated inside the jitted step) vs the plain tick, at the
    same config; sharded over the visible chips when >1 (the number that
    certifies the chaos plane's claimed ~zero overhead on real ICI).
+1d2. **topo_chaos** — the topology-enabled chaos tick (``sim/topology.py``
+   tier legs forced with a zero drop table) vs the flat chaos tick: the
+   id gathers + blocked one-hot tier expansion + extra coin sites run in
+   full but every coin passes, so the A/B must be BIT-EQUAL and the
+   overhead number prices the tier machinery itself on real ICI.
 1e. **mc_chaos** — the r12 batched chaos fleet: B=16 stacked-FaultPlan
    (churn×loss) scenarios stepped as ONE vmapped program vs the same 16
    stepped sequentially, both warm; sharded (batch replicated,
@@ -617,6 +622,94 @@ def main() -> None:
             )
     except Exception as e:  # pragma: no cover - hardware-dependent
         out.setdefault("chaos_tick", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
+    # -- 1d2: topo_chaos — the topology-enabled chaos tick vs the flat one --
+    # (sim/topology.py).  The same canonical smoke plan, once flat and once
+    # with the rack/zone/region tier legs FORCED with a zero drop table:
+    # the tier machinery (id gathers + blocked one-hot expansion + the
+    # extra coin sites) runs in full, but every coin passes — so the two
+    # runs must be BIT-EQUAL by the separate-coin construction, and the
+    # overhead number prices the tier evaluation itself.  Sharded over
+    # every visible chip when the window exposes >1 device (mirroring 1d).
+    try:
+        import functools as _ft
+
+        from ringpop_tpu.sim import chaos, topology
+
+        k = 256
+        flat_plan = chaos.scenario_plan("smoke", n, seed=0, horizon=4 * block)
+        topo = topology.default_topology(n)
+        topo_plan = chaos._merge_plans(flat_plan, topo.plan_legs(force=True))
+        # zero the table: bit-equality is the certificate; the penalized
+        # table would measure a DIFFERENT trajectory, not the machinery
+        topo_plan = topo_plan._replace(
+            tier_drop=jnp.zeros_like(topo_plan.tier_drop)
+        )
+        base_p = lifecycle.LifecycleParams(n=n, k=k, suspect_ticks=10, rng="counter")
+        sharded = len(jax.devices()) > 1 and out["platform"] != "cpu"
+        sec = {"n": n, "k": k, "block_ticks": block, "sharded": sharded,
+               "racks": topo.spec.total_racks}
+        out["topo_chaos"] = sec
+        if sharded:
+            from jax.sharding import Mesh
+
+            from ringpop_tpu.parallel.mesh import with_exchange_mesh
+
+            n_dev = len(jax.devices())
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            base_p = with_exchange_mesh(base_p, mesh)
+            sec["n_devices"] = n_dev
+            sec["mesh"] = f"{n_dev // rumor}x{rumor} (node x rumor)"
+
+            def mk_state():
+                return jax.tree.map(
+                    jax.device_put,
+                    lifecycle.init_state(base_p, seed=0),
+                    lifecycle.state_shardings(mesh, k=k),
+                )
+        else:
+            def mk_state():
+                return lifecycle.init_state(base_p, seed=0)
+
+        blk_fn = jax.jit(
+            _ft.partial(lifecycle._run_block, base_p), static_argnames="ticks"
+        )
+        finals = {}
+        for label, f in (("flat", flat_plan), ("topo", topo_plan)):
+            sstate = mk_state()
+            sstate = blk_fn(sstate, f, ticks=block)
+            jax.block_until_ready(sstate.learned)  # compile + warm
+            per_rep = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sstate = blk_fn(sstate, f, ticks=block)
+                jax.block_until_ready(sstate.learned)
+                per_rep.append(time.perf_counter() - t0)
+            finals[label] = sstate
+            sec[f"{label}_ms_per_tick_median"] = round(
+                sorted(per_rep)[len(per_rep) // 2] / block * 1e3, 3
+            )
+            flush()
+        sec["bit_equal"] = all(
+            bool((np.asarray(a) == np.asarray(b)).all())
+            for a, b in zip(
+                jax.tree_util.tree_leaves(finals["flat"]),
+                jax.tree_util.tree_leaves(finals["topo"]),
+            )
+        )
+        if sec.get("flat_ms_per_tick_median"):
+            sec["overhead_pct"] = round(
+                (sec["topo_ms_per_tick_median"] / sec["flat_ms_per_tick_median"] - 1)
+                * 100.0,
+                1,
+            )
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        out.setdefault("topo_chaos", {})["error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
 
     # -- 1e: mc_chaos — the r12 batched chaos fleet vs sequential B runs ----

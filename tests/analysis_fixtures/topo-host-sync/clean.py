@@ -1,0 +1,20 @@
+"""RPA103 clean (topology-plane shape): the tier lookup stays a pure
+elementwise function of the compiled device arrays — id gathers, a
+differing-level sum, and the blocked one-hot expansion over the static
+tier count (the real implementation's shape, ``delta.tier_pair_drop``)."""
+
+import jax
+import jax.numpy as jnp
+
+N_TIERS = 4
+
+
+@jax.jit
+def tier_pair_drop(tier_ids, tier_drop, a, b):
+    da = jnp.take(tier_ids, a, axis=-1)
+    db = jnp.take(tier_ids, b, axis=-1)
+    tier = (da != db).astype(jnp.int32).sum(axis=0)
+    drop = jnp.zeros(tier.shape, jnp.float32)
+    for t in range(N_TIERS):
+        drop = drop + jnp.where(tier == t, tier_drop[t], 0.0)
+    return drop

@@ -1,0 +1,16 @@
+"""RPA103 trip (topology-plane shape): a tier lookup that HOST-SYNCS —
+``.item()`` on the traced tier distance and a host-numpy coercion of the
+tier-id plane — turning the shard-local blocked one-hot evaluation into
+a per-leg device→host round-trip (or a trace-time error).  The topology
+compiler's one banned implementation shape (``sim/topology.py`` compiles
+host-side ONCE; the jitted step must never reach back)."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def tier_pair_drop(tier_ids, tier_drop, a, b):
+    ids = np.asarray(tier_ids)  # host-materializes the compiled id plane
+    tier = (ids[:, a] != ids[:, b]).sum()
+    return tier_drop[tier.item()]  # concretizes the traced tier

@@ -339,6 +339,35 @@ def test_snapshot_restore_mid_churn_window_resumes_bit_identically():
 # -- scorer -------------------------------------------------------------------
 
 
+def test_asym_refutations_attributed_to_unreachable_direction():
+    """The r10 asym scenario's false-positive refutes (309 at the full
+    SIMBENCH scale) happen at the UNREACHABLE side of the one-way window
+    — the minority the majority cannot send to, where false accusations
+    pile up and refute through the open direction.  The per-direction
+    split (telemetry.fetch attributing by the plan's static group×reach,
+    summed by score_blocks) must say exactly that: the split is the
+    total, and the reachable side carries ~none of it."""
+    n = 256
+    plan = chaos.scenario_plan("asym", n, seed=1, horizon=128)
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(n=n, k=32, seed=2, suspect_ticks=5,
+                                 rng="counter", telemetry=sink)
+    for _ in range(8):
+        sim.run(16, plan)
+    score = chaos.score_blocks(sink.records, plan, n=n, scenario="asym")
+    assert score["refutations"] > 0
+    assert (
+        score["refutations_unreachable_dir"] + score["refutations_reachable_dir"]
+        == score["refutations"]
+    )
+    # the one-way window's sink side owns the refutation load
+    assert score["refutations_unreachable_dir"] > score["refutations_reachable_dir"]
+    up_now = chaos.up_at_host(plan, 16, n)
+    assert not up_now.all()  # the rider crash cohort exists (true positives)
+    # the blocks carry the split too (fetch-level attribution)
+    assert all("refuted_unreachable_dir" in b for b in sink.records)
+
+
 def test_plan_events_timeline():
     plan = chaos.scenario_plan("smoke", 128, seed=0, horizon=96)
     events = chaos.plan_events(plan)

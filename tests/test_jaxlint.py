@@ -88,6 +88,18 @@ def test_chaos_host_sync_fixture_pair():
     assert not _lint_fixture("chaos-host-sync", "clean.py")
 
 
+def test_topo_host_sync_fixture_pair():
+    """The topology-plane alias directory (astlint.FIXTURE_SLUG_ALIASES):
+    a host-synced tier lookup — np coercion of the compiled id plane +
+    ``.item()`` on the traced tier — must trip RPA103, and the pure
+    elementwise blocked one-hot shape (the real ``delta.tier_pair_drop``
+    implementation) must be clean."""
+    found = _lint_fixture("topo-host-sync", "trip.py")
+    assert any(f.rule == "RPA103" for f in found), [f.render() for f in found]
+    assert {f.scope for f in found} == {"tier_pair_drop"}
+    assert not _lint_fixture("topo-host-sync", "clean.py")
+
+
 def test_host_sync_call_graph_closure():
     """RPA103 must flag host syncs in functions only REACHABLE from a jit
     root, not just directly decorated ones (the trip fixture's helper)."""

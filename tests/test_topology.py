@@ -1,0 +1,686 @@
+"""Topology-realistic fault overlays (sim/topology.py): the compiler,
+the tier-loss evaluation inside the jitted step, the traced suspicion
+timeout, per-tier telemetry/scoring, and the constant-topology identity
+contracts.
+
+The load-bearing pins:
+
+* a penalty-free tree compiles to NO tier legs and traces to the
+  IDENTICAL jaxpr as the flat fault-plan step (no golden recapture);
+* a 2-zone tree's partition compiles bit-identical to the hand-built
+  symmetric-partition FaultPlan;
+* zero-table tier legs (the stacked-fleet default) are bit-transparent
+  — a flat member in a topology fleet reproduces its solo run exactly;
+* the traced ``suspect_ticks`` leg at B=1 is bit-identical to the
+  static path, and batches the timeout axis through the fleet;
+* the per-tier suspicion split distinguishes a zone cut from the same
+  number of independent crashes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import chaos, delta, lifecycle, scenarios, telemetry, topology
+from ringpop_tpu.sim.chaos import FaultPlan
+from ringpop_tpu.sim.delta import N_TIERS, TIER_LEVELS
+from ringpop_tpu.sim.montecarlo import MonteCarlo
+from ringpop_tpu.sim.topology import TierLink, TopologySpec
+
+N, K = 128, 16
+PARAMS = dict(n=N, k=K, suspect_ticks=6, rng="counter")
+
+
+def _digest(state) -> int:
+    return int(telemetry.tree_digest(state))
+
+
+def _stepper(params):
+    return jax.jit(functools.partial(lifecycle.step, params))
+
+
+# -- the compiler -------------------------------------------------------------
+
+
+def test_compile_blocked_contiguous_ids():
+    topo = topology.compile_topology(
+        TopologySpec(regions=2, zones_per_region=2, racks_per_zone=2), N
+    )
+    rack, zone, region = topo.tier_ids
+    assert topo.tier_ids.shape == (TIER_LEVELS, N)
+    assert topo.tier_ids.dtype == np.int32
+    # contiguous equal blocks per level, global ids
+    assert np.all(np.diff(rack) >= 0) and len(np.unique(rack)) == 8
+    assert np.all(np.diff(zone) >= 0) and len(np.unique(zone)) == 4
+    assert np.all(np.diff(region) >= 0) and len(np.unique(region)) == 2
+    # the tree property: same rack => same zone => same region
+    for r in range(8):
+        nodes = topo.nodes_in_rack(r)
+        assert len(np.unique(zone[nodes])) == 1
+        assert len(np.unique(region[nodes])) == 1
+    # equal blocks at this divisible size
+    assert all(topo.nodes_in_rack(r).size == N // 8 for r in range(8))
+
+
+def test_tier_table_monotone_and_models_late_acks():
+    spec = TopologySpec(
+        regions=2, zones_per_region=2, racks_per_zone=2,
+        rack_link=TierLink(rtt_ms=0.2, loss=0.0),
+        zone_link=TierLink(rtt_ms=2.0, loss=0.005),
+        region_link=TierLink(rtt_ms=60.0, loss=0.02),
+        probe_timeout_ms=400.0,
+    )
+    topo = topology.compile_topology(spec, N)
+    table = topo.tier_drop.astype(np.float64)
+    assert table[0] == 0.0  # same rack pays nothing
+    assert np.all(np.diff(table) >= 0)  # more boundaries, more loss
+    # cross-region pays the WAN loss (2 traversals) AND the late-ack tail
+    loss_only = 1.0 - (1 - 0.005) ** 2 * (1 - 0.02) ** 2
+    assert table[3] > loss_only
+    # the late-ack model itself
+    assert topology.late_ack_prob(0.0, 400.0) == 0.0
+    assert 0.0 < topology.late_ack_prob(100.0, 400.0) < 0.05
+    assert topology.late_ack_prob(1e9, 400.0) > 0.99
+
+
+def test_tier_of_pair_host_mirror_matches_device():
+    topo = topology.default_topology(N)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, N, size=64).astype(np.int32)
+    b = rng.integers(0, N, size=64).astype(np.int32)
+    faults = delta.DeltaFaults(
+        tier_ids=jnp.asarray(topo.tier_ids), tier_drop=jnp.asarray(topo.tier_drop)
+    )
+    dev = np.asarray(delta.tier_pair(faults, jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(dev, topo.tier_of_pair(a, b))
+    # and the one-hot table expansion
+    drop = np.asarray(delta.tier_pair_drop(faults, jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(drop, topo.tier_drop[topo.tier_of_pair(a, b)])
+
+
+def test_compile_refuses_bad_specs():
+    with pytest.raises(ValueError, match="empty racks"):
+        topology.compile_topology(
+            TopologySpec(regions=4, zones_per_region=4, racks_per_zone=4), 32
+        )
+    with pytest.raises(ValueError, match="loss"):
+        topology.compile_topology(
+            TopologySpec(zone_link=TierLink(loss=1.5)), N
+        )
+    with pytest.raises(ValueError, match="rtt_ms"):
+        topology.compile_topology(
+            TopologySpec(zone_link=TierLink(rtt_ms=-1.0)), N
+        )
+
+
+# -- the identity contracts ---------------------------------------------------
+
+
+def test_constant_topology_traces_identical_jaxpr():
+    """A penalty-free tree emits NO tier legs, so its scenario traces to
+    the IDENTICAL jaxpr as the hand-built flat fault-plan step — the
+    acceptance-bar identity (no golden recapture)."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    state = lifecycle.init_state(params, seed=0)
+    flat_topo = topology.compile_topology(
+        TopologySpec(regions=2, zones_per_region=2, racks_per_zone=2), N
+    )
+    assert not flat_topo.has_penalties()
+    assert all(v is None for v in flat_topo.plan_legs())
+    const_plan = topology.topo_scenario_plan("flat", N, seed=0, horizon=64)
+    hand_plan = topology.zone_loss_plan(flat_topo, zone=1, at=2, heal=32)
+    ja = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, const_plan)
+    # different window constants are still the same jaxpr STRUCTURE; use
+    # the same schedule for literal string identity
+    hand_same = topology.zone_loss_plan(
+        flat_topo, zone=1, at=max(4, 64 // 32), heal=32
+    )
+    jb = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, hand_same)
+    assert str(ja) == str(jb)
+    # delta engine too
+    dparams = delta.DeltaParams(n=N, k=K, rng="counter")
+    dstate = delta.init_state(dparams, seed=0)
+    da = jax.make_jaxpr(lambda s, p: delta.step(dparams, s, p))(dstate, const_plan)
+    db = jax.make_jaxpr(lambda s, p: delta.step(dparams, s, p))(dstate, hand_same)
+    assert str(da) == str(db)
+
+
+def test_two_zone_tree_partition_equals_hand_built_plan():
+    """The topology-equivalence pin: a 2-zone tree with no inter-tier
+    penalties compiles its zone partition to a plan bit-identical to the
+    hand-built symmetric-partition FaultPlan."""
+    topo = topology.compile_topology(
+        TopologySpec(regions=1, zones_per_region=2, racks_per_zone=1), N
+    )
+    got = topology.partition_plan(
+        topo, level="zone", cut=(1,), split_at=8, heal_at=64
+    )
+    group = np.zeros(N, np.int32)
+    group[N // 2:] = 1
+    want = FaultPlan(
+        group=jnp.asarray(group),
+        part_from=jnp.asarray(np.int32(8)),
+        part_until=jnp.asarray(np.int32(64)),
+    )
+    for field in FaultPlan._fields:
+        g, w = getattr(got, field), getattr(want, field)
+        assert (g is None) == (w is None), field
+        if g is not None:
+            assert g.dtype == w.dtype, field
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=field)
+
+
+def test_zero_table_tier_legs_are_bit_transparent():
+    """Tier legs PRESENT with an all-zero table (``plan_legs(force=True)``
+    — the stacked-fleet default shape) must be value-transparent: the
+    tier coin is its own draw site, so the trajectory is bit-identical
+    to the leg-free run."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    flat_topo = topology.compile_topology(
+        TopologySpec(regions=2, zones_per_region=2, racks_per_zone=2), N
+    )
+    base = topology.zone_loss_plan(flat_topo, zone=1, at=4, heal=32)
+    with_legs = chaos._merge_plans(base, flat_topo.plan_legs(force=True))
+    assert with_legs.tier_ids is not None
+    st = _stepper(params)
+    s1 = s2 = lifecycle.init_state(params, seed=3)
+    for _ in range(40):
+        s1 = st(s1, base)
+        s2 = st(s2, with_legs)
+    assert _digest(s1) == _digest(s2)
+
+
+def test_penalized_tiers_actually_drop_cross_boundary_legs():
+    """A saturated cross-zone tier (drop 1.0) must sever every
+    cross-zone exchange while same-zone traffic flows — checked through
+    the delta engine's coverage: rumors seeded in zone 0 never reach
+    zone 1."""
+    topo = topology.compile_topology(
+        TopologySpec(regions=1, zones_per_region=2, racks_per_zone=1), N
+    )
+    plan = FaultPlan(
+        tier_ids=jnp.asarray(topo.tier_ids),
+        tier_drop=jnp.asarray(np.asarray([0.0, 0.0, 1.0, 1.0], np.float32)),
+    )
+    params = delta.DeltaParams(n=N, k=K, rng="counter")
+    # all K rumors seeded in zone 0 (nodes 0..N/2)
+    state = delta.init_state(params, seed=0, sources=np.arange(K) % (N // 2))
+    step = jax.jit(functools.partial(delta.step, params))
+    for _ in range(64):
+        state = step(state, plan)
+    learned = np.asarray(
+        (state.learned[:, 0] != 0)  # K=16 fits one word: any bit learned
+    )
+    assert learned[: N // 2].all(), "same-zone dissemination must complete"
+    assert not learned[N // 2:].any(), "a 1.0 cross-zone tier must sever the zones"
+    # heal the tier: coverage completes
+    healed = plan._replace(tier_drop=jnp.zeros(N_TIERS, jnp.float32))
+    for _ in range(64):
+        state = step(state, healed)
+    assert float(delta.converged_fraction(state)) == 1.0
+
+
+def test_tier_legs_refuse_threefry():
+    params = lifecycle.LifecycleParams(n=N, k=K, suspect_ticks=6)  # threefry
+    topo = topology.default_topology(N)
+    plan = topo.plan_legs(force=True)
+    with pytest.raises(ValueError, match="counter"):
+        lifecycle.step(params, lifecycle.init_state(params, seed=0), plan)
+    dparams = delta.DeltaParams(n=N, k=K)
+    with pytest.raises(ValueError, match="counter"):
+        delta.step(dparams, delta.init_state(dparams, seed=0), plan)
+
+
+def test_unpaired_tier_legs_refused():
+    topo = topology.default_topology(N)
+    with pytest.raises(ValueError, match="pair"):
+        chaos.validate_plan(FaultPlan(tier_ids=jnp.asarray(topo.tier_ids)))
+    params = lifecycle.LifecycleParams(**PARAMS)
+    with pytest.raises(ValueError, match="pair"):
+        lifecycle.step(
+            params,
+            lifecycle.init_state(params, seed=0),
+            delta.DeltaFaults(tier_drop=jnp.zeros(N_TIERS, jnp.float32)),
+        )
+
+
+def test_fullview_and_multihost_refuse_topology_legs():
+    from ringpop_tpu.sim.fullview import as_fullview_faults
+
+    topo = topology.default_topology(N)
+    legs = topo.plan_legs(force=True)
+    faults = chaos.faults_at(legs, jnp.int32(0))
+    with pytest.raises(ValueError, match="topology"):
+        as_fullview_faults(faults)
+    with pytest.raises(ValueError, match="fullview"):
+        as_fullview_faults(delta.DeltaFaults(suspect_ticks=jnp.asarray(5, jnp.int32)))
+
+    from ringpop_tpu.sim.delta_multihost import _check_supported
+
+    dparams = delta.DeltaParams(n=N, k=K, rng="counter")
+    with pytest.raises(NotImplementedError, match="mesh path"):
+        _check_supported(dparams, faults)
+
+
+# -- the traced suspicion timeout (satellite 1) -------------------------------
+
+
+def test_traced_suspect_ticks_bit_identical_to_static_at_b1():
+    """The leg carrying the SAME value as the param, and the -1
+    sentinel, must both reproduce the static path bit-for-bit; a
+    different value must genuinely move the trajectory."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    up = np.ones(N, bool)
+    up[[3, 9]] = False
+    base = FaultPlan(base_up=jnp.asarray(up))
+    same = chaos._merge_plans(
+        base, FaultPlan(suspect_ticks=jnp.asarray(params.suspect_ticks, jnp.int32))
+    )
+    sentinel = chaos._merge_plans(
+        base, FaultPlan(suspect_ticks=jnp.asarray(-1, jnp.int32))
+    )
+    longer = chaos._merge_plans(
+        base, FaultPlan(suspect_ticks=jnp.asarray(20, jnp.int32))
+    )
+    st = _stepper(params)
+    s0 = s1 = s2 = s3 = lifecycle.init_state(params, seed=1)
+    for _ in range(40):
+        s0 = st(s0, base)
+        s1 = st(s1, same)
+        s2 = st(s2, sentinel)
+        s3 = st(s3, longer)
+    assert _digest(s0) == _digest(s1) == _digest(s2)
+    assert _digest(s0) != _digest(s3)
+    # None leg traces to the IDENTICAL static jaxpr
+    state = lifecycle.init_state(params, seed=1)
+    ja = jax.make_jaxpr(lambda s, p: lifecycle.step(params, s, p))(state, base)
+    jb = jax.make_jaxpr(
+        lambda s, p: lifecycle.step(params, s, p)
+    )(state, FaultPlan(base_up=jnp.asarray(up)))
+    assert str(ja) == str(jb)
+
+
+def test_suspect_ticks_batches_through_the_fleet():
+    """The suspects= grid axis: one compiled program, per-member traced
+    timeouts — each member bit-identical to the solo static-param run
+    (the sweep_static baseline it replaces)."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=[0], losses=(0.0,), suspects=(4, 12),
+        churn_seed=1,
+    )
+    assert [m["suspect"] for m in meta] == [4, 12]
+    assert chaos.plan_batch_size(plan) == 2
+    seeds = scenarios.grid_seeds(meta, 0)
+    mc = MonteCarlo(params, seeds)
+    mc.run(48, plan)
+    for b, suspect in enumerate((4, 12)):
+        solo = lifecycle.LifecycleSim(
+            n=N, k=K, seed=seeds[b], suspect_ticks=suspect, rng="counter"
+        )
+        solo.run(48, chaos.index_plan(plan, b))
+        for field in solo.state._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(mc.states, field))[b],
+                np.asarray(getattr(solo.state, field)),
+                err_msg=f"b={b} {field}",
+            )
+
+
+def test_suspect_ticks_validation():
+    with pytest.raises(ValueError, match="suspect_ticks"):
+        chaos.validate_plan(FaultPlan(suspect_ticks=jnp.asarray(0, jnp.int32)))
+    with pytest.raises(ValueError, match="suspect_ticks"):
+        chaos.validate_plan(FaultPlan(suspect_ticks=jnp.asarray(-3, jnp.int32)))
+    chaos.validate_plan(FaultPlan(suspect_ticks=jnp.asarray(-1, jnp.int32)))
+    chaos.validate_plan(FaultPlan(suspect_ticks=jnp.asarray(25, jnp.int32)))
+
+
+# -- plan validation hardening (satellite 2) ----------------------------------
+
+
+def test_validate_plan_group_range_vs_reach():
+    group = np.zeros(N, np.int32)
+    group[:4] = 2  # id 2 out of range for a [2, 2] reach
+    with pytest.raises(ValueError, match="out of range"):
+        chaos.validate_plan(
+            FaultPlan(
+                group=jnp.asarray(group),
+                reach=jnp.asarray(np.eye(2, dtype=bool)),
+            )
+        )
+    # builders route through it too
+    with pytest.raises(ValueError, match="out of range"):
+        chaos._merge_plans(
+            FaultPlan(group=jnp.asarray(group)),
+            FaultPlan(reach=jnp.asarray(np.eye(2, dtype=bool))),
+        )
+    with pytest.raises(ValueError, match="out of range"):
+        chaos.stack_plans(
+            [FaultPlan(
+                group=jnp.asarray(group),
+                reach=jnp.asarray(np.eye(2, dtype=bool)),
+            )]
+        )
+
+
+def test_validate_plan_reach_shape_and_dtype():
+    with pytest.raises(ValueError, match="square"):
+        chaos.validate_plan(FaultPlan(reach=jnp.asarray(np.ones((2, 3), bool))))
+    with pytest.raises(ValueError, match="boolean"):
+        chaos.validate_plan(FaultPlan(reach=jnp.asarray(np.eye(2, dtype=np.float32))))
+    with pytest.raises(ValueError, match=">= -1"):
+        chaos.validate_plan(FaultPlan(group=jnp.asarray(np.full(N, -2, np.int32))))
+    # a in-range directed plan passes
+    chaos.validate_plan(chaos.asym_partition_plan(N))
+
+
+def test_validate_plan_tier_shapes():
+    topo = topology.default_topology(N)
+    with pytest.raises(ValueError, match="hierarchy"):
+        chaos.validate_plan(
+            FaultPlan(
+                tier_ids=jnp.asarray(topo.tier_ids[:2]),
+                tier_drop=jnp.asarray(topo.tier_drop),
+            )
+        )
+    with pytest.raises(ValueError, match="per tier"):
+        chaos.validate_plan(
+            FaultPlan(
+                tier_ids=jnp.asarray(topo.tier_ids),
+                tier_drop=jnp.zeros(3, jnp.float32),
+            )
+        )
+    with pytest.raises(ValueError, match="probabilities"):
+        chaos.validate_plan(
+            FaultPlan(
+                tier_ids=jnp.asarray(topo.tier_ids),
+                tier_drop=jnp.full(N_TIERS, 1.5, jnp.float32),
+            )
+        )
+
+
+# -- stacking through the fleet -----------------------------------------------
+
+
+def test_flat_member_in_topology_fleet_reproduces_solo():
+    """The key stacked-default property: a member WITHOUT topology legs,
+    stacked next to a penalized topology member, materializes zero-table
+    legs — and must still reproduce its solo trajectory bit-for-bit."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    lean = chaos.churn_plan(N, n_churn=4, n_permanent=2, first=4, waves=2, seed=3)
+    rich = topology.topo_scenario_plan("zone_loss", N, horizon=64)
+    stacked = chaos.stack_plans([lean, rich])
+    assert stacked.tier_ids is not None  # materialized for both members
+    np.testing.assert_array_equal(
+        np.asarray(stacked.tier_drop[0]), np.zeros(N_TIERS, np.float32)
+    )
+    mc = MonteCarlo(params, [5, 6])
+    mc.run(24, stacked)
+    solo = lifecycle.LifecycleSim(seed=5, **PARAMS)
+    solo.run(24, lean)
+    for field in solo.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(mc.states, field))[0],
+            np.asarray(getattr(solo.state, field)),
+            err_msg=field,
+        )
+
+
+def test_topology_member_b1_identical_to_solo():
+    params = lifecycle.LifecycleParams(**PARAMS)
+    plan = topology.topo_scenario_plan("smoke", N, seed=0, horizon=64)
+    mc = MonteCarlo(params, [7])
+    mc.run(32, chaos.stack_plans([plan]))
+    solo = lifecycle.LifecycleSim(seed=7, **PARAMS)
+    solo.run(32, plan)
+    assert _digest(jax.tree.map(lambda x: x[0], mc.states)) == _digest(solo.state)
+
+
+# -- per-tier telemetry + scoring (the acceptance split) ----------------------
+
+
+def test_per_tier_counters_match_host_expectation():
+    """With ONLY a saturated cross-region tier and every node up, all
+    suspicion flow is (a) false-positive by plan truth and (b) strictly
+    cross-region — the counters must land in exactly that bucket."""
+    topo = topology.compile_topology(
+        TopologySpec(regions=2, zones_per_region=1, racks_per_zone=1), N
+    )
+    plan = FaultPlan(
+        tier_ids=jnp.asarray(topo.tier_ids),
+        tier_drop=jnp.asarray(np.asarray([0.0, 0.0, 0.0, 0.9], np.float32)),
+    )
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(
+        seed=0, telemetry=sink, telemetry_tiers=True, **PARAMS
+    )
+    for _ in range(4):
+        sim.run(16, plan)
+    recs = sink.records
+    total = {
+        key: sum(r[f"suspects_{key}"] for r in recs) for key in telemetry.TIER_KEYS
+    }
+    false_total = {
+        key: sum(r[f"false_suspects_{key}"] for r in recs)
+        for key in telemetry.TIER_KEYS
+    }
+    assert total["cross_region"] > 0, "a 0.9 WAN tier must raise suspicions"
+    assert total["same_rack"] == total["cross_rack"] == total["cross_zone"] == 0
+    # every node is up, so every declaration is a false positive
+    assert false_total == total
+    # and the score record carries the split
+    score = chaos.score_blocks(recs, plan, n=N, scenario="t")
+    assert score["suspects_by_tier"]["cross_region"] == total["cross_region"]
+    assert score["false_positive_by_tier"] == {
+        k: int(v) for k, v in false_total.items()
+    }
+
+
+def test_tiers_unarmed_means_no_tier_keys():
+    plan = topology.topo_scenario_plan("zone_loss", N, horizon=64)
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(seed=0, telemetry=sink, **PARAMS)  # unarmed
+    sim.run(16, plan)
+    assert "suspects_same_rack" not in sink.records[0]
+    score = chaos.score_blocks(sink.records, plan, n=N, scenario="t")
+    assert "suspects_by_tier" not in score
+
+
+def test_zone_loss_distinguished_from_independent_crashes():
+    """The acceptance discriminator at test scale: a zone cut's
+    suspicion flow has NO near-tier (same-rack/cross-rack) component —
+    its same-zone observers are dead — while the same number of
+    independent crashes draws near-tier suspicion."""
+    n = 256
+    topo = topology.default_topology(n)
+    horizon = 128
+    plans = [
+        chaos._merge_plans(
+            topology.zone_loss_plan(topo, 1, at=4, heal=horizon // 2),
+            topo.plan_legs(),
+        ),
+        chaos._merge_plans(
+            topology.independent_crash_plan(
+                topo, int(topo.nodes_in_zone(1).size), at=4, heal=horizon // 2,
+                seed=0,
+            ),
+            topo.plan_legs(),
+        ),
+    ]
+    meta = [
+        {"scenario_id": 0, "event": "zone_loss"},
+        {"scenario_id": 1, "event": "independent"},
+    ]
+    params = lifecycle.LifecycleParams(n=n, k=32, suspect_ticks=8, rng="counter")
+    scores = scenarios.scored_fleet(
+        params, chaos.stack_plans(plans), meta, [0, 1], horizon=horizon,
+        journal_every=16, scenario="topo_test",
+    )
+    for s in scores:
+        assert isinstance(s["suspects_by_tier"], dict)
+        assert isinstance(s["time_to_detect_by_tier"], dict)
+
+    def near(s):
+        by_tier = s["suspects_by_tier"]
+        return by_tier["same_rack"] + by_tier["cross_rack"]
+
+    def total(s):
+        return sum(s["suspects_by_tier"].values())
+
+    assert total(scores[0]) > 0 and total(scores[1]) > 0
+    zone_share = near(scores[0]) / total(scores[0])
+    ind_share = near(scores[1]) / total(scores[1])
+    assert ind_share > zone_share, (zone_share, ind_share)
+    assert zone_share == 0.0, "a zone cut has no live near-tier accusers"
+
+
+def test_wan_oneway_refutations_attributed_to_unreachable_direction():
+    """The topology WAN builder rides the asym reach semantics: the cut
+    region is unreachable from outside, so its (false) accusations
+    refute there — the per-direction split must say so."""
+    n = 256
+    plan = topology.topo_scenario_plan("wan", n, seed=1, horizon=128)
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(
+        n=n, k=32, seed=2, suspect_ticks=5, rng="counter", telemetry=sink,
+        telemetry_tiers=True,
+    )
+    for _ in range(8):
+        sim.run(16, plan)
+    score = chaos.score_blocks(sink.records, plan, n=n, scenario="wan")
+    assert score["refutations"] > 0, "the one-way window must generate refutes"
+    assert (
+        score["refutations_unreachable_dir"] + score["refutations_reachable_dir"]
+        == score["refutations"]
+    )
+    assert score["refutations_unreachable_dir"] > score["refutations_reachable_dir"]
+
+
+def test_symmetric_member_in_directed_fleet_reports_no_direction():
+    """The stacked identity-reach default is MUTUAL blockage — a
+    symmetric partition has no unreachable direction, so a symmetric
+    member stacked next to a one-way member must report
+    refuted_unreachable_dir == 0 (every refutation lands in the
+    reachable bucket), not claim a direction it doesn't have."""
+    n = 256
+    topo = topology.default_topology(n)
+    sym = chaos._merge_plans(
+        topology.partition_plan(topo, level="region", cut=(1,), split_at=4,
+                                heal_at=48),
+        chaos.churn_plan(n, n_churn=4, n_permanent=0, first=2, stagger=1,
+                         waves=1, down_ticks=16, seed=1),
+    )
+    oneway = topology.partition_plan(
+        topo, level="region", cut=(1,), split_at=4, heal_at=48, one_way=True
+    )
+    stacked = chaos.stack_plans([sym, oneway])
+    params = lifecycle.LifecycleParams(n=n, k=32, suspect_ticks=5, rng="counter")
+    mc = MonteCarlo(params, [0, 1], telemetry=True)
+    recs = []
+    for _ in range(6):
+        mc.run(16, stacked)
+        recs.extend(mc.fetch_telemetry(stacked))
+    sym_blocks = [r for r in recs if r["scenario_id"] == 0]
+    ow_blocks = [r for r in recs if r["scenario_id"] == 1]
+    assert all(r["refuted_unreachable_dir"] == 0 for r in sym_blocks)
+    assert sum(r["refuted_reachable_dir"] for r in sym_blocks) > 0
+    # the one-way member still attributes to its sink side
+    assert sum(r["refuted_unreachable_dir"] for r in ow_blocks) > 0
+
+
+def test_emit_topo_stats_gauges():
+    class Rec:
+        def __init__(self):
+            self.gauges = {}
+
+        def gauge(self, key, value):
+            self.gauges[key] = value
+
+    score = {
+        "suspects_by_tier": {"same_rack": 0, "cross_zone": 5},
+        "false_positive_by_tier": {"cross_zone": 2},
+        "time_to_detect_by_tier": {"cross_zone": 16, "same_rack": None},
+        "refutations_unreachable_dir": 7,
+    }
+    rec = Rec()
+    topology.emit_topo_stats(rec, score)
+    assert rec.gauges["ringpop.sim.topo.suspects.cross-zone"] == 5.0
+    assert rec.gauges["ringpop.sim.topo.false-positives.cross-zone"] == 2.0
+    assert rec.gauges["ringpop.sim.topo.time-to-detect.cross-zone"] == 16.0
+    assert rec.gauges["ringpop.sim.topo.refuted.unreachable-dir"] == 7.0
+    assert "ringpop.sim.topo.time-to-detect.same-rack" not in rec.gauges
+
+
+# -- scenario builders --------------------------------------------------------
+
+
+def test_correlated_builders_shapes():
+    topo = topology.default_topology(N)
+    zl = topology.zone_loss_plan(topo, 1, at=8, heal=32)
+    nodes = topo.nodes_in_zone(1)
+    crash = np.asarray(zl.crash_tick)
+    assert (crash[nodes] == 8).all()
+    assert (crash[np.setdiff1d(np.arange(N), nodes)] == chaos.NO_TICK).all()
+    # switch flap: ONE unit — identical period AND phase behind the switch
+    sf = topology.switch_flap_plan(topo, 2, period=24, down=6, start=8)
+    rnodes = topo.nodes_in_rack(2)
+    assert len(np.unique(np.asarray(sf.flap_phase)[rnodes])) == 1
+    assert (np.asarray(sf.flap_period)[rnodes] == 24).all()
+    # first down window opens at start
+    up9 = chaos.up_at_host(sf, 7, N)
+    up8 = chaos.up_at_host(sf, 8, N)
+    assert up9[rnodes].all() and not up8[rnodes].any()
+    # partition builder refuses nonsense
+    with pytest.raises(ValueError, match="do not exist"):
+        topology.partition_plan(topo, level="zone", cut=(99,))
+    with pytest.raises(ValueError, match="nothing"):
+        topology.partition_plan(topo, level="region", cut=(0, 1))
+    with pytest.raises(ValueError, match="level"):
+        topology.partition_plan(topo, level="pod", cut=(0,))
+    with pytest.raises(ValueError, match="does not exist"):
+        topology.zone_loss_plan(topo, 99)
+
+
+def test_topo_scenario_specs_family():
+    topo = topology.default_topology(N)
+    plans, meta = topology.topo_scenario_specs(topo, seed=0, horizon=128, reps=2)
+    assert len(plans) == len(meta) == 2 * (4 + 8 + 2 + 4)
+    events = {m["event"] for m in meta}
+    assert events == {"zone_loss", "switch_flap", "wan", "wan_oneway", "independent"}
+    # stacks cleanly (the fleet shape)
+    stacked = chaos.stack_plans(plans)
+    assert chaos.plan_batch_size(stacked) == len(plans)
+    # every member carries the tier legs (the default tree is penalized)
+    assert stacked.tier_ids is not None and stacked.tier_drop is not None
+
+
+def test_scenario_grid_overlay_axis():
+    topo = topology.default_topology(N)
+    overlay = chaos._merge_plans(
+        topology.zone_loss_plan(topo, 1, at=4, heal=32), topo.plan_legs()
+    )
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3], doses=[0, 2], losses=(0.0,),
+        overlays=(("none", None), ("zone_loss", overlay)), churn_seed=1,
+    )
+    assert chaos.plan_batch_size(plan) == 4
+    assert [m["overlay"] for m in meta] == ["none", "none", "zone_loss", "zone_loss"]
+    # overlay members carry the topology legs; the stacked default zeros
+    # the others
+    np.testing.assert_array_equal(
+        np.asarray(plan.tier_drop[0]), np.zeros(N_TIERS, np.float32)
+    )
+    assert float(np.asarray(plan.tier_drop[2]).max()) > 0
+    # a colliding overlay (partition vs parts>0) is refused loudly
+    with pytest.raises(ValueError, match="more than one plan"):
+        scenarios.scenario_grid(
+            N, victims=[3], doses=[0], parts=(0.5,),
+            overlays=(("wan", topology.partition_plan(topo, level="region", cut=(1,))),),
+            churn_seed=1,
+        )
