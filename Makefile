@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
@@ -52,6 +52,15 @@ topo-smoke:
 # must round-trip, and the response surface must match a solo probe.
 mc-smoke:
 	$(PY) scripts/mc_smoke.py
+
+# scenario-fleet gate (r19): tiny grid through cli/fleet_bench — P=1
+# unbroken == P=2 with a MID-SWEEP orbax fleet checkpoint (each rank
+# writes only its shards, run continues) == P=1 restore of the P=2
+# checkpoint (a DIFFERENT process count), per-scenario digests + score
+# records bit-exact; plus the adaptive cliff driver finding the dense
+# 1-dose grid's cliff coordinate at strictly fewer scenario-evals.
+fleet-smoke:
+	$(PY) scripts/fleet_smoke.py
 
 # serve-the-ring gate (serve/): tiny 2-frontend shared-memory A/B —
 # owner digests serve==bisect per (worker, rep), generation-pinned

@@ -1,0 +1,186 @@
+"""One rank of a scenario-fleet certification run (r19).
+
+Spawned by ``scripts/multihost_launch.py`` (simbench ``fleet_scale``,
+``make fleet-smoke``, the test suite): reads the standard
+``jax.distributed`` env contract, slices the deterministic scenario grid
+by ``partition.process_block`` over its batch axis, runs its slice as a
+``scenarios.FleetSweep``, and emits JSONL records to ``MULTIHOST_JSONL``.
+
+Legs::
+
+    sweep          — scored sweep over this rank's batch slice to the
+                     horizon; emits per-scenario state digests + score
+                     verdicts + peak RSS.  ``--save-at T --path D``
+                     additionally checkpoints the whole fleet carry at
+                     tick T (each process writing only its shards) and
+                     CONTINUES — certifying that a mid-sweep save does
+                     not perturb the run.
+    sweep-restore  — restore the checkpoint AT THIS PROCESS COUNT (need
+                     not match the saver's), continue to the horizon,
+                     emit the same digests/scores record — the
+                     kill-and-restore certificate.
+
+The grid is a pure function of (n, k, doses, losses, seed), so every
+process count constructs the identical B scenarios and any slicing of
+them is bit-exact per scenario (``chaos.slice_plan``).  Works
+single-process too (no coordinator env → plain local run), which is what
+makes the P=1 unbroken run the SAME code path as P=2/4.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+
+def _emit(rec: dict) -> None:
+    path = os.environ.get("MULTIHOST_JSONL")
+    line = json.dumps(rec)
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        # stdout gets a SUMMARY only: the full record is ~0.5 MB at fleet
+        # scale (2048 digests + score records), and the launcher reads
+        # records from the JSONL file anyway — an un-drained 64 KB stdout
+        # pipe must never be able to block a rank's exit
+        line = json.dumps({
+            k: rec.get(k)
+            for k in ("kind", "b", "b_local", "lo", "hi", "ticks_done",
+                      "wall_s", "peak_rss_mb", "process_id", "saved_at")
+            if k in rec
+        })
+    print(line, flush=True)
+
+
+def _peak_rss_mb() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
+def build_grid(args):
+    """The deterministic grid every rank (and every process count)
+    reconstructs identically: victims drawn like the mc_chaos scenario,
+    the shared churn-dose ladder, loss rows, ``grid_seeds`` pairing."""
+    import numpy as np
+
+    from ringpop_tpu.sim import scenarios
+
+    rng = np.random.default_rng(args.seed)
+    victims = sorted(rng.choice(args.n, size=4, replace=False).tolist())
+    doses = scenarios.mc_churn_doses(args.b_doses, args.churn_max or args.n // 32)
+    losses = tuple(float(x) for x in args.losses.split(","))
+    plan, meta = scenarios.scenario_grid(
+        args.n, victims=victims, doses=doses, losses=losses,
+        churn_seed=args.seed + 777,
+    )
+    seeds = scenarios.grid_seeds(meta, args.seed)
+    return victims, plan, meta, seeds
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fleet_bench", description=__doc__)
+    p.add_argument("leg", choices=["sweep", "sweep-restore"])
+    p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--k", type=int, default=64)
+    p.add_argument("--b-doses", type=int, default=32)
+    p.add_argument("--losses", default="0.0,0.1")
+    p.add_argument("--churn-max", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--suspect-ticks", type=int, default=10)
+    p.add_argument("--horizon", type=int, default=32)
+    p.add_argument("--journal-every", type=int, default=16)
+    p.add_argument("--save-at", type=int, default=0,
+                   help="sweep leg: checkpoint the carry at this tick "
+                   "(a journal block boundary), then continue")
+    p.add_argument("--path", default=None, help="fleet checkpoint dir")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ringpop_tpu.parallel.multihost import init_distributed
+
+    # distributed bring-up FIRST: the compile-cache probe runs a jax
+    # computation, which jax.distributed.initialize refuses to follow
+    distributed = init_distributed()
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    configure_compile_cache()
+    nprocs = jax.process_count() if distributed else 1
+    rank = jax.process_index() if distributed else 0
+
+    from ringpop_tpu.parallel.partition import process_block
+    from ringpop_tpu.sim import chaos, scenarios
+    from ringpop_tpu.sim.lifecycle import LifecycleParams
+
+    params = LifecycleParams(
+        n=args.n, k=args.k, suspect_ticks=args.suspect_ticks, rng="counter"
+    )
+    victims, plan, meta, seeds = build_grid(args)
+    b = len(meta)
+    lo, hi = process_block(b, rank, nprocs) if nprocs > 1 else (0, b)
+    plan_s = chaos.slice_plan(plan, lo, hi)
+    meta_s, seeds_s = meta[lo:hi], seeds[lo:hi]
+
+    t0 = time.perf_counter()
+    if args.leg == "sweep":
+        sweep = scenarios.FleetSweep(
+            params, plan_s, meta_s, seeds_s, horizon=args.horizon,
+            journal_every=args.journal_every, scenario="fleet_scale",
+            global_b=b,
+        )
+        save_s = None
+        if args.save_at:
+            sweep.run(until_tick=args.save_at)
+            ts = time.perf_counter()
+            sweep.save(args.path)
+            save_s = round(time.perf_counter() - ts, 3)
+        sweep.run()
+    else:
+        sweep = scenarios.FleetSweep.restore(
+            args.path, params, plan_s, meta_s, seeds_s,
+            scenario="fleet_scale", global_b=b,
+        )
+        sweep.run()
+    rec = {
+        "kind": args.leg,
+        "n": args.n,
+        "k": args.k,
+        "b": b,
+        "b_local": len(meta_s),
+        "lo": lo,
+        "hi": hi,
+        "horizon": args.horizon,
+        "ticks_done": sweep.ticks_done,
+        "victims": victims,
+        "digests": {str(k_): v for k_, v in sweep.digests().items()},
+        "scores": sweep.scores(),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "peak_rss_mb": _peak_rss_mb(),
+        "process_count": nprocs,
+        "process_id": rank,
+        **sweep.header_params(),
+    }
+    if args.leg == "sweep" and args.save_at:
+        rec["saved_at"] = args.save_at
+        rec["save_s"] = save_s
+    _emit(rec)
+    if distributed and nprocs > 1:
+        # explicit exit barrier through the coordination-service client
+        # (plain gRPC, the same channel _orbax_mp_options routes orbax's
+        # barriers through): rank slices can finish far apart (per-rank
+        # host-side scoring on shared cores), and jax.distributed's own
+        # shutdown barrier is short — an early rank would SIGABRT at
+        # exit AFTER all its work succeeded
+        from jax._src import distributed as _dist
+
+        client = _dist.global_state.client
+        if client is not None:
+            client.wait_at_barrier("fleet_bench_exit", 3600 * 1000)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

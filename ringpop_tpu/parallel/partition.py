@@ -109,31 +109,39 @@ def spec_for(name: str) -> P:
     return P()
 
 
-def partition_spec(tree, batch_axes: int = 0):
+def partition_spec(tree, batch_axes: int = 0, batch_axis: Optional[str] = None):
     """Pytree of PartitionSpec, one per leaf, from the canonical table.
 
-    ``batch_axes`` prepends that many unsharded (None) axes to EVERY leaf
-    spec — the Monte-Carlo fleet's ``[B, ...]`` replica batch (scenarios
-    are independent; the batch axis replicates, and scalar leaves like
-    ``tick``/``key`` are batched to [B]/[B, 2] too, so they get the None
-    prefix as well — the ``montecarlo.fleet_state_shardings`` convention).
+    ``batch_axes`` prepends that many axes to EVERY leaf spec — the
+    Monte-Carlo fleet's ``[B, ...]`` replica batch (scalar leaves like
+    ``tick``/``key`` are batched to [B]/[B, 2] too, so they get the
+    prefix as well — the ``montecarlo.fleet_state_shardings``
+    convention).  By default the prefix replicates (None axes: scenarios
+    are independent, and a small fleet costs nothing to replicate);
+    ``batch_axis`` names a mesh axis the FIRST prepended axis shards
+    over instead — the r19 block-sharded fleet, where B·R ≫ 10⁴
+    replica-scenarios split their batch dimension across
+    devices/processes and per-host RSS actually shards.
     """
 
     def one(path, leaf):
         spec = spec_for(_path_name(path))
         if batch_axes:
-            spec = P(*([None] * batch_axes), *spec)
+            prefix = [batch_axis] + [None] * (batch_axes - 1)
+            spec = P(*prefix, *spec)
         return spec
 
     return jax.tree_util.tree_map_with_path(one, tree)
 
 
-def named_shardings(tree, mesh: Mesh, batch_axes: int = 0):
+def named_shardings(tree, mesh: Mesh, batch_axes: int = 0,
+                    batch_axis: Optional[str] = None):
     """Pytree of NamedSharding over ``mesh`` from :func:`partition_spec`.
     ``tree`` may hold arrays OR ShapeDtypeStructs — only structure and
     leaf names are read."""
     return jax.tree.map(
-        lambda s: NamedSharding(mesh, s), partition_spec(tree, batch_axes=batch_axes)
+        lambda s: NamedSharding(mesh, s),
+        partition_spec(tree, batch_axes=batch_axes, batch_axis=batch_axis),
     )
 
 
@@ -259,6 +267,77 @@ def host_gather(tree, batch_axes: int = 0):
         return np.concatenate(rows, axis=node_axis) if len(rows) > 1 else rows[0]
 
     return jax.tree.map(gather, tree, specs)
+
+
+# -- fleet placement: batch-axis shards --------------------------------------
+
+
+def fleet_shard_put(local_tree, mesh: Mesh, global_b: int):
+    """Build GLOBAL batch-sharded arrays from this process's LOCAL batch
+    slice — the leading-axis analog of :func:`shard_put` for the r19
+    scenario fleet's checkpoint carry.
+
+    Every leaf of ``local_tree`` is ``[B_local, ...]`` — the
+    ``process_block(global_b, rank, nprocs)`` slice of a ``[global_b,
+    ...]`` fleet leaf (states, telemetry counters, per-replica
+    first-detection ticks).  ``mesh`` must carry a ``"batch"`` axis whose
+    device order follows process order (``make_fleet_mesh`` /
+    ``montecarlo.fleet_save_mesh``); each process device_puts exactly its
+    own shards via ``jax.make_array_from_single_device_arrays``, so no
+    host ever materializes the global fleet — which is what lets each
+    rank of a B=4096 × n=4096 sweep checkpoint only its slice.  Works
+    single-process too (the virtual-mesh tests), where "local" is "all".
+    """
+    nprocs = jax.process_count()
+    lo, _hi = (
+        process_block(global_b, jax.process_index(), nprocs)
+        if nprocs > 1
+        else (0, global_b)
+    )
+
+    def place(leaf):
+        arr = np.asarray(leaf)
+        gshape = (global_b,) + arr.shape[1:]
+        sharding = NamedSharding(mesh, P("batch", *([None] * (arr.ndim - 1))))
+        dmap = sharding.devices_indices_map(gshape)
+        pieces = []
+        for d in jax.local_devices():
+            idx = list(dmap[d])
+            s = idx[0]
+            start = (0 if s.start is None else s.start) - lo
+            stop = (global_b if s.stop is None else s.stop) - lo
+            if start < 0 or stop > arr.shape[0]:
+                raise ValueError(
+                    "mesh places non-local fleet rows on a local device — "
+                    "the mesh's batch axis does not follow process_block "
+                    "order (build it with montecarlo.fleet_save_mesh)"
+                )
+            idx[0] = slice(start, stop)
+            pieces.append(jax.device_put(arr[tuple(idx)], d))
+        return jax.make_array_from_single_device_arrays(gshape, sharding, pieces)
+
+    return jax.tree.map(place, local_tree)
+
+
+def fleet_host_gather(tree):
+    """The inverse of :func:`fleet_shard_put`: per leaf, one contiguous
+    host array of the LOCALLY-addressable batch rows (this process's
+    ``process_block`` slice of the fleet).  Assumes only the leading
+    batch axis is sharded — the fleet checkpoint layout; never touches
+    another process's shards."""
+
+    def gather(leaf):
+        if not isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        by_start = {}
+        for sh in leaf.addressable_shards:
+            s = sh.index[0] if sh.index else slice(None)
+            start = 0 if s.start is None else s.start
+            by_start[start] = np.asarray(sh.data)
+        rows = [by_start[s] for s in sorted(by_start)]
+        return np.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+
+    return jax.tree.map(gather, tree)
 
 
 # -- digest partials ----------------------------------------------------------

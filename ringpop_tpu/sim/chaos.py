@@ -671,6 +671,25 @@ def index_plan(plan: FaultPlan, b: int) -> FaultPlan:
     return FaultPlan(**legs)
 
 
+def slice_plan(plan: FaultPlan, lo: int, hi: int) -> FaultPlan:
+    """Members ``[lo, hi)`` of a stacked plan as a (smaller) stacked plan
+    — batched legs are sliced along the scenario axis, shared legs pass
+    through.  The r19 fleet's process-slicing seam: rank r of a
+    P-process sweep runs ``slice_plan(plan, *process_block(B, r, P))``
+    and, because a stacked member's trajectory is independent of which
+    other members share its program (pinned by the B=1 and heterogeneous
+    identity tests), re-slicing onto a different process count is
+    bit-exact per scenario."""
+    if not 0 <= lo <= hi:
+        raise ValueError(f"bad slice [{lo}, {hi})")
+    legs = {}
+    for field, value in zip(plan._fields, plan):
+        if value is None:
+            continue
+        legs[field] = value[lo:hi] if _leg_rank(field, value) else value
+    return FaultPlan(**legs)
+
+
 # -- host-side timeline introspection ----------------------------------------
 
 

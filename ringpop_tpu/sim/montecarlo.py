@@ -47,15 +47,59 @@ from ringpop_tpu.sim.lifecycle import (
 )
 
 
-def init_replicas(params: LifecycleParams, seeds: Sequence[int]):
+def init_replicas(params: LifecycleParams, seeds: Sequence[int], mesh=None):
     """Batched state pytree: every array gains a leading replica axis B.
 
     Keys are built with ``jax.random.PRNGKey(seed)`` per seed (host loop, B
     is small) so replica b's stream is EXACTLY ``LifecycleSim(seed=...)``'s
     for any seed Python accepts — a uint32 cast would silently wrap seeds
-    >= 2**32 and break the bit-identical contract."""
+    >= 2**32 and break the bit-identical contract.
+
+    ``mesh`` (r19): place the batch on a device mesh via the canonical
+    partition table — a mesh with a ``"batch"`` axis shards the replica
+    dimension itself (``fleet_state_shardings``), so a B=4096 × n=4096
+    fleet's arrays split across devices/processes instead of replicating.
+    """
     keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
-    return jax.vmap(lambda k: init_state_from_key(params, k))(keys)
+    states = jax.vmap(lambda k: init_state_from_key(params, k))(keys)
+    if mesh is not None:
+        states = jax.tree.map(
+            jax.device_put, states, fleet_state_shardings(mesh, k=params.k)
+        )
+    return states
+
+
+def make_fleet_mesh(n_devices: Optional[int] = None, shape=None):
+    """A ``("batch", "node", "rumor")`` mesh for block-sharded fleets: the
+    replica batch is a REAL mesh axis, so the canonical partition table
+    (``parallel.partition`` with ``batch_axis="batch"``) shards every
+    ``[B, ...]`` fleet leaf's leading dimension across devices.  Default
+    shape puts ALL parallelism on the batch axis — scenarios are
+    independent, so batch sharding adds zero cross-replica collectives
+    and divides per-device residency by the batch factor (the Ising-fleet
+    memory story); pass ``shape`` to split devices between batch and the
+    node/rumor axes for fleets whose members are themselves large."""
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if len(devices) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devices = cpu
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices, have {len(devices)} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+        )
+    if shape is None:
+        shape = (n_devices, 1, 1)
+    dev_array = np.asarray(devices[:n_devices]).reshape(shape)
+    return Mesh(dev_array, axis_names=("batch", "node", "rumor"))
 
 
 # solo (unbatched) ndim per DeltaFaults leaf — a leaf with one more axis
@@ -130,15 +174,35 @@ def _mc_block(params: LifecycleParams, states, faults, ticks: int, telemetry=Non
     )
 
 
+def fleet_save_mesh():
+    """One-axis ``("batch",)`` mesh over EVERY process's devices in
+    process order — the checkpoint placement mesh for process-sliced
+    sweeps: ``partition.fleet_shard_put`` places each rank's local batch
+    slice on it so orbax writes a process-spanning store with every rank
+    writing only its shards (and restores re-chunk onto a different
+    process count).  Single-process it degenerates to all local devices
+    — the same code path."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), ("batch",))
+
+
 def fleet_state_shardings(mesh, k=None):
-    """Shardings for a [B, ...] replica batch over a ("node", "rumor")
-    mesh: the batch axis replicates (scenarios are mutually independent —
-    sharding it would be trivial-parallel, not a partitioning exercise)
-    and every underlying state axis keeps the canonical
-    ``lifecycle.state_shardings`` layout.  Used by the sharded mc_chaos
-    ksweep section and the jaxlint fleet entry point.  Derived from the
-    ONE canonical rule table (``parallel.partition``) with a one-deep
-    batch prefix."""
+    """Shardings for a [B, ...] replica batch over a mesh, derived from
+    the ONE canonical rule table (``parallel.partition``) with a one-deep
+    batch prefix.  Two mesh families:
+
+    * ``("node", "rumor")`` — the r12 layout: the batch axis REPLICATES
+      and every underlying state axis keeps the canonical
+      ``lifecycle.state_shardings`` placement (the sharded mc_chaos
+      ksweep section and the jaxlint fleet entry point).
+    * a mesh carrying a ``"batch"`` axis (``make_fleet_mesh``) — the r19
+      block-sharded fleet: the replica dimension itself shards over that
+      axis, so per-device (and, process-spanning, per-host) residency
+      divides by the batch factor while each member's trajectory stays
+      bit-identical to its unsharded twin (scenarios are independent; no
+      cross-replica collectives exist to reassociate).
+    """
     from ringpop_tpu.parallel.partition import named_shardings
     from ringpop_tpu.sim.lifecycle import LifecycleState
     from ringpop_tpu.sim.packbits import check_rumor_shardable
@@ -146,7 +210,62 @@ def fleet_state_shardings(mesh, k=None):
     if k is not None:
         check_rumor_shardable(k, mesh.shape.get("rumor", 1))
     skeleton = LifecycleState(**{f: 0 for f in LifecycleState._fields})
-    return named_shardings(skeleton, mesh, batch_axes=1)
+    return named_shardings(
+        skeleton, mesh, batch_axes=1,
+        batch_axis="batch" if "batch" in mesh.axis_names else None,
+    )
+
+
+def fleet_shardings(tree, mesh):
+    """NamedShardings for ANY ``[B, ...]``-batched fleet pytree (batched
+    telemetry accumulators, per-replica first-detection ticks, the whole
+    checkpoint carry) over ``mesh`` — same rule as
+    :func:`fleet_state_shardings`: canonical table per leaf, batch prefix
+    on the mesh's ``"batch"`` axis when it has one, replicated prefix
+    otherwise."""
+    from ringpop_tpu.parallel.partition import named_shardings
+
+    return named_shardings(
+        tree, mesh, batch_axes=1,
+        batch_axis="batch" if "batch" in mesh.axis_names else None,
+    )
+
+
+def fleet_faults_shardings(faults, mesh):
+    """Per-leg NamedShardings for a (possibly) batched fault model over a
+    fleet mesh: STACKED legs (one more axis than their solo rank) get the
+    batch prefix — sharded over the ``"batch"`` mesh axis when present —
+    while shared/solo legs keep their canonical placement and None legs
+    stay None.  The leg-wise analog of :func:`fleet_state_shardings`,
+    needed because a stacked plan mixes both kinds in one pytree."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ringpop_tpu.parallel.partition import spec_for
+    from ringpop_tpu.sim import chaos
+
+    batch = "batch" if "batch" in mesh.axis_names else None
+    if isinstance(faults, chaos.FaultPlan):
+        ranks = {f: chaos._leg_rank(f, v) if v is not None else 0
+                 for f, v in zip(faults._fields, faults)}
+        fields, cls = faults._fields, chaos.FaultPlan
+    else:
+        ranks = {
+            f: (1 if getattr(v, "ndim", 0) == _DELTA_FAULTS_NDIM[f] + 1 else 0)
+            for f in _DELTA_FAULTS_NDIM
+            for v in (getattr(faults, f),)
+            if v is not None
+        }
+        fields, cls = tuple(_DELTA_FAULTS_NDIM), DeltaFaults
+    out = {}
+    for f in fields:
+        v = getattr(faults, f)
+        if v is None:
+            continue
+        spec = spec_for(f)
+        if ranks.get(f):
+            spec = P(batch, *spec)
+        out[f] = NamedSharding(mesh, spec)
+    return cls(**out)
 
 
 def _index_faults(faults, b: int):
@@ -195,6 +314,7 @@ def _mc_run_until_device(
     states,
     faults: DeltaFaults,
     subjects: jax.Array,
+    telemetry=None,
     *,
     min_status: int,
     block_ticks: int,
@@ -206,8 +326,16 @@ def _mc_run_until_device(
     has detected.  Same shape of fix as ``_run_until_detected_device`` —
     the host-side per-replica ``detection_fraction`` walk this replaces was
     the pattern 1M-bench profiling showed costing ~90% of wall-clock.
-    Returns (states, blocks_run, first_block[B] (-1 = never)) — the order
-    of the while_loop carry."""
+
+    ``telemetry`` (a [B]-batched accumulator or None): when given it
+    rides the while_loop carry, so the r7 counters cover every tick the
+    lockstep fleet actually stepped — long-horizon sweeps journal
+    counters from the SAME detection loop instead of falling back to
+    fixed-horizon stepping (the r12 refusal this replaces).  The None
+    leg compiles out: the telemetry-free program is exactly the r12 one.
+
+    Returns (states, telemetry, blocks_run, first_block[B] (-1 = never))
+    — the order of the while_loop carry."""
 
     def vdone(states):
         axes = _faults_axes(faults)
@@ -221,20 +349,27 @@ def _mc_run_until_device(
         )(states)
 
     def cond(carry):
-        _, blocks, first = carry
+        _, _, blocks, first = carry
         return (first < 0).any() & (blocks < max_blocks)
 
     def body(carry):
-        states, blocks, first = carry
-        states = _mc_block(params, states, faults, block_ticks)
+        states, tel, blocks, first = carry
+        if tel is None:
+            states = _mc_block(params, states, faults, block_ticks)
+        else:
+            states, tel = _mc_block(
+                params, states, faults, block_ticks, telemetry=tel
+            )
         blocks = blocks + jnp.int32(1)
         first = jnp.where((first < 0) & vdone(states), blocks, first)
-        return states, blocks, first
+        return states, tel, blocks, first
 
     # entry check keeps tick-for-tick equivalence with LifecycleSim's
     # runner, which reports 0 ticks on an already-detected state
     first0 = jnp.where(vdone(states), jnp.int32(0), jnp.int32(-1))
-    return jax.lax.while_loop(cond, body, (states, jnp.int32(0), first0))
+    return jax.lax.while_loop(
+        cond, body, (states, telemetry, jnp.int32(0), first0)
+    )
 
 
 class MonteCarlo:
@@ -244,14 +379,23 @@ class MonteCarlo:
     one compiled program evaluates B scenarios × their seeds.
 
     ``telemetry=True`` carries a [B]-batched r7 counter accumulator
-    through every :meth:`run` tick; :meth:`fetch_telemetry` reduces it to
-    B per-scenario block records (tagged ``scenario_id``) in one dispatch
-    + one ``device_get`` — the journal ``chaos.score_blocks`` reduces
-    into per-scenario verdicts with no host round-trips per scenario.
-    The scored path is exact-horizon :meth:`run` blocks
-    (``scenarios.scored_fleet``); :meth:`run_until_detected`'s device
-    loop does NOT carry the accumulator and refuses to run with one
-    armed rather than pair advanced state with stale counters.
+    through every :meth:`run` tick AND through
+    :meth:`run_until_detected`'s device loop (r19 — the loop's while
+    carry holds the accumulator, so long-horizon sweeps journal counters
+    without falling back to fixed-horizon stepping);
+    :meth:`fetch_telemetry` reduces it to B per-scenario block records
+    (tagged ``scenario_id``) in one dispatch + one ``device_get`` — the
+    journal ``chaos.score_blocks`` reduces into per-scenario verdicts
+    with no host round-trips per scenario.  The exact-horizon scored
+    path remains :meth:`run` blocks (``scenarios.scored_fleet``).
+
+    ``mesh`` (r19): a ``make_fleet_mesh`` mesh block-shards the fleet —
+    states, the telemetry accumulator and every stacked fault leg place
+    their batch axis on the mesh's ``"batch"`` axis via the canonical
+    partition table, so per-device/per-host residency divides by the
+    batch factor while every member stays bit-identical to its unsharded
+    twin (pinned by tests/test_fleet_shard.py).  A ``("node", "rumor")``
+    mesh keeps the r12 batch-replicated layout.
 
     ``aot="tag"`` routes the batched detection program through the
     ``util/aot.py`` warm-start front door (``aot_info`` collects the
@@ -269,27 +413,72 @@ class MonteCarlo:
         telemetry: bool = False,
         aot: Optional[str] = None,
         telemetry_tiers: bool = False,
+        mesh=None,
     ):
         self.params = params
         self.seeds = list(seeds)
-        self.states = init_replicas(params, self.seeds)
+        self.mesh = mesh
+        self.states = init_replicas(params, self.seeds, mesh=mesh)
         self._block = jax.jit(
             functools.partial(_mc_block, self.params), static_argnames="ticks"
         )
         self._aot_tag = aot
         self._aot_calls: dict = {}
         self.aot_info: dict = {}
+        self._faults_cache: tuple = (None, None)
+        self._telemetry_tiers = telemetry_tiers
         self.telemetry = None
         if telemetry:
-            from ringpop_tpu.sim import telemetry as _tm
+            self.telemetry = self._fresh_telemetry()
 
-            # telemetry_tiers arms the per-tier suspicion counters for
-            # topology-carrying fleets (see telemetry.zeros)
-            tz = _tm.zeros(params, tiers=telemetry_tiers)
-            b = len(self.seeds)
-            self.telemetry = jax.tree.map(
-                lambda x: jnp.broadcast_to(x, (b,) + x.shape), tz
+    def _fresh_telemetry(self):
+        from ringpop_tpu.sim import telemetry as _tm
+
+        # telemetry_tiers arms the per-tier suspicion counters for
+        # topology-carrying fleets (see telemetry.zeros)
+        tz = _tm.zeros(self.params, tiers=self._telemetry_tiers)
+        b = len(self.seeds)
+        tel = jax.tree.map(lambda x: jnp.broadcast_to(x, (b,) + x.shape), tz)
+        if self.mesh is not None:
+            tel = jax.tree.map(
+                jax.device_put, tel, fleet_shardings(tel, self.mesh)
             )
+        return tel
+
+    def reset_states(self, seeds: Optional[Sequence[int]] = None):
+        """Re-seed the fleet IN PLACE (same B — the compiled programs are
+        shape-keyed) without dropping the instance's AOT/jit warm state:
+        the adaptive cliff driver (``scenarios.refine_surface``) swaps
+        seeds and plan VALUES between dispatches while the fleet program
+        stays compiled once.  Zeroes the telemetry accumulator when
+        armed."""
+        if seeds is not None:
+            seeds = list(seeds)
+            if len(seeds) != len(self.seeds):
+                raise ValueError(
+                    f"reset_states got {len(seeds)} seeds for a B="
+                    f"{len(self.seeds)} fleet (B is compiled into the program)"
+                )
+            self.seeds = seeds
+        self.states = init_replicas(self.params, self.seeds, mesh=self.mesh)
+        if self.telemetry is not None:
+            self.telemetry = jax.tree.map(jnp.zeros_like, self.telemetry)
+
+    def _place_faults(self, faults):
+        """Device placement for the fault model on a fleet mesh: stacked
+        legs shard over the batch axis alongside the states
+        (``fleet_faults_shardings``).  Memoized on object identity — the
+        sweep loops hand the same plan to every block."""
+        if self.mesh is None or faults is None:
+            return faults
+        cached, placed = self._faults_cache
+        if cached is faults:
+            return placed
+        placed = jax.tree.map(
+            jax.device_put, faults, fleet_faults_shardings(faults, self.mesh)
+        )
+        self._faults_cache = (faults, placed)
+        return placed
 
     def detection_fractions(
         self, subjects, faults: DeltaFaults = DeltaFaults(), min_status: int = FAULTY
@@ -316,6 +505,7 @@ class MonteCarlo:
         return len(self.seeds)
 
     def run(self, ticks: int, faults: DeltaFaults = DeltaFaults()):
+        faults = self._place_faults(faults)
         if self.telemetry is None:
             self.states = self._block(self.states, faults, ticks=ticks)
         else:
@@ -324,30 +514,42 @@ class MonteCarlo:
             )
         return self.states
 
-    def fetch_telemetry(self, faults: DeltaFaults = DeltaFaults()) -> list[dict]:
+    def fetch_telemetry(
+        self, faults: DeltaFaults = DeltaFaults(), id_base: int = 0
+    ) -> list[dict]:
         """Fetch-and-reset the batched accumulators: B per-scenario host
-        block records (``scenario_id`` = replica index, per-replica
-        ``state_digest`` attached), produced by ONE jitted reduction and
-        ONE ``device_get`` (``telemetry.split_batched``)."""
+        block records (``scenario_id`` = ``id_base`` + replica index —
+        rank r of a process-sliced fleet passes its slice offset so
+        records carry GLOBAL scenario ids), produced by ONE jitted
+        reduction and ONE ``device_get`` (``telemetry.split_batched``)."""
         if self.telemetry is None:
             raise ValueError("MonteCarlo built without telemetry=True")
         from ringpop_tpu.sim import telemetry as _tm
 
+        faults = self._place_faults(faults)
         record, self.telemetry, digests = _mc_fetch(
             self.telemetry, self.states, faults, axes=_faults_axes(faults)
         )
-        return _tm.split_batched(record, {"state_digest": digests})
+        return _tm.split_batched(
+            record, {"state_digest": digests}, id_base=id_base
+        )
 
-    def _until_call(self, states, faults, subjects, *, min_status, block_ticks, max_blocks):
+    def _until_call(self, states, faults, subjects, tel, *, min_status, block_ticks, max_blocks):
         """Dispatch the whole-fleet detection program — through the AOT
         warm-start front door when the instance carries a tag.  Memoized
-        per (statics, faults structure + leaf avals, subjects aval) —
-        every dynamic shape the exported executable is fixed to, same
-        discrimination rule as ``LifecycleSim._block_call``."""
+        per (statics, faults structure + leaf avals, subjects aval,
+        telemetry armed-ness, the FLEET SHARDING descriptor) — every
+        dynamic shape AND placement the exported executable is fixed to:
+        a mesh-sharded fleet is a different compiled program than its
+        unsharded twin and must never share its memo slot (the leaf
+        descriptors inside ``load_or_compile`` already key the artifact
+        itself; this keys the per-instance call cache built before the
+        leaves are enumerated)."""
         kw = dict(min_status=min_status, block_ticks=block_ticks)
         if self._aot_tag is None:
             return _mc_run_until_device(
-                self.params, states, faults, subjects, max_blocks=max_blocks, **kw
+                self.params, states, faults, subjects, tel,
+                max_blocks=max_blocks, **kw
             )
         from ringpop_tpu.util import aot as _aot
 
@@ -355,6 +557,8 @@ class MonteCarlo:
             str(jax.tree.structure(faults))
             + "|".join(_aot._leaf_descriptor(x) for x in jax.tree.leaves(faults))
             + "|s:" + _aot._leaf_descriptor(subjects)
+            + "|t:" + str(jax.tree.structure(tel))
+            + "|m:" + _aot.sharding_descriptor((states, faults, tel))
         )
         memo = (min_status, block_ticks, fdesc)
         if memo not in self._aot_calls:
@@ -366,13 +570,15 @@ class MonteCarlo:
             )
             call, info = _aot.load_or_compile(
                 functools.partial(_mc_run_until_device, self.params),
-                states, faults, subjects,
+                states, faults, subjects, tel,
                 dyn_kw={"max_blocks": max_blocks},
                 tag=tag, static_kw=kw, statics=(repr(self.params),),
             )
             self._aot_calls[memo] = call
             self.aot_info[tag] = info
-        return self._aot_calls[memo](states, faults, subjects, max_blocks=max_blocks)
+        return self._aot_calls[memo](
+            states, faults, subjects, tel, max_blocks=max_blocks
+        )
 
     def run_until_detected(
         self,
@@ -390,20 +596,23 @@ class MonteCarlo:
         replica first measured full detection, and whether it did within
         ``max_ticks``.  Replicas that finish early keep stepping (lockstep
         is what makes this one program); their recorded tick is frozen.
+
+        An armed telemetry accumulator RIDES the device loop's carry
+        (r19): the counters cover every tick the lockstep fleet actually
+        stepped — ``blocks_run × check_every``, which for early finishers
+        exceeds their first-detection tick by construction — and
+        :meth:`fetch_telemetry` journals them as usual.  (r12 refused
+        this pairing because the loop did not carry the accumulator; the
+        carry is the supported route now.)
         """
-        if self.telemetry is not None:
-            raise ValueError(
-                "run_until_detected does not carry the telemetry accumulator "
-                "(its counters would silently stay stale while the state "
-                "advances) — use run() + fetch_telemetry (the scored_fleet "
-                "path) or build the MonteCarlo without telemetry=True"
-            )
+        faults = self._place_faults(faults)
         subjects = jnp.asarray(list(victims), jnp.int32)
         max_blocks = -(-max_ticks // check_every)  # host loop ran ceil(max/check)
-        self.states, _, first_block = self._until_call(
+        self.states, self.telemetry, _, first_block = self._until_call(
             self.states,
             faults,
             subjects,
+            self.telemetry,
             min_status=min_status,
             block_ticks=check_every,
             max_blocks=jnp.int32(max_blocks),

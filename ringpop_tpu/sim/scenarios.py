@@ -47,6 +47,8 @@ block slice into a verdict with its grid coordinates attached.
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -268,39 +270,333 @@ def scored_fleet(
     and reduce each scenario's journal slice into a ``chaos.score_blocks``
     verdict carrying its grid coordinates.  ``sink`` (a
     ``telemetry.TelemetrySink`` or None) receives every per-scenario
-    block record and, when it journals, every score record."""
-    # a topology-carrying plan arms the per-tier suspicion counters, so
-    # its verdicts get the per-tier ttd/false-positive breakdowns
-    mc = MonteCarlo(
-        params, seeds, telemetry=True,
-        telemetry_tiers=plan.tier_ids is not None,
+    block record and, when it journals, every score record.
+
+    The one-shot wrapper around :class:`FleetSweep` — the resumable form
+    with mid-sweep checkpoints, process slicing and mesh sharding."""
+    sweep = FleetSweep(
+        params, plan, meta, seeds, horizon=horizon,
+        journal_every=journal_every, sink=sink, scenario=scenario,
     )
-    blocks: list[list[dict]] = [[] for _ in meta]
-    ticks_left = horizon
-    while ticks_left > 0:
-        # exactly ``horizon`` ticks: full journal blocks plus one short
-        # remainder block (its own compile of the static-ticks program)
-        # when journal_every does not divide the horizon
-        mc.run(min(journal_every, ticks_left), plan)
-        ticks_left -= min(journal_every, ticks_left)
-        for rec in mc.fetch_telemetry(plan):
-            blocks[rec["scenario_id"]].append(rec)
-            if sink is not None:
-                sink(rec)
-    scores = []
-    for b, m in enumerate(meta):
-        sc = chaos.score_blocks(
-            blocks[b],
-            chaos.index_plan(plan, b),
-            n=params.n,
-            scenario=scenario,
-            scenario_id=b,
+    sweep.run()
+    return sweep.scores()
+
+
+FLEET_CKPT_VERSION = 1
+
+
+class FleetSweep:
+    """A resumable long-horizon scored sweep — the r19 unit of fleet
+    work: B scenarios stepped in lockstep journal blocks with the r7
+    counters under the batch axis, checkpointable MID-SWEEP and
+    restorable bit-exactly, including onto a different process count.
+
+    The checkpoint carry is (batched engine state + batched telemetry
+    counters); sweep progress and the already-fetched per-scenario block
+    records ride a JSON sidecar next to the orbax store (block records
+    are native JSON scalars by the ``_to_host`` coercion, so the sidecar
+    round-trip is value-exact and the resumed run's
+    ``chaos.score_blocks`` verdicts equal the unbroken run's bit for
+    bit).  Process slicing: rank r of a P-process sweep constructs this
+    class over ``chaos.slice_plan(plan, lo, hi)`` /
+    ``meta[lo:hi]`` / ``seeds[lo:hi]`` with ``global_b=B`` — at save
+    time each rank's local slice is placed on the process-spanning
+    ``montecarlo.fleet_save_mesh`` (``partition.fleet_shard_put``) so
+    every process writes ONLY its shards; at restore the new process
+    count's ranks read only theirs (``fleet_scale`` certificate,
+    ``make fleet-smoke``).
+
+    ``mesh`` — a ``make_fleet_mesh`` device mesh block-shards the fleet
+    in-process (single-host many-device); mutually exclusive with
+    multi-process slicing (one partitioning owner at a time).
+    """
+
+    def __init__(
+        self,
+        params: LifecycleParams,
+        plan: FaultPlan,
+        meta: list[dict],
+        seeds: Sequence[int],
+        *,
+        horizon: int,
+        journal_every: int = 16,
+        sink=None,
+        scenario: str = "mc_chaos",
+        mesh=None,
+        global_b: Optional[int] = None,
+        telemetry_tiers: Optional[bool] = None,
+    ):
+        if len(meta) != len(list(seeds)):
+            raise ValueError(f"{len(meta)} meta entries vs {len(list(seeds))} seeds")
+        self.params, self.plan = params, plan
+        self.meta, self.seeds = list(meta), list(seeds)
+        self.horizon, self.journal_every = horizon, journal_every
+        self.sink, self.scenario = sink, scenario
+        self.global_b = len(self.meta) if global_b is None else global_b
+        # meta carries grid-GLOBAL scenario ids; a process slice keeps
+        # them, so the id base is simply the first entry's id
+        self.id_base = self.meta[0]["scenario_id"] if self.meta else 0
+        ids = [m["scenario_id"] for m in self.meta]
+        if ids != list(range(self.id_base, self.id_base + len(ids))):
+            raise ValueError(
+                "meta scenario_ids must be contiguous (a process_block "
+                f"slice of the grid); got {ids[:4]}..."
+            )
+        # a topology-carrying plan arms the per-tier suspicion counters,
+        # so its verdicts get the per-tier ttd/false-positive breakdowns
+        tiers = (
+            plan.tier_ids is not None
+            if telemetry_tiers is None
+            else telemetry_tiers
         )
-        sc.update({k: v for k, v in m.items() if k != "scenario_id"})
-        scores.append(sc)
-        if sink is not None and getattr(sink, "journal", None) is not None:
-            sink.journal.score(sc)
-    return scores
+        self.mc = MonteCarlo(
+            params, self.seeds, telemetry=True, telemetry_tiers=tiers,
+            mesh=mesh,
+        )
+        self.blocks: dict[int, list[dict]] = {i: [] for i in ids}
+        self.ticks_done = 0
+        self.resumed: Optional[dict] = None
+
+    def header_params(self) -> dict:
+        """Restore-proof fields for a journal header (OBSERVABILITY.md
+        fleet-checkpoint schema): where the sweep stands and — after a
+        restore — where it came from."""
+        out = {
+            "fleet_b": len(self.meta),
+            "global_b": self.global_b,
+            "id_base": self.id_base,
+            "horizon": self.horizon,
+            "journal_every": self.journal_every,
+            "ticks_done": self.ticks_done,
+        }
+        if self.resumed is not None:
+            out["resumed"] = dict(self.resumed)
+        return out
+
+    def run(self, until_tick: Optional[int] = None) -> "FleetSweep":
+        """Step to ``until_tick`` (default: the horizon) in journal
+        blocks — exactly ``horizon`` total ticks: full blocks plus one
+        short remainder block (its own compile of the static-ticks
+        program) when ``journal_every`` does not divide.  ``until_tick``
+        must land on a block boundary: checkpoints live between blocks,
+        so a resumed run replays the identical block structure."""
+        target = self.horizon if until_tick is None else min(until_tick, self.horizon)
+        if target % self.journal_every and target != self.horizon:
+            raise ValueError(
+                f"until_tick={target} is not a journal block boundary "
+                f"(journal_every={self.journal_every}) — checkpoints live "
+                "between blocks"
+            )
+        while self.ticks_done < target:
+            step = min(self.journal_every, self.horizon - self.ticks_done)
+            self.mc.run(step, self.plan)
+            self.ticks_done += step
+            for rec in self.mc.fetch_telemetry(self.plan, id_base=self.id_base):
+                self.blocks[rec["scenario_id"]].append(rec)
+                if self.sink is not None:
+                    self.sink(rec)
+        return self
+
+    def scores(self) -> list[dict]:
+        """Per-scenario ``chaos.score_blocks`` verdicts over EVERY block
+        this sweep has seen — including, after a restore, the pre-kill
+        blocks read back from the checkpoint sidecar."""
+        scores = []
+        for b, m in enumerate(self.meta):
+            gid = m["scenario_id"]
+            sc = chaos.score_blocks(
+                self.blocks[gid],
+                chaos.index_plan(self.plan, b),
+                n=self.params.n,
+                scenario=self.scenario,
+                scenario_id=gid,
+            )
+            sc.update({k: v for k, v in m.items() if k != "scenario_id"})
+            scores.append(sc)
+            if self.sink is not None and getattr(self.sink, "journal", None) is not None:
+                self.sink.journal.score(sc)
+        return scores
+
+    def digests(self) -> dict[int, int]:
+        """{global scenario_id: state digest} for this sweep's slice —
+        the per-scenario certification currency (one vmapped digest
+        dispatch)."""
+        import jax
+
+        from ringpop_tpu.sim import telemetry as _tm
+
+        d = jax.vmap(_tm.tree_digest)(self.mc.states)
+        return {
+            self.id_base + i: int(v) for i, v in enumerate(jax.device_get(d))
+        }
+
+    # -- checkpointing --------------------------------------------------------
+
+    def _carry(self) -> dict:
+        return {"states": self.mc.states, "telemetry": self.mc.telemetry}
+
+    def save(self, path: str) -> None:
+        """Checkpoint mid-sweep: the carry to orbax (each process writes
+        only its shards — multi-process slices place their local batch
+        rows on the process-spanning save mesh first) plus a per-rank
+        JSON sidecar under ``<path>.meta/`` carrying progress, config
+        fingerprints and this rank's fetched block records."""
+        import jax
+
+        from ringpop_tpu.sim import snapshot
+        from ringpop_tpu.sim.montecarlo import fleet_save_mesh
+
+        nprocs = jax.process_count()
+        carry = self._carry()
+        if nprocs > 1:
+            if self.mc.mesh is not None:
+                raise ValueError(
+                    "process-sliced sweeps checkpoint their local slice; a "
+                    "device mesh on top would need two partitioning owners"
+                )
+            from ringpop_tpu.parallel.partition import fleet_shard_put
+
+            carry = fleet_shard_put(carry, fleet_save_mesh(), self.global_b)
+        snapshot.save_carry_orbax(path, carry)
+        meta_dir = path + ".meta"
+        os.makedirs(meta_dir, exist_ok=True)
+        rank = jax.process_index() if nprocs > 1 else 0
+        sidecar = {
+            "version": FLEET_CKPT_VERSION,
+            "scenario": self.scenario,
+            "params": repr(self.params),
+            "global_b": self.global_b,
+            "lo": self.id_base,
+            "hi": self.id_base + len(self.meta),
+            "ticks_done": self.ticks_done,
+            "horizon": self.horizon,
+            "journal_every": self.journal_every,
+            "process_count": nprocs,
+            "blocks": {str(k): v for k, v in self.blocks.items()},
+        }
+        tmp = os.path.join(meta_dir, f"rank{rank}.json.tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(sidecar, f)
+        os.replace(tmp, os.path.join(meta_dir, f"rank{rank}.json"))
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        params: LifecycleParams,
+        plan: FaultPlan,
+        meta: list[dict],
+        seeds: Sequence[int],
+        *,
+        sink=None,
+        scenario: Optional[str] = None,
+        mesh=None,
+        global_b: Optional[int] = None,
+        telemetry_tiers: Optional[bool] = None,
+    ) -> "FleetSweep":
+        """Resume a killed sweep — at THIS process count, which need not
+        match the saver's.  ``plan``/``meta``/``seeds`` are the caller's
+        reconstruction of ITS slice of the grid (the grid is
+        deterministic in its config; ``chaos.slice_plan`` +
+        ``partition.process_block`` re-slice it for the new rank
+        layout); the carry restores with every process reading only its
+        own shards, and the pre-kill block records merge back from ALL
+        ranks' sidecars so the final verdicts cover the whole horizon."""
+        import glob as _glob
+
+        import jax
+
+        from ringpop_tpu.sim import snapshot
+        from ringpop_tpu.sim.montecarlo import fleet_save_mesh
+
+        meta_dir = path + ".meta"
+        sidecars = []
+        for p in sorted(_glob.glob(os.path.join(meta_dir, "rank*.json"))):
+            with open(p) as f:
+                sidecars.append(json.load(f))
+        if not sidecars:
+            raise ValueError(f"{path}: no fleet checkpoint sidecars in {meta_dir}")
+        head = sidecars[0]
+        if head.get("version") != FLEET_CKPT_VERSION:
+            raise ValueError(
+                f"{path}: fleet checkpoint version {head.get('version')} "
+                f"(this build reads {FLEET_CKPT_VERSION})"
+            )
+        for key in ("ticks_done", "horizon", "journal_every", "global_b", "params"):
+            vals = {json.dumps(s.get(key)) for s in sidecars}
+            if len(vals) > 1:
+                raise ValueError(f"{path}: sidecars disagree on {key!r}: {vals}")
+        if head["params"] != repr(params):
+            raise ValueError(
+                f"{path}: checkpoint was taken with {head['params']}, "
+                f"restore asked for {params!r}"
+            )
+        sweep = cls(
+            params, plan, meta, seeds,
+            horizon=head["horizon"], journal_every=head["journal_every"],
+            sink=sink, scenario=scenario or head.get("scenario", "mc_chaos"),
+            mesh=mesh, global_b=global_b, telemetry_tiers=telemetry_tiers,
+        )
+        if sweep.global_b != head["global_b"]:
+            raise ValueError(
+                f"{path}: checkpoint holds a B={head['global_b']} fleet, "
+                f"restore sliced B={sweep.global_b}"
+            )
+        example = sweep._carry()
+        nprocs = jax.process_count()
+        if nprocs > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            smesh = fleet_save_mesh()
+
+            def _sh(leaf):
+                return NamedSharding(
+                    smesh, P("batch", *([None] * (np.ndim(leaf) - 1)))
+                )
+
+            # the example holds the LOCAL slice; the store holds the
+            # GLOBAL fleet — widen the batch axis, restore sharded, and
+            # keep only this rank's rows
+            gexample = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (sweep.global_b,) + np.shape(x)[1:], x.dtype
+                ),
+                example,
+            )
+            carry = snapshot.load_carry_orbax(
+                path, gexample, jax.tree.map(_sh, gexample)
+            )
+            from ringpop_tpu.parallel.partition import fleet_host_gather
+
+            carry = jax.tree.map(jnp.asarray, fleet_host_gather(carry))
+        else:
+            # explicit target shardings ALWAYS: a checkpoint written by a
+            # process-spanning save carries per-shard sharding metadata
+            # that cannot reconstruct on a different topology — the
+            # restore target, not the store, names the layout
+            if mesh is not None:
+                from ringpop_tpu.sim.montecarlo import fleet_shardings
+
+                shardings = fleet_shardings(example, mesh)
+            else:
+                dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+                shardings = jax.tree.map(lambda x: dev, example)
+            carry = snapshot.load_carry_orbax(path, example, shardings)
+        sweep.mc.states = carry["states"]
+        sweep.mc.telemetry = carry["telemetry"]
+        sweep.ticks_done = head["ticks_done"]
+        for s in sidecars:
+            for gid_s, recs in s.get("blocks", {}).items():
+                gid = int(gid_s)
+                if gid in sweep.blocks:
+                    sweep.blocks[gid] = list(recs)
+        sweep.resumed = {
+            "from_tick": head["ticks_done"],
+            "checkpoint": os.path.abspath(path),
+            "saved_process_count": head.get("process_count"),
+            "restored_process_count": nprocs,
+        }
+        return sweep
 
 
 # -- surface reduction --------------------------------------------------------
@@ -342,11 +638,362 @@ def response_surface(
 def locate_cliff(curve: Sequence[tuple]) -> tuple[Optional[int], Optional[float]]:
     """The dose at the largest jump between consecutive detected points
     of a dose-response curve (the mc_churn cliff finder, factored here so
-    the 1-D slice and every surface row share one definition).  Takes
-    ``[(dose, ticks-or-None), ...]``; returns ``(cliff_at, jump)`` or
-    ``(None, None)`` when fewer than two points detected."""
+    the 1-D slice, every surface row, AND the adaptive driver's 2-cell
+    windows share one definition).  Takes ``[(dose, ticks-or-None),
+    ...]``.
+
+    Contract (explicit since r19 — the old code returned ``(None,
+    None)`` ambiguously for both cases):
+
+    * fewer than TWO detected points (empty curve, a single point, or
+      everything None) → ``(None, None)``: the curve is too short to
+      define a jump at all;
+    * two or more detected points but no POSITIVE jump (flat or
+      monotone non-increasing) → ``(None, 0.0)``: a well-defined curve
+      with no cliff on it;
+    * otherwise ``(dose, jump)`` at the largest consecutive-detected
+      jump — ties on the jump resolve to the LARGER dose (``max`` over
+      ``(jump, dose)``), the rule the bisection driver's keep-upper
+      tie-break mirrors.
+    """
     pts = [(c, t) for c, t in curve if t is not None]
     if len(pts) < 2:
         return None, None
     jump, at = max((t2 - t1, c2) for (_, t1), (c2, t2) in zip(pts, pts[1:]))
+    if jump <= 0:
+        return None, 0.0
     return at, jump
+
+
+# -- adaptive cliff search (r19) ----------------------------------------------
+
+
+def dose_mask_table(
+    n: int, victims: Sequence[int], max_dose: int, churn_seed: int
+) -> np.ndarray:
+    """``up[max_dose + 1, N]`` — EVERY dose's churn mask at 1-dose
+    resolution, drawn by the EXACT sequential rng rule of
+    :func:`churn_dose_masks` over the full ladder ``0..max_dose``.  This
+    is the shared response-function table: the adaptive driver and its
+    dense A/B baseline both INDEX it (a mask is a function of the dose
+    alone once the table is fixed), so they measure the same surface
+    point for point and "identical cliff coordinates" is a claim about
+    the search, not about mask luck.  Host-side and cheap: the full
+    1-dose table at n=4096, max_dose=128 is half a megabyte."""
+    return churn_dose_masks(n, victims, list(range(max_dose + 1)), churn_seed)
+
+
+def _points_plan(masks: np.ndarray, points: Sequence[tuple]) -> FaultPlan:
+    """A stacked plan for explicit ``(dose, loss)`` points — always the
+    same two legs (``base_up``, ``drop_rate``), so every dispatch of the
+    driver has the identical plan STRUCTURE and avals: value-only swaps
+    through one compiled fleet program."""
+    return chaos.stack_plans([
+        FaultPlan(
+            base_up=jnp.asarray(masks[d]),
+            drop_rate=jnp.asarray(np.float32(l)),
+        )
+        for d, l in points
+    ])
+
+
+class _CliffRunner:
+    """Dispatch harness for the adaptive search: a FIXED-width fleet
+    (width = the compiled batch size, in replica SLOTS) evaluated
+    repeatedly with value-only (plan, seed) swaps —
+    ``MonteCarlo.reset_states`` keeps the instance's jit/AOT warm state,
+    so the program compiles ONCE and each refinement round costs one
+    dispatch.  Each (dose, loss) point occupies ``seeds_per_point``
+    slots (seeds ``base_seed + dose·S + j`` — distinct per (dose,
+    replica), shared across loss rows like ``grid_seeds``); its value is
+    the MEDIAN first-detection tick over those replicas, which is what
+    makes "the cliff" a property of the surface rather than of one
+    seed's luck (the Ising-ensemble move; ``seeds_per_point=1`` is the
+    r12 single-seed pairing).  Short rounds pad by repeating their last
+    point; padding costs dispatch slots (reported in ``slots``) but no
+    new scenario-evaluations (the ``cache`` is the unique-evaluation
+    ledger, in replica-slots: points × seeds_per_point)."""
+
+    def __init__(self, params, victims, masks, width, *, base_seed,
+                 max_ticks, check_every, aot, seeds_per_point=1):
+        if width % seeds_per_point:
+            raise ValueError(
+                f"width {width} must be a multiple of seeds_per_point "
+                f"{seeds_per_point}"
+            )
+        self.params, self.victims, self.masks = params, victims, masks
+        self.width, self.base_seed = width, base_seed
+        self.max_ticks, self.check_every = max_ticks, check_every
+        self.aot = aot
+        self.spp = seeds_per_point
+        self.mc: Optional[MonteCarlo] = None
+        self.dispatches = 0
+        self.slots = 0
+        self.cache: dict[tuple, Optional[float]] = {}
+
+    def eval(self, points: Sequence[tuple]) -> dict:
+        todo = [p for p in dict.fromkeys(points) if p not in self.cache]
+        per = self.width // self.spp
+        while todo:
+            chunk, todo = todo[:per], todo[per:]
+            batch = chunk + [chunk[-1]] * (per - len(chunk))
+            slots = [(pt, j) for pt in batch for j in range(self.spp)]
+            seeds = [self.base_seed + d * self.spp + j for (d, _), j in slots]
+            if self.mc is None:
+                self.mc = MonteCarlo(self.params, seeds, aot=self.aot)
+            else:
+                self.mc.reset_states(seeds)
+            ticks, det = self.mc.run_until_detected(
+                self.victims,
+                _points_plan(self.masks, [pt for pt, _ in slots]),
+                max_ticks=self.max_ticks, check_every=self.check_every,
+            )
+            self.dispatches += 1
+            self.slots += self.width
+            for i, pt in enumerate(batch):
+                reps = [
+                    (float(t) if d else None)
+                    for t, d in zip(
+                        ticks[i * self.spp:(i + 1) * self.spp],
+                        det[i * self.spp:(i + 1) * self.spp],
+                    )
+                ]
+                if pt not in self.cache:
+                    if all(r is None for r in reps):
+                        self.cache[pt] = None
+                    else:
+                        self.cache[pt] = float(np.median([
+                            self.max_ticks if r is None else r for r in reps
+                        ]))
+        return {p: self.cache[p] for p in points}
+
+    def result_fields(self) -> dict:
+        aot_info = (
+            next(iter(self.mc.aot_info.values()), {}) if self.mc is not None
+            and self.aot is not None else {}
+        )
+        return {
+            "evals_unique": len(self.cache) * self.spp,
+            "evals_dispatched": self.slots,
+            "dispatches": self.dispatches,
+            "width": self.width,
+            "seeds_per_point": self.spp,
+            "all_detected": all(v is not None for v in self.cache.values()),
+            "compiled_programs": (
+                len(self.mc._aot_calls) if self.mc is not None and
+                self.aot is not None else None
+            ),
+            "aot": aot_info,
+        }
+
+
+def refine_surface(
+    params: LifecycleParams,
+    *,
+    victims: Sequence[int],
+    losses: Sequence[float],
+    max_dose: int,
+    coarse: int = 9,
+    base_seed: int = 0,
+    churn_seed: int = 1234,
+    max_ticks: int = 4096,
+    check_every: int = 1,
+    aot: Optional[str] = None,
+    masks: Optional[np.ndarray] = None,
+    cells_per_row: int = 2,
+    verify_window: int = 2,
+    seeds_per_point: int = 1,
+) -> dict:
+    """Adaptive cliff search: locate each loss row's dose cliff at
+    1-dose resolution in O(log max_dose) fleet dispatches instead of a
+    dense grid.
+
+    A COARSE pass (``coarse`` evenly spaced doses per row, one fleet
+    dispatch) ranks each row's cells by first-detection jump; the top
+    ``cells_per_row`` are candidates (detection noise can put two
+    near-equal jumps in different cells).  Then an outer host loop
+    BISECTS only those cells: each round evaluates every active cell's
+    midpoint (all rows and cells share one dispatch; finished rows
+    pad), keeps the half with the larger jump (ties keep the upper
+    half, mirroring ``locate_cliff``), and stops at width 1.  A final
+    VERIFY dispatch evaluates the ±``verify_window`` 1-dose
+    neighborhood of every candidate, and the row's answer is the
+    largest jump over ADJACENT evaluated dose pairs — the exact
+    quantity the dense grid maximizes, so on a surface with a dominant
+    cliff the two coincide (the fleet_scale A/B asserts it).  The fleet
+    program is compiled ONCE: every dispatch is a value-only (plan,
+    seed) swap at fixed batch width (``_CliffRunner``), so refinement
+    costs dispatches, not compiles.
+
+    Rows whose coarse curve has fewer than two detected points report
+    ``(None, None)``; rows with no positive jump report ``(None, 0.0)``
+    — the :func:`locate_cliff` contract.  Undetected points inside an
+    active cell count as ``max_ticks`` for jump arithmetic (operationally
+    "at least"); ``all_detected`` in the result says whether that ever
+    happened.
+
+    Returns ``{"cliffs": {loss: {"cliff_at", "jump", "cell"}},
+    "points": {loss: [(dose, tick-or-None), ...]}}`` plus the dispatch
+    ledger (``evals_unique``/``evals_dispatched``/``dispatches``/
+    ``width``) the dense A/B compares against."""
+    if coarse < 3:
+        raise ValueError(f"coarse={coarse}: need at least 3 coarse doses")
+    if max_dose < 2:
+        raise ValueError(f"max_dose={max_dose}: nothing to refine")
+    losses = tuple(float(l) for l in losses)
+    if masks is None:
+        masks = dose_mask_table(params.n, victims, max_dose, churn_seed)
+    coarse_doses = sorted({
+        int(round(i * max_dose / (coarse - 1))) for i in range(coarse)
+    })
+    runner = _CliffRunner(
+        params, victims, masks,
+        width=len(coarse_doses) * len(losses) * seeds_per_point,
+        base_seed=base_seed, max_ticks=max_ticks, check_every=check_every,
+        aot=aot, seeds_per_point=seeds_per_point,
+    )
+    got = runner.eval([(d, l) for l in losses for d in coarse_doses])
+
+    def t_of(d, l):
+        v = runner.cache[(d, l)]
+        return max_ticks if v is None else v
+
+    # per row: the top-`cells_per_row` steepest coarse cells (noise can
+    # put two near-equal jumps in different cells — refining only the
+    # winner would crown whichever the stride happened to flatter)
+    cells: dict[float, list[tuple[int, int]]] = {}
+    cliffs: dict = {}
+    for l in losses:
+        curve = [(d, got[(d, l)]) for d in coarse_doses]
+        det = [(d, t) for d, t in curve if t is not None]
+        if len(det) < 2:
+            cliffs[l] = {"cliff_at": None, "jump": None, "cell": None}
+            cells[l] = []
+            continue
+        ranked = sorted(
+            ((t2 - t1, d1, d2) for (d1, t1), (d2, t2) in zip(det, det[1:])),
+            reverse=True,
+        )
+        if ranked[0][0] <= 0:
+            cliffs[l] = {"cliff_at": None, "jump": 0.0, "cell": None}
+            cells[l] = []
+            continue
+        cells[l] = [
+            (d1, d2) for jump, d1, d2 in ranked[:cells_per_row] if jump > 0
+        ]
+    while True:
+        active = [
+            (l, i) for l, cs in cells.items()
+            for i, (lo, hi) in enumerate(cs) if hi - lo > 1
+        ]
+        if not active:
+            break
+        mids = []
+        for l, i in active:
+            lo, hi = cells[l][i]
+            mids.append(((lo + hi) // 2, l))
+        runner.eval(mids)
+        for l, i in active:
+            lo, hi = cells[l][i]
+            m = (lo + hi) // 2
+            jl = t_of(m, l) - t_of(lo, l)
+            jh = t_of(hi, l) - t_of(m, l)
+            # keep the half with the larger jump; ties keep the UPPER
+            # half (locate_cliff's larger-dose tie-break)
+            cells[l][i] = (m, hi) if jh >= jl else (lo, m)
+    # verify pass: a ±verify_window 1-dose neighborhood around every
+    # refined candidate, so the final answer rests on adjacent PAIRS,
+    # not on which path the bisection took
+    extra = []
+    for l, cs in cells.items():
+        for lo, hi in cs:
+            for d in range(hi - 1 - verify_window, hi + 1 + verify_window):
+                if 0 <= d <= max_dose:
+                    extra.append((d, l))
+    if extra:
+        runner.eval(extra)
+    # final rule per row: the largest jump over ADJACENT evaluated dose
+    # pairs — the exact quantity the dense grid maximizes, restricted to
+    # the points the search visited (ties to the larger dose, the
+    # locate_cliff tie-break)
+    for l in losses:
+        if not cells[l]:
+            continue
+        evald = sorted(d for (d, ll) in runner.cache if ll == l)
+        pairs = [
+            (t_of(d2, l) - t_of(d1, l), d2)
+            for d1, d2 in zip(evald, evald[1:]) if d2 == d1 + 1
+        ]
+        jump, at = max(pairs)
+        if jump <= 0:
+            # every adjacent evaluated pair is flat or decreasing: the
+            # coarse-stride jump that elected this cell did not survive
+            # 1-dose resolution — the locate_cliff no-cliff contract
+            cliffs[l] = {"cliff_at": None, "jump": 0.0, "cell": None}
+            continue
+        cell = next(
+            ([lo, hi] for lo, hi in cells[l] if hi == at), [at - 1, at]
+        )
+        cliffs[l] = {"cliff_at": at, "jump": jump, "cell": cell}
+    points = {
+        l: sorted((d, t) for (d, ll), t in runner.cache.items() if ll == l)
+        for l in losses
+    }
+    return {
+        "losses": list(losses),
+        "max_dose": max_dose,
+        "coarse_doses": coarse_doses,
+        "cliffs": cliffs,
+        "points": points,
+        **runner.result_fields(),
+    }
+
+
+def dense_surface(
+    params: LifecycleParams,
+    *,
+    victims: Sequence[int],
+    losses: Sequence[float],
+    max_dose: int,
+    base_seed: int = 0,
+    churn_seed: int = 1234,
+    max_ticks: int = 4096,
+    check_every: int = 1,
+    aot: Optional[str] = None,
+    masks: Optional[np.ndarray] = None,
+    width: Optional[int] = None,
+    seeds_per_point: int = 1,
+) -> dict:
+    """The baseline :func:`refine_surface` replaces: EVERY dose
+    ``0..max_dose`` of every loss row evaluated through the batched
+    fleet (one dispatch, or chunks of ``width``), cliffs located by
+    :func:`locate_cliff` on the full 1-dose curves.  Shares the
+    ``dose_mask_table`` and the seed pairing with the adaptive driver,
+    so the two measure the same response function — the fleet_scale A/B
+    asserts identical cliff coordinates at a fraction of the
+    scenario-evaluations."""
+    losses = tuple(float(l) for l in losses)
+    if masks is None:
+        masks = dose_mask_table(params.n, victims, max_dose, churn_seed)
+    points = [(d, l) for l in losses for d in range(max_dose + 1)]
+    runner = _CliffRunner(
+        params, victims, masks,
+        width=width or len(points) * seeds_per_point,
+        base_seed=base_seed, max_ticks=max_ticks, check_every=check_every,
+        aot=aot, seeds_per_point=seeds_per_point,
+    )
+    got = runner.eval(points)
+    cliffs = {}
+    curves = {}
+    for l in losses:
+        curve = [(d, got[(d, l)]) for d in range(max_dose + 1)]
+        curves[l] = curve
+        at, jump = locate_cliff(curve)
+        cliffs[l] = {"cliff_at": at, "jump": jump}
+    return {
+        "losses": list(losses),
+        "max_dose": max_dose,
+        "cliffs": cliffs,
+        "curves": curves,
+        **runner.result_fields(),
+    }

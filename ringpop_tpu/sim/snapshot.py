@@ -259,6 +259,113 @@ def load_state_orbax(path: str, example: T, shardings=None) -> T:
     )
 
 
+# -- fleet carry checkpoints (r19) -------------------------------------------
+#
+# ``save_state_orbax``/``load_state_orbax`` above take ONE flat NamedTuple
+# state.  The scenario fleet's resumable unit is a nested CARRY — batched
+# engine state + batched telemetry counters + per-replica first-detection
+# ticks + sweep progress — so these two generalize the same orbax
+# machinery to any pytree: leaves are stored under "/"-joined tree-path
+# names (stable across processes by construction — same structure), each
+# process writes/reads ONLY its shards (``_orbax_mp_options`` barriers),
+# and the restore target's shardings are independent of the save-time
+# partition, which is how a 2-process sweep checkpoint restores onto 1 or
+# 4 processes (``parallel.partition.fleet_shard_put`` names the layout).
+
+
+def _flatten_named(tree) -> dict:
+    """Pytree -> flat {path-name: leaf} dict in ``jax.tree`` leaf order
+    (None legs are structure, not leaves — they round-trip through the
+    example's treedef, not the store).  Names join with "." — "/" is
+    orbax/tensorstore's own path separator."""
+    import jax
+
+    from ringpop_tpu.parallel.partition import _path_name
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        name = _path_name(path).replace("/", ".")
+        if name in out:
+            raise ValueError(f"carry flattens to duplicate leaf name {name!r}")
+        out[name] = leaf
+    return out
+
+
+def save_carry_orbax(path: str, carry) -> None:
+    """Checkpoint an arbitrary pytree carry (the fleet's states +
+    telemetry + detection freeze) via orbax, each process writing ONLY
+    its addressable shards.  Synchronous — the fleet sweep checkpoints
+    at block boundaries and the kill-and-restore certificate needs the
+    write complete before the run may die."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.Checkpointer(
+        ocp.StandardCheckpointHandler(), **_orbax_mp_options()
+    ) as ckptr:
+        ckptr.save(
+            os.path.abspath(path),
+            args=ocp.args.StandardSave(_flatten_named(carry)),
+            force=True,
+        )
+
+
+def load_carry_orbax(path: str, example, shardings=None):
+    """Restore a :func:`save_carry_orbax` checkpoint into the structure
+    of ``example`` (arrays or ShapeDtypeStructs).  ``shardings`` — an
+    optional MATCHING pytree of NamedSharding — restores each leaf as a
+    sharded ``jax.Array`` with every process reading only its own
+    shards; because the target sharding is independent of the sharding
+    at save time, this is how a sweep killed at P processes resumes at
+    P' (the fleet_scale certificate).  Shape/dtype validated explicitly
+    (same orbax caveat as :func:`load_state_orbax`)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    flat_ex = _flatten_named(example)
+    flat_sh = _flatten_named(shardings) if shardings is not None else {}
+    if flat_sh and sorted(flat_sh) != sorted(flat_ex):
+        raise ValueError(
+            "shardings tree does not match the example carry: "
+            f"{sorted(flat_sh)} vs {sorted(flat_ex)}"
+        )
+    target = {
+        name: jax.ShapeDtypeStruct(
+            np.shape(v), v.dtype, sharding=flat_sh.get(name)
+        )
+        for name, v in flat_ex.items()
+    }
+    with ocp.Checkpointer(
+        ocp.StandardCheckpointHandler(), **_orbax_mp_options()
+    ) as ckptr:
+        data = ckptr.restore(
+            os.path.abspath(path), args=ocp.args.StandardRestore(target)
+        )
+    for name, want in target.items():
+        got = data[name]
+        if np.shape(got) != want.shape or got.dtype != want.dtype:
+            # got.dtype, never np.asarray(got): a process-spanning shard
+            # cannot materialize on one host and the diagnostic must not
+            # die trying
+            raise ValueError(
+                f"{path}: carry leaf {name!r} is "
+                f"{np.shape(got)}/{got.dtype}, expected "
+                f"{want.shape}/{want.dtype} — wrong fleet config?"
+            )
+    leaves = [
+        (v if isinstance(v, jax.Array) else jnp.asarray(v))
+        for v in (data[name] for name in flat_ex)
+    ]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(example), leaves
+    )
+
+
 # -- host-plane membership export/import -------------------------------------
 
 

@@ -335,13 +335,19 @@ def fetch(
     return record, fresh
 
 
-def split_batched(record: dict, extra: Optional[dict] = None) -> list[dict]:
+def split_batched(
+    record: dict, extra: Optional[dict] = None, id_base: int = 0
+) -> list[dict]:
     """Split ONE batched block record (every value ``[B]``-leading, the
     output of a vmapped :func:`fetch`) into B per-scenario host records,
     each tagged ``scenario_id`` — the one ``device_get`` that replaces B
     per-scenario round-trips in the Monte-Carlo fleet.  ``extra`` merges
     additional ``[B]`` columns (e.g. per-replica state digests) before
-    the split.  Scalars (no leading axis) broadcast to every record."""
+    the split.  Scalars (no leading axis) broadcast to every record.
+    ``id_base`` offsets the ids — rank r of a process-sliced fleet tags
+    its records with GLOBAL scenario ids (``id_base = lo`` of its
+    ``process_block`` slice), so journals from different ranks merge
+    without collisions."""
     host = jax.device_get({**record, **(extra or {})})
     b = max(
         (np.asarray(v).shape[0] for v in host.values() if np.ndim(v) >= 1),
@@ -352,7 +358,7 @@ def split_batched(record: dict, extra: Optional[dict] = None) -> list[dict]:
         sliced = {
             k: (np.asarray(v)[i] if np.ndim(v) >= 1 else v) for k, v in host.items()
         }
-        out.append({"scenario_id": i, **_to_host(sliced)})
+        out.append({"scenario_id": id_base + i, **_to_host(sliced)})
     return out
 
 

@@ -164,6 +164,26 @@ def _leaf_descriptor(leaf) -> str:
     return desc
 
 
+def sharding_descriptor(tree) -> str:
+    """Compact placement signature of a pytree: the set of distinct
+    mesh-axis/spec descriptors its leaves carry (empty string for an
+    all-unsharded tree).  Entry-point memo keys that cache ``call``
+    wrappers per program fold this in so a mesh-sharded fleet never
+    shares a memo slot with its unsharded twin — the r19
+    fleet-sharding descriptor (the per-leaf avals are already covered
+    by ``_leaf_descriptor``; this is the cheap tree-level discriminant
+    for keys built before leaves are enumerated)."""
+    import jax
+
+    descs = set()
+    for leaf in jax.tree.leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None):
+            descs.add(f"{dict(mesh.shape)}:{getattr(sh, 'spec', '')}")
+    return ";".join(sorted(descs))
+
+
 def signature_key(tag: str, statics, leaves) -> str:
     """16-hex deterministic key: tag + static config reprs + leaf
     descriptors + toolchain fingerprint + package-source fingerprint

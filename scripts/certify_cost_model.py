@@ -88,6 +88,22 @@ story"):
   keys/s pricing of the same plane is the ksweep ``serve_fanin``
   section, behind the TPU gate.)
 
+- (r19) the million-replica scenario fleet: ``fleet_scale`` — also
+  host-level (SIMBENCH_r13.json), judged with or without a ksweep
+  capture.  The fleet model says: batch-axis process slicing is
+  bit-exact per scenario (P=2 digests+scores == P=1 unbroken) AND
+  actually shards residency (max per-rank peak RSS at P=2 < 0.75 of
+  P=1); a mid-sweep orbax fleet checkpoint restores onto a DIFFERENT
+  process count and reproduces the unbroken run's digests and score
+  records bit-exactly; the GSPMD batch-mesh twin is digest-equal; and
+  the adaptive cliff driver lands the dense 1-dose grid's cliff
+  coordinate at <= 1/4 the scenario-evaluations.  Any inequality, an
+  RSS fraction >= 0.75, or a cheaper-than-claimed search that missed
+  the coordinate REFUTES.  (The real-chip batch-sharded-vs-replicated
+  pricing is the ksweep ``fleet_scale`` section, behind the TPU gate:
+  bit-unequal or slower than the replicated layout beyond noise
+  REFUTES — batch sharding must be free compute, pure HBM headroom.)
+
 Usage: ``python scripts/certify_cost_model.py [capture.json]``
 (defaults to the newest ksweep capture found).
 """
@@ -281,6 +297,52 @@ def judge_serve_fanin():
     )
 
 
+def judge_fleet_scale():
+    """The r19 scenario-fleet verdict from the committed
+    SIMBENCH_r13.json — host-certifiable, judged with or without a
+    ksweep capture.  Returns a (name, ok, detail) tuple, or None when
+    the artifact does not exist."""
+    path = os.path.join(REPO, "SIMBENCH_r13.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return ("scenario fleet at scale", None, f"unreadable SIMBENCH_r13.json: {e}")
+    sc = next(
+        (s for s in data.get("scenarios", [])
+         if str(s.get("metric", "")).startswith("fleet_scale")),
+        None,
+    )
+    if sc is None:
+        return ("scenario fleet at scale", None,
+                "SIMBENCH_r13.json carries no fleet_scale scenario")
+    ad = sc.get("adaptive") or {}
+    rss = sc.get("rss_frac")
+    ok = (
+        bool(sc.get("digests_equal")) and bool(sc.get("scores_equal"))
+        and bool(sc.get("restore_exact"))
+        and rss is not None and rss < 0.75
+        and bool((sc.get("twin") or {}).get("equal"))
+        and bool(ad.get("cliffs_match"))
+        and ad.get("evals_ratio") is not None and ad["evals_ratio"] <= 0.25
+    )
+    return (
+        f"scenario fleet at scale (B={sc.get('b')}, n={sc.get('n_nodes')}, "
+        f"k={sc.get('k')})",
+        ok,
+        f"digests_equal={sc.get('digests_equal')} "
+        f"scores_equal={sc.get('scores_equal')} "
+        f"restore_exact={sc.get('restore_exact')} (P=2 save -> P=1 restore); "
+        f"RSS frac {rss} (< 0.75 required, {sc.get('rss_p2_max_mb')} vs "
+        f"{sc.get('rss_p1_mb')} MB); twin={(sc.get('twin') or {}).get('equal')}; "
+        f"adaptive cliff {ad.get('cliffs')} == dense at evals ratio "
+        f"{ad.get('evals_ratio')} (<= 0.25 required, "
+        f"{ad.get('evals_adaptive')}/{ad.get('evals_dense')})",
+    )
+
+
 def _print_solo(host_verdicts) -> int:
     """Render the host-level verdicts (dcn_wire r15, swing_overlap r16)
     when no on-chip capture is judgeable — these claims never wait on
@@ -307,7 +369,8 @@ def _print_solo(host_verdicts) -> int:
 
 
 def main() -> int:
-    host = [judge_dcn_wire(), judge_swing_overlap(), judge_serve_fanin()]
+    host = [judge_dcn_wire(), judge_swing_overlap(), judge_serve_fanin(),
+            judge_fleet_scale()]
     path = sys.argv[1] if len(sys.argv) > 1 else newest_ksweep()
     if not path:
         print("no ksweep capture found (run make tpu-watch and wait for a window)")
@@ -523,6 +586,25 @@ def main() -> int:
              f"batched {b_ms} vs sequential {s_ms} ms/tick "
              f"(amortization {round(s_ms / max(b_ms, 1e-9), 2)}x), "
              f"bit_equal={mc.get('bit_equal')}")
+        )
+    # the r19 batch-sharded fleet on real chips: the batch axis shards
+    # over the mesh with NO cross-batch collectives, so the model says
+    # sharded == replicated per tick (free compute, pure HBM headroom);
+    # slower beyond noise or any scenario divergence REFUTES.
+    fl = cap.get("fleet_scale") or {}
+    if "error" in fl:
+        verdicts.append(("batch-sharded fleet (mesh batch axis)", None, fl["error"]))
+    elif fl.get("sharded_ms_per_tick_median") is not None and fl.get(
+        "replicated_ms_per_tick_median"
+    ) is not None:
+        s_ms, r_ms = fl["sharded_ms_per_tick_median"], fl["replicated_ms_per_tick_median"]
+        ok = bool(fl.get("bit_equal")) and s_ms <= r_ms * 1.05
+        verdicts.append(
+            (f"batch-sharded fleet (B={fl.get('b')}, n={fl.get('n')}, "
+             f"{fl.get('n_devices')} chips)",
+             ok,
+             f"batch-sharded {s_ms} vs batch-replicated {r_ms} ms/tick, "
+             f"bit_equal={fl.get('bit_equal')}")
         )
     # the r13 serve-tier dispatch: bit-equal to the host walk and >= 2x a
     # host bisect process per key, else the shared-ring premise is refuted

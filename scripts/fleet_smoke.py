@@ -1,0 +1,139 @@
+"""fleet-smoke — the CI gate for the r19 million-replica scenario fleet
+(block-sharded fleets + resume-exact checkpoints + adaptive cliff
+search).
+
+Two legs, both correctness-only (scale and RSS are priced by the
+committed SIMBENCH ``fleet_scale`` artifact, never asserted on the CI
+container):
+
+1. **Kill-and-restore across process counts**: a tiny scenario grid runs
+   three ways through ``cli/fleet_bench.py`` — P=1 unbroken; P=2 with a
+   MID-SWEEP fleet checkpoint (each rank writing only its shards) that
+   then CONTINUES; and a P=1 restore of that P=2 checkpoint (a different
+   process count than the saver).  All three must land identical
+   per-scenario state digests AND identical score records, bit for bit.
+
+2. **Adaptive vs dense cliff search**: ``scenarios.refine_surface`` must
+   find the dense 1-dose grid's cliff coordinate with strictly fewer
+   scenario-evaluations, through ONE compiled fleet program.
+
+Exit 0 on success, 1 with a diagnosis on any failure.
+
+Usage:
+    python scripts/fleet_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def main() -> int:
+    failures: list[str] = []
+
+    # -- 1: kill-and-restore across process counts ---------------------------
+    from multihost_launch import launch
+
+    ck = os.path.join(tempfile.mkdtemp(prefix="fleet_smoke_"), "ck")
+    grid_args = [
+        "--n", "256", "--k", "16", "--b-doses", "4", "--losses", "0.0,0.1",
+        "--churn-max", "8", "--horizon", "48", "--journal-every", "16",
+        "--suspect-ticks", "6",
+    ]
+    worker = ["-m", "ringpop_tpu.cli.fleet_bench"]
+    try:
+        unbroken = launch(1, worker + ["sweep"] + grid_args)[0]["records"][0]
+        saved = launch(
+            2, worker + ["sweep", "--save-at", "32", "--path", ck] + grid_args
+        )
+        restored = launch(
+            1, worker + ["sweep-restore", "--path", ck] + grid_args
+        )[0]["records"][0]
+    except Exception as e:  # noqa: BLE001 — the diagnosis IS the product
+        print("fleet-smoke: FAIL")
+        print(f"  - launcher leg died: {type(e).__name__}: {e}")
+        return 1
+
+    dig_p2: dict = {}
+    scores_p2: list = []
+    for r in saved:
+        rec = r["records"][0]
+        dig_p2.update(rec["digests"])
+        scores_p2 += rec["scores"]
+    scores_p2.sort(key=lambda s: s["scenario_id"])
+
+    if unbroken["digests"] != dig_p2:
+        failures.append(
+            f"P=2 (mid-sweep save) digests diverge from P=1 unbroken: "
+            f"{dig_p2} vs {unbroken['digests']}"
+        )
+    if unbroken["scores"] != scores_p2:
+        failures.append("P=2 score records diverge from P=1 unbroken")
+    if unbroken["digests"] != restored["digests"]:
+        failures.append(
+            f"P=2-save -> P=1-restore digests diverge: {restored['digests']} "
+            f"vs {unbroken['digests']}"
+        )
+    if unbroken["scores"] != restored["scores"]:
+        failures.append("restored score records diverge from unbroken run")
+    if restored.get("resumed", {}).get("saved_process_count") != 2:
+        failures.append(
+            f"restore-proof header wrong: {restored.get('resumed')}"
+        )
+
+    # -- 2: adaptive vs dense cliff coordinates ------------------------------
+    import numpy as np
+
+    from ringpop_tpu.sim import lifecycle, scenarios
+    from ringpop_tpu.util.accel import configure_compile_cache
+
+    configure_compile_cache()
+    n = 512
+    params = lifecycle.LifecycleParams(n=n, k=16)
+    rng = np.random.default_rng(0)
+    victims = sorted(rng.choice(n, size=4, replace=False).tolist())
+    kw = dict(
+        victims=victims, losses=(0.0,), max_dose=64, churn_seed=777,
+        max_ticks=1024, check_every=1,
+    )
+    ad = scenarios.refine_surface(params, coarse=9, **kw)
+    de = scenarios.dense_surface(params, **kw)
+    ad_at = ad["cliffs"][0.0]["cliff_at"]
+    de_at = de["cliffs"][0.0]["cliff_at"]
+    if ad_at != de_at or ad_at is None:
+        failures.append(
+            f"adaptive cliff {ad_at} != dense {de_at} "
+            f"(adaptive points {ad['points'][0.0]})"
+        )
+    if not ad["evals_unique"] < de["evals_unique"]:
+        failures.append(
+            f"adaptive used {ad['evals_unique']} evals vs dense "
+            f"{de['evals_unique']} — no saving"
+        )
+
+    if failures:
+        print("fleet-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print(
+        f"fleet-smoke: OK — B={unbroken['b']} fleet: P=1 unbroken == P=2 "
+        f"(mid-sweep save, each rank its own shards) == P=2-save->P=1-restore "
+        f"({len(unbroken['digests'])} digests + {len(unbroken['scores'])} "
+        f"score records bit-exact); adaptive cliff at dose {ad_at} == dense "
+        f"({ad['evals_unique']} vs {de['evals_unique']} scenario-evals, "
+        f"{ad['dispatches']} dispatches)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

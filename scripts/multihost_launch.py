@@ -82,16 +82,38 @@ def launch(
                 cwd=REPO,
             )
         )
-    out = []
-    failure = None
-    for rank, p in enumerate(procs):
+    # drain every rank's pipes CONCURRENTLY: a sequential communicate()
+    # walk deadlocks the job the moment any later rank writes more than a
+    # pipe buffer (~64 KB) to stdout — that rank blocks mid-write and
+    # never reaches the coordinated exit, while the earlier rank waits
+    # for it inside jax.distributed teardown (observed at fleet scale,
+    # where a rank's record line is ~0.5 MB)
+    import threading
+
+    drained: list = [None] * nprocs
+
+    def _drain(rank: int, p) -> None:
         try:
-            stdout, stderr = p.communicate(timeout=timeout_s)
+            drained[rank] = p.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             for q in procs:
                 if q.poll() is None:
                     q.kill()
-            stdout, stderr = p.communicate()
+            drained[rank] = p.communicate() + ("timeout",)
+
+    threads = [
+        threading.Thread(target=_drain, args=(rank, p), daemon=True)
+        for rank, p in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = []
+    failure = None
+    for rank, p in enumerate(procs):
+        stdout, stderr = drained[rank][0], drained[rank][1]
+        if len(drained[rank]) > 2:
             failure = failure or f"rank {rank} timed out after {timeout_s}s"
         records = []
         if os.path.exists(logs[rank]):

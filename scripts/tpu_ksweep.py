@@ -28,6 +28,14 @@ every section so a mid-run tunnel death still leaves partial evidence):
    stepped sequentially, both warm; sharded (batch replicated,
    node/rumor canonical) when >1 chip.  Judged by certify_cost_model:
    the fleet must be no slower per tick and bit-equal per scenario.
+1f. **fleet_scale** — the r19 block-sharded fleet: the SAME stacked grid
+   stepped with its batch axis ON the mesh (``make_fleet_mesh`` — B
+   shards over the chips, per-chip residency divides by the batch
+   factor) vs the r12 batch-replicated layout.  No cross-batch
+   collectives exist, so the model says batch sharding is free compute
+   and pure HBM headroom: certify_cost_model REFUTES if the sharded
+   fleet is slower beyond noise or any scenario's final state diverges
+   (bit_equal).
 2. Headline detection at the official config (k=256, 1000 victims),
    fresh state, wall + ticks; cross-checked against the cost model.
 3. Convergence (view-checksum agreement + quiescence) continuing from
@@ -817,6 +825,84 @@ def main() -> None:
         )
     except Exception as e:  # pragma: no cover - hardware-dependent
         out.setdefault("mc_chaos", {})["error"] = f"{type(e).__name__}: {e}"[:300]
+    flush()
+
+    # -- 1f: fleet_scale — batch axis ON the mesh vs batch-replicated -------
+    # The r19 claim on real chips: sharding the replica axis itself
+    # (make_fleet_mesh + the canonical partition table's batch prefix)
+    # costs nothing per tick — scenarios are independent, GSPMD adds no
+    # cross-batch collectives — while per-chip residency divides by the
+    # batch factor.  A/B against the r12 batch-REPLICATED fleet at the
+    # same config, bit_equal per scenario required.
+    try:
+        import functools as _ft
+
+        from ringpop_tpu.sim import chaos, montecarlo, scenarios
+
+        n_fl = int(os.environ.get("KSWEEP_FLEET_N", 16384))
+        k_fl = 64
+        fl_ticks = block
+        n_dev = len(jax.devices())
+        sec = {"n": n_fl, "k": k_fl, "block_ticks": fl_ticks, "n_devices": n_dev}
+        out["fleet_scale"] = sec
+        if n_dev <= 1 or out["platform"] == "cpu":
+            sec["error"] = "needs >1 real device (batch axis has nothing to shard over)"
+        else:
+            rng3 = np.random.default_rng(2)
+            fl_victims = sorted(rng3.choice(n_fl, size=8, replace=False).tolist())
+            doses = scenarios.mc_churn_doses(n_dev * 4, n_fl // 32)
+            plan, meta = scenarios.scenario_grid(
+                n_fl, victims=fl_victims, doses=doses, losses=(0.0, 0.05),
+                churn_seed=778,
+            )
+            seeds = scenarios.grid_seeds(meta, 0)
+            b_fl = len(meta)
+            sec["b"] = b_fl
+            params_fl = lifecycle.LifecycleParams(
+                n=n_fl, k=k_fl, suspect_ticks=10, rng="counter"
+            )
+            blk = jax.jit(
+                _ft.partial(montecarlo._mc_block, params_fl),
+                static_argnames="ticks",
+            )
+            from jax.sharding import Mesh
+
+            rumor = 2 if n_dev % 2 == 0 else 1
+            mesh_rep = Mesh(
+                np.asarray(jax.devices()).reshape(n_dev // rumor, rumor),
+                ("node", "rumor"),
+            )
+            mesh_batch = montecarlo.make_fleet_mesh(n_dev, (n_dev, 1, 1))
+            sec["mesh_batch"] = f"{n_dev}x1x1 (batch x node x rumor)"
+            sec["mesh_replicated"] = f"{n_dev // rumor}x{rumor} (node x rumor)"
+            sides = {}
+            for label, mesh in (("replicated", mesh_rep), ("sharded", mesh_batch)):
+                st = montecarlo.init_replicas(params_fl, seeds, mesh=mesh)
+                pl = jax.tree.map(
+                    jax.device_put, plan,
+                    montecarlo.fleet_faults_shardings(plan, mesh),
+                )
+                st = blk(st, pl, ticks=fl_ticks)
+                jax.block_until_ready(st.learned)  # compile + warm block
+                per_rep = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    st = blk(st, pl, ticks=fl_ticks)
+                    jax.block_until_ready(st.learned)
+                    per_rep.append(time.perf_counter() - t0)
+                sec[f"{label}_ms_per_tick_median"] = round(
+                    sorted(per_rep)[len(per_rep) // 2] / fl_ticks * 1e3, 3
+                )
+                sides[label] = st
+                flush()
+            # one host transfer per fleet leaf per side
+            host_a = [np.asarray(x) for x in jax.tree_util.tree_leaves(sides["replicated"])]
+            host_b = [np.asarray(x) for x in jax.tree_util.tree_leaves(sides["sharded"])]
+            sec["bit_equal"] = all(
+                bool((a == b).all()) for a, b in zip(host_a, host_b)
+            )
+    except Exception as e:  # pragma: no cover - hardware-dependent
+        out.setdefault("fleet_scale", {})["error"] = f"{type(e).__name__}: {e}"[:300]
     flush()
 
     # -- 2+3: headline detection then convergence at the official config ----

@@ -1,0 +1,149 @@
+"""Resume-exact fleet checkpoints (r19 tentpole leg 2).
+
+The claim under pin: a long-horizon scored sweep killed mid-flight and
+restored from its orbax carry checkpoint reproduces the unbroken run's
+per-scenario state digests AND score records bit-exactly — the carry
+holds batched state (tick + PRNG position ride inside it), batched
+telemetry counters, and the sidecar holds sweep progress plus the
+already-fetched block records (native JSON scalars, value-exact round
+trip).  The multi-process flavor (each process writing only its shards,
+restore onto a DIFFERENT process count) is certified by ``make
+fleet-smoke`` / simbench ``fleet_scale``; these tests pin the
+single-process and virtual-mesh paths plus the carry store itself.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ringpop_tpu.sim import lifecycle, scenarios, snapshot
+from ringpop_tpu.sim.montecarlo import make_fleet_mesh
+
+N, K = 128, 16
+PARAMS = dict(n=N, k=K, suspect_ticks=6, rng="counter")
+
+
+def _grid():
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=[0, 4], losses=(0.0, 0.1), churn_seed=777
+    )
+    return plan, meta, scenarios.grid_seeds(meta, 0)
+
+
+def _sweep(**kw):
+    params = lifecycle.LifecycleParams(**PARAMS)
+    plan, meta, seeds = _grid()
+    return scenarios.FleetSweep(
+        params, plan, meta, seeds, horizon=48, journal_every=16, **kw
+    )
+
+
+def test_kill_and_restore_bit_exact(tmp_path):
+    unbroken = _sweep().run()
+    want_scores, want_digests = unbroken.scores(), unbroken.digests()
+
+    sweep = _sweep()
+    sweep.run(until_tick=32)
+    ck = os.path.join(tmp_path, "ck")
+    sweep.save(ck)
+    del sweep
+
+    params = lifecycle.LifecycleParams(**PARAMS)
+    plan, meta, seeds = _grid()
+    resumed = scenarios.FleetSweep.restore(ck, params, plan, meta, seeds)
+    assert resumed.ticks_done == 32
+    assert resumed.resumed["from_tick"] == 32
+    resumed.run()
+    assert resumed.digests() == want_digests
+    assert resumed.scores() == want_scores
+    # restore-proof header fields (OBSERVABILITY.md fleet schema)
+    hp = resumed.header_params()
+    assert hp["resumed"]["restored_process_count"] == 1
+    assert hp["ticks_done"] == 48
+
+
+def test_restore_onto_fleet_mesh_bit_exact(tmp_path):
+    """A checkpoint saved unsharded restores onto the batch-sharded
+    virtual mesh (the shardings come from the restore target, not the
+    store) and continues digest-equal."""
+    unbroken = _sweep().run()
+    want = unbroken.digests()
+
+    sweep = _sweep()
+    sweep.run(until_tick=16)
+    ck = os.path.join(tmp_path, "ck")
+    sweep.save(ck)
+
+    params = lifecycle.LifecycleParams(**PARAMS)
+    plan, meta, seeds = _grid()
+    mesh = make_fleet_mesh(8, (2, 4, 1))
+    resumed = scenarios.FleetSweep.restore(ck, params, plan, meta, seeds, mesh=mesh)
+    from jax.sharding import PartitionSpec as P
+
+    assert resumed.mc.states.pcount.sharding.spec == P("batch", "node", "rumor")
+    resumed.run()
+    assert resumed.digests() == want
+    assert resumed.scores() == unbroken.scores()
+
+
+def test_save_mid_sweep_does_not_perturb(tmp_path):
+    """Saving is observation, not interference: a sweep that checkpoints
+    mid-flight and keeps going lands the unbroken digests."""
+    unbroken = _sweep().run()
+    sweep = _sweep()
+    sweep.run(until_tick=16)
+    sweep.save(os.path.join(tmp_path, "ck"))
+    sweep.run()
+    assert sweep.digests() == unbroken.digests()
+    assert sweep.scores() == unbroken.scores()
+
+
+def test_restore_refuses_wrong_config(tmp_path):
+    sweep = _sweep()
+    sweep.run(until_tick=16)
+    ck = os.path.join(tmp_path, "ck")
+    sweep.save(ck)
+    plan, meta, seeds = _grid()
+    wrong = lifecycle.LifecycleParams(n=N, k=K, suspect_ticks=7, rng="counter")
+    with pytest.raises(ValueError, match="checkpoint was taken with"):
+        scenarios.FleetSweep.restore(ck, wrong, plan, meta, seeds)
+    with pytest.raises(ValueError, match="sidecars"):
+        scenarios.FleetSweep.restore(
+            os.path.join(tmp_path, "nope"),
+            lifecycle.LifecycleParams(**PARAMS), plan, meta, seeds,
+        )
+
+
+def test_run_refuses_off_boundary_checkpoint_target():
+    sweep = _sweep()
+    with pytest.raises(ValueError, match="block boundary"):
+        sweep.run(until_tick=17)
+
+
+def test_carry_orbax_round_trip_nested(tmp_path):
+    """save_carry_orbax/load_carry_orbax: nested pytree with None legs
+    round-trips bit-exactly; a shape drift refuses."""
+    from ringpop_tpu.sim.telemetry import TelemetryState, zeros
+
+    params = lifecycle.LifecycleParams(n=64, k=16)
+    tel = zeros(params)  # suspects_by_tier None: structure, not leaves
+    carry = {
+        "states": {"x": jnp.arange(12, dtype=jnp.int32).reshape(3, 4)},
+        "telemetry": tel,
+        "first": jnp.asarray([1, -1, 3], jnp.int32),
+    }
+    path = os.path.join(tmp_path, "carry")
+    snapshot.save_carry_orbax(path, carry)
+    out = snapshot.load_carry_orbax(path, carry)
+    assert isinstance(out["telemetry"], TelemetryState)
+    assert out["telemetry"].suspects_by_tier is None
+    for a, b in zip(jax.tree.leaves(carry), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    bad = dict(carry, first=jnp.zeros(5, jnp.int32))
+    with pytest.raises(Exception):  # orbax raises on structure/shape drift
+        snapshot.load_carry_orbax(path, bad)

@@ -1,0 +1,174 @@
+"""Block-sharded scenario fleets (r19 tentpole leg 1): the batch axis on
+the mesh.
+
+The claim under pin: a fleet whose ``[B, ...]`` arrays shard their
+REPLICA axis over a ``make_fleet_mesh`` device mesh (states, telemetry
+accumulator, stacked fault legs — all via the canonical partition table)
+runs bit-identically, scenario for scenario, to the unsharded fleet:
+same per-member state digests, same telemetry block records, same
+first-detection ticks.  Scenarios are independent, so batch sharding
+adds no collectives that could reassociate anything — the certificate is
+exact equality, not tolerance.
+
+Includes the r18 follow-up: topology overlays (``scenario_grid(
+overlays=...)``) through the SHARDED fleet — previously only the flat
+fleet had a sharded twin pin.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ringpop_tpu.sim import chaos, lifecycle, scenarios, telemetry
+from ringpop_tpu.sim.montecarlo import (
+    MonteCarlo,
+    fleet_faults_shardings,
+    fleet_state_shardings,
+    make_fleet_mesh,
+)
+
+N, K = 128, 16
+PARAMS = dict(n=N, k=K, suspect_ticks=6, rng="counter")
+
+
+@pytest.fixture(scope="module")
+def fleet_mesh():
+    # 8 virtual CPU devices (conftest): 2-way batch x 4-way node
+    return make_fleet_mesh(8, (2, 4, 1))
+
+
+@pytest.fixture(scope="module")
+def grid():
+    rng = np.random.default_rng(0)
+    victims = sorted(rng.choice(N, size=2, replace=False).tolist())
+    plan, meta = scenarios.scenario_grid(
+        N, victims=victims, doses=[0, 4], losses=(0.0, 0.1), churn_seed=777
+    )
+    return victims, plan, meta, scenarios.grid_seeds(meta, 0)
+
+
+def test_fleet_state_shardings_batch_axis(fleet_mesh):
+    fs = fleet_state_shardings(fleet_mesh, k=32)
+    assert fs.pcount.spec == P("batch", "node", "rumor")
+    assert fs.base_status.spec == P("batch", "node")
+    assert fs.tick.spec == P("batch")
+    assert fs.r_subject.spec == P("batch", "rumor")
+
+
+def test_fleet_faults_shardings_batched_vs_shared_legs(fleet_mesh, grid):
+    _, plan, _, _ = grid
+    sh = fleet_faults_shardings(plan, fleet_mesh)
+    # stacked legs carry the batch prefix over their canonical spec
+    assert sh.base_up.spec == P("batch", "node")
+    assert sh.drop_rate.spec == P("batch")
+    # legs no member set stay None
+    assert (plan.reach is None) == (sh.reach is None)
+    # a SOLO plan's legs keep the canonical placement, no batch prefix
+    solo = chaos.scenario_plan("churn", N, seed=0, horizon=64)
+    ssh = fleet_faults_shardings(solo, fleet_mesh)
+    assert solo.crash_tick is not None
+    assert ssh.crash_tick.spec == P("node")
+
+
+def test_sharded_fleet_digest_equal_per_scenario(fleet_mesh, grid):
+    """run() + fetch_telemetry through the batch-sharded mesh: every
+    per-scenario record — digest AND every counter — equals the
+    unsharded fleet's."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    _, plan, _, seeds = grid
+    mc_u = MonteCarlo(params, seeds, telemetry=True)
+    mc_s = MonteCarlo(params, seeds, telemetry=True, mesh=fleet_mesh)
+    # placement engaged: the batch axis is genuinely sharded
+    assert mc_s.states.pcount.sharding.spec == P("batch", "node", "rumor")
+    mc_u.run(24, plan)
+    mc_s.run(24, plan)
+    for ru, rs in zip(mc_u.fetch_telemetry(plan), mc_s.fetch_telemetry(plan)):
+        assert ru == rs, (ru["scenario_id"],)
+
+
+def test_sharded_detection_loop_equal(fleet_mesh, grid):
+    """run_until_detected (the while-loop program, telemetry carried)
+    lands identical first-detection ticks and state digests sharded vs
+    unsharded."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    victims, plan, _, seeds = grid
+    mc_u = MonteCarlo(params, seeds, telemetry=True)
+    mc_s = MonteCarlo(params, seeds, telemetry=True, mesh=fleet_mesh)
+    tu, du = mc_u.run_until_detected(victims, plan, max_ticks=256, check_every=4)
+    ts, ds = mc_s.run_until_detected(victims, plan, max_ticks=256, check_every=4)
+    assert [int(t) for t in tu] == [int(t) for t in ts]
+    assert list(du) == list(ds)
+    assert mc_u.fetch_telemetry(plan) == mc_s.fetch_telemetry(plan)
+
+
+def test_overlay_grid_sharded_twin(fleet_mesh):
+    """r18 topology overlays through the SHARDED fleet: a
+    ``scenario_grid(overlays=...)`` batch (tier legs, zone-loss windows)
+    on the batch-sharded mesh is digest-equal per member to its
+    unsharded twin — today's pin extends the flat-fleet-only one."""
+    from ringpop_tpu.sim import topology
+
+    params = lifecycle.LifecycleParams(**PARAMS)
+    overlays = [
+        ("none", None),
+        ("zone_loss", topology.topo_scenario_plan("zone_loss", N, seed=1, horizon=64)),
+    ]
+    plan, meta = scenarios.scenario_grid(
+        N, victims=[3, 9], doses=[0, 4], losses=(0.0,),
+        overlays=overlays, churn_seed=7,
+    )
+    seeds = scenarios.grid_seeds(meta, 0)
+    mc_u = MonteCarlo(params, seeds, telemetry=True, telemetry_tiers=True)
+    mc_s = MonteCarlo(
+        params, seeds, telemetry=True, telemetry_tiers=True, mesh=fleet_mesh
+    )
+    mc_u.run(32, plan)
+    mc_s.run(32, plan)
+    ru, rs = mc_u.fetch_telemetry(plan), mc_s.fetch_telemetry(plan)
+    assert [r["overlay"] for r in (dict(m, **r) for m, r in zip(meta, ru))]
+    for m, (a, b) in zip(meta, zip(ru, rs)):
+        assert a == b, (m["overlay"], m["scenario_id"])
+    # the per-tier keys actually rode the sharded fetch
+    assert any(k.startswith("suspects_") for k in ru[0])
+
+
+def test_slice_plan_matches_index_plan(grid):
+    _, plan, _, _ = grid
+    b = chaos.plan_batch_size(plan)
+    part = chaos.slice_plan(plan, 1, 3)
+    assert chaos.plan_batch_size(part) == 2
+    for j, src in enumerate(range(1, 3)):
+        want = chaos.index_plan(plan, src)
+        got = chaos.index_plan(part, j)
+        for f in want._fields:
+            w, g = getattr(want, f), getattr(got, f)
+            assert (w is None) == (g is None), f
+            if w is not None:
+                np.testing.assert_array_equal(np.asarray(w), np.asarray(g), err_msg=f)
+    with pytest.raises(ValueError, match="slice"):
+        chaos.slice_plan(plan, 3, 1)
+    # full-range slice round-trips the batch size
+    assert chaos.plan_batch_size(chaos.slice_plan(plan, 0, b)) == b
+
+
+def test_fleet_shard_put_gather_round_trip(fleet_mesh):
+    """partition.fleet_shard_put places a local batch block as a global
+    batch-sharded array; fleet_host_gather inverts it (single-process:
+    local == all)."""
+    from jax.sharding import Mesh
+
+    from ringpop_tpu.parallel.partition import fleet_host_gather, fleet_shard_put
+
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:8]), ("batch",))
+    tree = {
+        "a": np.arange(8 * 6, dtype=np.int32).reshape(8, 6),
+        "b": np.arange(8, dtype=np.float32),
+    }
+    placed = fleet_shard_put(tree, mesh, 8)
+    assert placed["a"].sharding.spec == P("batch", None)
+    back = fleet_host_gather(placed)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"], tree["b"])

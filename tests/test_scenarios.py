@@ -106,13 +106,46 @@ def test_default_legs_are_value_neutral():
         )
 
 
-def test_run_until_detected_refuses_armed_telemetry():
-    # the fleet detection loop does not carry the counter accumulator —
-    # it must refuse loudly rather than pair advanced state with stale
-    # counters in the next fetch_telemetry journal
-    mc = MonteCarlo(lifecycle.LifecycleParams(**PARAMS), [0], telemetry=True)
-    with pytest.raises(ValueError, match="telemetry"):
-        mc.run_until_detected([3], max_ticks=16)
+def test_run_until_detected_carries_armed_telemetry():
+    """r19 (was a refusal in r12): the fleet detection loop CARRIES an
+    armed accumulator in its while carry, so long-horizon sweeps journal
+    counters without falling back to fixed-horizon stepping.  The
+    fetched block must cover exactly the ticks the lockstep fleet
+    stepped, match a solo telemetry run of the same length field for
+    field, and the state must equal the telemetry-off run's (counters
+    never perturb the trajectory)."""
+    params = lifecycle.LifecycleParams(**PARAMS)
+    victims = [3]
+    up = np.ones(N, bool)
+    up[victims] = False
+    faults = delta.DeltaFaults(up=jnp.asarray(up))
+    mc = MonteCarlo(params, [0], telemetry=True)
+    ticks, det = mc.run_until_detected(
+        victims, faults, max_ticks=256, check_every=8
+    )
+    assert bool(det[0])
+    rec = mc.fetch_telemetry(faults)[0]
+
+    mc_off = MonteCarlo(params, [0])
+    ticks_off, det_off = mc_off.run_until_detected(
+        victims, faults, max_ticks=256, check_every=8
+    )
+    assert int(ticks[0]) == int(ticks_off[0]) and bool(det[0]) == bool(det_off[0])
+    assert rec["state_digest"] == int(
+        telemetry.tree_digest(jax.tree.map(lambda x: x[0], mc_off.states))
+    )
+
+    # the counters cover every tick the lockstep fleet actually stepped
+    # (first-detection ticks are a lower bound; here B=1 so they agree)
+    total = int(rec["ticks"])
+    assert total == int(ticks[0])
+    sink = telemetry.TelemetrySink()
+    sim = lifecycle.LifecycleSim(seed=0, telemetry=sink, **PARAMS)
+    sim.run(total, faults)
+    solo = sink.records[0]
+    for key in ("ping_send", "ping_req_send", "refuted", "decl_suspect",
+                "decl_faulty", "timer_fired", "ticks"):
+        assert rec[key] == solo[key], key
 
 
 # -- B=1 / heterogeneous bit-identity (the ISSUE 7 acceptance pins) ----------
@@ -272,6 +305,67 @@ def test_response_surface_and_cliff():
     at, jump = scenarios.locate_cliff(list(zip(surf["cols"], surf["cells"][0])))
     assert (at, jump) == (20, 29.0)
     assert scenarios.locate_cliff([(0, None), (1, 5)]) == (None, None)
+
+
+def test_locate_cliff_contract():
+    """The explicit empty/short-input contract (r19): (None, None) ONLY
+    for curves too short to define a jump; (None, 0.0) for well-defined
+    curves with no positive jump; ties break to the larger dose."""
+    # too short: empty, single point, all-undetected
+    assert scenarios.locate_cliff([]) == (None, None)
+    assert scenarios.locate_cliff([(5, 12)]) == (None, None)
+    assert scenarios.locate_cliff([(0, None), (1, None)]) == (None, None)
+    assert scenarios.locate_cliff([(0, None), (1, 5)]) == (None, None)
+    # monotone-flat / non-increasing: a curve with NO cliff, jump 0.0
+    assert scenarios.locate_cliff([(0, 10), (1, 10), (2, 10)]) == (None, 0.0)
+    assert scenarios.locate_cliff([(0, 30), (1, 20), (2, 10)]) == (None, 0.0)
+    # the 2-cell windows the adaptive driver hands it
+    assert scenarios.locate_cliff([(4, 10), (5, 40)]) == (5, 30)
+    assert scenarios.locate_cliff([(4, 10), (5, 10)]) == (None, 0.0)
+    # tie on jump -> larger dose
+    assert scenarios.locate_cliff([(0, 0), (1, 10), (2, 20)]) == (2, 10)
+
+
+def test_refine_surface_matches_dense_with_fewer_evals():
+    """The adaptive driver on a surface with a dominant cliff: identical
+    cliff coordinate to the dense 1-dose grid, strictly fewer
+    scenario-evaluations, ONE compiled program for every dispatch."""
+    n = 512
+    params = lifecycle.LifecycleParams(n=n, k=16)
+    rng = np.random.default_rng(0)
+    victims = sorted(rng.choice(n, size=4, replace=False).tolist())
+    kw = dict(
+        victims=victims, losses=(0.0,), max_dose=64, churn_seed=777,
+        max_ticks=1024, check_every=1,
+    )
+    ad = scenarios.refine_surface(params, coarse=9, **kw)
+    de = scenarios.dense_surface(params, **kw)
+    assert de.get("all_detected") and ad.get("all_detected")
+    assert ad["cliffs"][0.0]["cliff_at"] == de["cliffs"][0.0]["cliff_at"]
+    assert ad["cliffs"][0.0]["jump"] == de["cliffs"][0.0]["jump"]
+    assert ad["evals_unique"] < de["evals_unique"] / 2
+    # O(log) outer loop: coarse + bisect rounds + verify, not O(doses)
+    assert ad["dispatches"] <= 3 + int(np.ceil(np.log2(64)))
+
+
+def test_refine_runner_compiles_once():
+    """Value-only plan swaps: with the AOT front door on, every
+    dispatch of the adaptive driver's runner reuses the ONE keyed
+    program — different doses, losses and seeds are value swaps, never
+    new signatures (the memo gains the fleet sharding descriptor, so
+    this is also the key-stability pin)."""
+    n = 256
+    params = lifecycle.LifecycleParams(n=n, k=16)
+    masks = scenarios.dose_mask_table(n, [3, 9], 16, churn_seed=7)
+    runner = scenarios._CliffRunner(
+        params, [3, 9], masks, width=4, base_seed=0, max_ticks=512,
+        check_every=4, aot="refine-test",
+    )
+    runner.eval([(0, 0.0), (4, 0.0), (8, 0.0), (12, 0.0)])
+    runner.eval([(2, 0.05), (6, 0.1)])  # new doses AND new loss values
+    assert runner.dispatches == 2
+    assert len(runner.mc._aot_calls) == 1
+    assert runner.result_fields()["compiled_programs"] == 1
 
 
 def test_scored_fleet_verdicts_carry_grid_coordinates():
