@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke
 
 all: native test
 
@@ -20,8 +20,26 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
+
+# live-operations-plane gate (r20, obs/): a P=2 in-process fleet sweep
+# serving its live endpoint mid-run — /progress shows BOTH ranks'
+# ticks_done, /metrics aggregated counters equal the ranks' journal
+# sums exactly, live-plane-on digests == plane-off (bit-transparency),
+# and killing one rank mid-sweep leaves a flight-recorder dump whose
+# last block record matches that rank's journal tail.
+live-smoke:
+	$(PY) scripts/live_smoke.py
+
+# span-tracing gate (r20, obs/trace.py): a forwarded key's span chain
+# (frontend route -> per-owner forward RPC -> receive-side handle ->
+# quorum-read wave) reconstructs from the JSONL journal alone with hop
+# counts equal to the ringpop-hops header values, span ids are
+# rerun-deterministic (sampled by key hash), and the P=2 serve mesh's
+# digests are bit-identical tracing-on vs off.
+trace-smoke:
+	$(PY) scripts/trace_smoke.py
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
 # parseable JSONL journal AND end digest-equal to a telemetry-off run;
@@ -124,9 +142,12 @@ aot-smoke:
 # response-leg crossing sends issued off PARTIAL request-leg receives,
 # interleaved with the merge (exit 5 if the fused leg loop regressed to
 # a strictly sequential dependency graph).
+# --fail-unattributed (r20): every censused collective must carry a
+# named-scope phase — '(unattributed)' was a printed warning the doc
+# already called a coverage bug; CI now fails on it (exit 6).
 profile-mesh:
 	$(PY) scripts/profile_mesh.py --step-n 8192 --step-k 64 --detect-n 8192 \
-	  --force-sparse --chaos --overlap \
+	  --force-sparse --chaos --overlap --fail-unattributed \
 	  --compare captures/mesh_profile_small_budget.json \
 	  --phase-budget --out /tmp/mesh_profile_small.json
 

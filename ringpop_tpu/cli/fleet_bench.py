@@ -96,6 +96,11 @@ def main(argv=None) -> int:
                    help="sweep leg: checkpoint the carry at this tick "
                    "(a journal block boundary), then continue")
     p.add_argument("--path", default=None, help="fleet checkpoint dir")
+    p.add_argument("--live-port", type=int,
+                   default=int(os.environ.get("RINGPOP_OBS_PORT", "0") or 0),
+                   help="serve the live operations plane (/metrics "
+                   "/healthz /progress) on this port (0 = off; the "
+                   "launcher exports RINGPOP_OBS_PORT = base + rank)")
     args = p.parse_args(argv)
 
     import jax
@@ -124,12 +129,48 @@ def main(argv=None) -> int:
     plan_s = chaos.slice_plan(plan, lo, hi)
     meta_s, seeds_s = meta[lo:hi], seeds[lo:hi]
 
+    # live operations plane (r20, opt-in): a per-rank pull endpoint with
+    # rank-0 cross-rank aggregation over its OWN obs fabric, plus a
+    # flight recorder armed on fabric failures and uncaught exceptions —
+    # a rank that dies mid-sweep leaves its last blocks behind.
+    ops = None
+    live_addr = None
+    if args.live_port:
+        # the ops plane must never take the rank down: a failed HTTP
+        # bind (port collision) keeps the collector (other ranks still
+        # aggregate this one), and a failed LiveOps bring-up runs the
+        # sweep dark — both reported, neither fatal
+        try:
+            from ringpop_tpu.obs.endpoint import LiveOps
+            from ringpop_tpu.obs.flight import FlightRecorder
+
+            kv = None
+            if distributed and nprocs > 1:
+                from ringpop_tpu.parallel.fabric import DistributedKV
+
+                kv = DistributedKV()
+            recorder = FlightRecorder(rank=rank).install()
+            ops = LiveOps(rank, nprocs, recorder=recorder, kv=kv)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"kind": "live", "rank": rank,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+        if ops is not None:
+            try:
+                live_addr = ops.serve(port=args.live_port)
+            except OSError as e:
+                print(json.dumps({"kind": "live", "rank": rank,
+                                  "error": f"bind: {e}"}), flush=True)
+            else:
+                print(json.dumps({"kind": "live", "rank": rank,
+                                  "addr": live_addr}), flush=True)
+
     t0 = time.perf_counter()
     if args.leg == "sweep":
         sweep = scenarios.FleetSweep(
             params, plan_s, meta_s, seeds_s, horizon=args.horizon,
             journal_every=args.journal_every, scenario="fleet_scale",
-            global_b=b,
+            global_b=b, obs=ops,
         )
         save_s = None
         if args.save_at:
@@ -141,7 +182,7 @@ def main(argv=None) -> int:
     else:
         sweep = scenarios.FleetSweep.restore(
             args.path, params, plan_s, meta_s, seeds_s,
-            scenario="fleet_scale", global_b=b,
+            scenario="fleet_scale", global_b=b, obs=ops,
         )
         sweep.run()
     rec = {
@@ -166,7 +207,11 @@ def main(argv=None) -> int:
     if args.leg == "sweep" and args.save_at:
         rec["saved_at"] = args.save_at
         rec["save_s"] = save_s
+    if live_addr is not None:
+        rec["live_addr"] = live_addr
     _emit(rec)
+    if ops is not None:
+        ops.close()
     if distributed and nprocs > 1:
         # explicit exit barrier through the coordination-service client
         # (plain gRPC, the same channel _orbax_mp_options routes orbax's

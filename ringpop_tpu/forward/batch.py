@@ -31,8 +31,18 @@ key-hash batches over the ``net/channel.py`` framing:
   mid-read (``sim/chaos.py``) is scored — recovery rides
   ``chaos.score_blocks`` over the wave journal.
 
+Span tracing (r20, ``obs/trace.py``): every class takes an optional
+``Tracer``.  A batch holding a sampled key (sampling is a pure function
+of the key hash — reruns trace the same requests) carries the
+``ringpop-trace`` header (trace id + parent span id) NEXT TO
+``ringpop-hops``, and each leg — frontend route, per-owner forward RPC,
+receive-side handle, quorum wave — emits a ``kind:"span"`` record whose
+``hops`` field is exactly the hop count the header carried.  Tracing off
+(the default) is the identical code path with zero records.
+
 Top-level imports stay jax-free (frontends import this without paying a
-backend init); the quorum chaos harness imports ``sim.chaos`` lazily.
+backend init; ``obs.trace`` is numpy+stdlib); the quorum chaos harness
+imports ``sim.chaos`` lazily.
 """
 
 from __future__ import annotations
@@ -51,6 +61,7 @@ from ringpop_tpu.net.channel import (
     decode_array,
     encode_array,
 )
+from ringpop_tpu.obs.trace import TRACE_HEADER, salt_of
 
 _logger = logging_mod.logger("forward.batch")
 
@@ -94,6 +105,7 @@ class BatchForwarder:
         timeout: float = 3.0,
         max_hops: int = DEFAULT_MAX_HOPS,
         fabric_arrays: bool = False,
+        tracer=None,
     ):
         self.channel = channel
         self.service = service
@@ -107,6 +119,10 @@ class BatchForwarder:
         # decoder is self-describing, so the unmodified serve endpoints
         # answer either lane
         self.fabric_arrays = fabric_arrays
+        # tracer: an obs.trace.Tracer — batches holding a sampled key
+        # carry the ringpop-trace header and emit a "forward" span per
+        # RPC (None = tracing off, the bit-identical default)
+        self.tracer = tracer
         self._codec = getattr(channel, "codec", "json")
         self.rpcs = 0
         self.keys_forwarded = 0
@@ -121,17 +137,32 @@ class BatchForwarder:
             "batches_failed": self.batches_failed,
         }
 
-    async def forward_batch(self, dest: str, hashes, n: int = 1, hops: int = 0):
+    async def forward_batch(
+        self, dest: str, hashes, n: int = 1, hops: int = 0, parent=None,
+        salt: int = 0,
+    ):
         """-> (owners int32[B] or int32[B, n], generation).  ``hops`` is
         how many forwards this batch has ALREADY crossed; the guard fires
         before the wire so a loop costs ``max_hops`` RPCs total, not a
-        timeout storm."""
+        timeout storm.  ``parent`` (a span id) parents this RPC's span
+        when a tracer is attached and the batch holds a sampled key."""
         if hops >= self.max_hops:
             raise MaxHopsExceededError(
                 f"batch of {len(hashes)} keys crossed {hops} forwards "
                 f"(max_hops={self.max_hops}) — routing loop"
             )
         headers = {FORWARDED_HEADER: "true", HOPS_HEADER: str(hops + 1)}
+        span = None
+        if self.tracer is not None:
+            # the span's hops field is EXACTLY the ringpop-hops value on
+            # the wire — the acceptance join checks that equality
+            span = self.tracer.begin(
+                "forward", hashes, parent=parent, hops=hops + 1,
+                salt=salt_of(dest, hops + 1, salt), dest=dest,
+                endpoint=self.endpoint, n=n,
+            )
+            if span is not None:
+                headers[TRACE_HEADER] = span.header_value()
         body = {
             "h": encode_array(
                 hashes, self._codec, "<u4", fabric=self.fabric_arrays
@@ -147,16 +178,20 @@ class BatchForwarder:
                     headers=headers, timeout=self.timeout,
                 )
                 break
-            except RemoteError:
+            except RemoteError as e:
                 # the remote HANDLER executed and raised (e.g. a deeper
                 # hop guard): deterministic, and retrying would multiply
                 # every hop level's RPCs by the retry count — a routing
                 # loop must cost max_hops RPCs total, not 3^max_hops
                 self.batches_failed += 1
+                if span is not None:
+                    span.finish(ok=False, retries=attempt, error=str(e))
                 raise
             except CallError as e:
                 if attempt >= self.max_retries:
                     self.batches_failed += 1
+                    if span is not None:
+                        span.finish(ok=False, retries=attempt, error=str(e))
                     raise
                 delay = self.retry_delays[min(attempt, len(self.retry_delays) - 1)]
                 attempt += 1
@@ -174,9 +209,13 @@ class BatchForwarder:
         # generations of several answerers mid-churn; plain serve
         # endpoints return the scalar "gen" (their whole answer came
         # from one snapshot)
-        if "g" in res:
-            return owners, decode_array(res["g"], "<i4")
-        return owners, int(res["gen"])
+        gens = decode_array(res["g"], "<i4") if "g" in res else int(res["gen"])
+        if span is not None:
+            g = gens if isinstance(gens, int) else (
+                int(gens.max(initial=0)) if gens.size else 0
+            )
+            span.finish(ok=True, retries=attempt, gen=g)
+        return owners, gens
 
 
 def rank_of_hashes(tokens: np.ndarray, hashes, nprocs: int) -> np.ndarray:
@@ -227,12 +266,22 @@ class BlockRouter:
         self.keys_local = 0
         self.keys_forwarded = 0
 
-    async def route(self, hashes, n: int = 1, hops: int = 0):
+    async def route(self, hashes, n: int = 1, hops: int = 0, parent=None):
         """-> (owners int32[B] or [B, n], gens int32[B]) in input order.
         ``gens`` is exact per key even across re-forwards — the handler
-        ships the per-key array back, never a collapsed scalar."""
+        ships the per-key array back, never a collapsed scalar.
+        ``parent`` parents this route's span (a frontend call passes
+        None; the receive-side handler passes its own span)."""
         hashes = np.asarray(hashes, np.uint32)
         b = hashes.shape[0]
+        tracer = self.forwarder.tracer
+        route_span = None
+        if tracer is not None:
+            route_span = tracer.begin(
+                "route", hashes, parent=parent, hops=hops,
+                salt=salt_of("route", self.rank, hops), rank=self.rank,
+            )
+        route_parent = None if route_span is None else route_span.span
         ranks = rank_of_hashes(self.tokens_fn(), hashes, self.nprocs)
         owners = np.full((b, n) if n > 1 else b, -1, np.int32)
         gens = np.full(b, -1, np.int32)
@@ -251,7 +300,8 @@ class BlockRouter:
             results = await asyncio.gather(
                 *(
                     self.forwarder.forward_batch(
-                        self.peer_addrs[r], hashes[ix], n=n, hops=hops
+                        self.peer_addrs[r], hashes[ix], n=n, hops=hops,
+                        parent=route_parent,
                     )
                     for r, ix in groups.items()
                 )
@@ -260,6 +310,12 @@ class BlockRouter:
                 owners[ix] = rows
                 gens[ix] = gen
                 self.keys_forwarded += len(ix)
+        if route_span is not None:
+            route_span.finish(
+                keys_local=int(local.sum()),
+                keys_forwarded=int((~local).sum()),
+                owners=len(remote_ranks),
+            )
         return owners, gens
 
     def handler(self):
@@ -270,7 +326,25 @@ class BlockRouter:
         async def handle(body: dict, headers: dict) -> dict:
             hashes = decode_array(body["h"], "<u4")
             n = int(body.get("n", 1))
-            owners, gens = await self.route(hashes, n=n, hops=hop_count(headers))
+            hops = hop_count(headers)
+            tracer = self.forwarder.tracer
+            handle_span = None
+            if tracer is not None:
+                # traced iff the ringpop-trace header is present — the
+                # sender made the sampling decision; the header's span
+                # id (the sender's forward span) becomes the parent
+                handle_span = tracer.follow(
+                    headers, "handle", salt=salt_of("handle", self.rank, hops),
+                    rank=self.rank, nkeys=int(hashes.shape[0]),
+                )
+            owners, gens = await self.route(
+                hashes, n=n, hops=hops,
+                parent=None if handle_span is None else handle_span.span,
+            )
+            if handle_span is not None:
+                handle_span.finish(
+                    gen=int(gens.max(initial=0)) if gens.size else 0
+                )
             codec = getattr(self.forwarder.channel, "codec", "json")
             return {
                 "o": encode_array(owners, codec, "<i4"),
@@ -334,14 +408,27 @@ class QuorumReader:
         self.r = r
         self.quorum = quorum_size(r)
 
-    async def quorum_wave(self, tokens, owners, n_servers: int, hashes) -> dict:
+    async def quorum_wave(
+        self, tokens, owners, n_servers: int, hashes, parent=None,
+        salt: int = 0,
+    ) -> dict:
         """One read wave.  Returns the wave record: per-key ack counts,
         quorum/full-ack fractions, agreement, and the RPC count (the
-        O(owners) pricing evidence)."""
+        O(owners) pricing evidence).  With a traced forwarder, the wave
+        emits a ``quorum_wave`` span parenting each per-owner read RPC —
+        the quorum-read leg of the acceptance chain."""
         from ringpop_tpu.ops.ring_ops import host_lookup_n
 
         hashes = np.asarray(hashes, np.uint32)
         b = hashes.shape[0]
+        tracer = self.forwarder.tracer
+        wave_span = None
+        if tracer is not None:
+            wave_span = tracer.begin(
+                "quorum_wave", hashes, parent=parent,
+                salt=salt_of("wave", salt), r=self.r, quorum=self.quorum,
+            )
+        wave_parent = None if wave_span is None else wave_span.span
         pref = host_lookup_n(tokens, owners, hashes, self.r, n_servers)  # [B, r]
         # group (key, replica) assignments by owning server
         by_owner: dict[int, list[int]] = {}
@@ -355,7 +442,8 @@ class QuorumReader:
             ix = np.asarray(keys, np.int64)
             try:
                 rows, _gen = await self.forwarder.forward_batch(
-                    self.server_addrs[owner], hashes[ix], n=1
+                    self.server_addrs[owner], hashes[ix], n=1,
+                    parent=wave_parent, salt=salt,
                 )
             except (CallError, MaxHopsExceededError):
                 return  # a dead/partitioned replica simply contributes no ack
@@ -369,6 +457,12 @@ class QuorumReader:
         agree = all(
             len({int(v) for v in vals}) <= 1 for vals in answered.values()
         )
+        if wave_span is not None:
+            wave_span.finish(
+                owners=rpcs,
+                acks_min=int(acks.min()) if b else 0,
+                quorum_ok=bool((acks >= self.quorum).all()) if b else True,
+            )
         return {
             "keys": int(b),
             "r": self.r,

@@ -256,6 +256,11 @@ from ringpop_tpu.errors import (  # noqa: E402
     FabricTimeout,
 )
 
+# span tracing (r20): the header constant + salt helper are jax-free
+# (obs/trace.py) — BaseChannel.dispatch emits a transport-level server
+# span for requests that arrive with the ringpop-trace header
+from ringpop_tpu.obs.trace import TRACE_HEADER, salt_of  # noqa: E402
+
 
 class CallError(FabricError):
     """A call failed to complete (network error, black hole, timeout)."""
@@ -276,12 +281,20 @@ class RemoteError(CallError):
 
 
 class BaseChannel:
-    """Handler registry + dispatch shared by both transports."""
+    """Handler registry + dispatch shared by both transports.
+
+    ``tracer`` (an ``obs.trace.Tracer``; default None = off) emits one
+    ``kind:"span"`` record per dispatched request that arrived with the
+    ``ringpop-trace`` header — the transport-level server leg, between
+    the sender's RPC span (its parent, from the header) and whatever the
+    handler itself traces.  The sampling decision was the CALLER's: a
+    headerless request costs one dict lookup and nothing else."""
 
     def __init__(self, app: str = ""):
         self.app = app
         self.hostport: str = ""
         self._handlers: dict[tuple[str, str], Handler] = {}
+        self.tracer = None
 
     def register(self, service: str, endpoint: str, handler: Handler) -> None:
         self._handlers[(service, endpoint)] = handler
@@ -293,9 +306,29 @@ class BaseChannel:
         handler = self._handlers.get((service, endpoint))
         if handler is None:
             raise RemoteError(f"no handler for {service}::{endpoint}")
-        res = handler(body, headers)
-        if inspect.isawaitable(res):  # sync handlers are fine too
-            res = await res
+        span = None
+        if self.tracer is not None and TRACE_HEADER in (headers or {}):
+            # the header gate keeps untraced requests at ONE dict lookup
+            # (the documented cost) — salt hashing only runs for traced
+            # ones.  hops rides the salt so the same endpoint serving
+            # the same trace at two hop levels gets two distinct span
+            # ids (the parent folded into the id covers the rest).
+            span = self.tracer.follow(
+                headers, "server",
+                salt=salt_of(self.hostport, endpoint,
+                             str(headers.get("ringpop-hops", ""))),
+                endpoint=endpoint, service=service, hostport=self.hostport,
+            )
+        try:
+            res = handler(body, headers)
+            if inspect.isawaitable(res):  # sync handlers are fine too
+                res = await res
+        except Exception as e:
+            if span is not None:
+                span.finish(ok=False, error=str(e))
+            raise
+        if span is not None:
+            span.finish(ok=True)
         return res
 
     async def call(

@@ -1,0 +1,69 @@
+"""Live operations plane: in-memory stat aggregation, a pull-based HTTP
+endpoint, span tracing through the forwarding planes, and a crash flight
+recorder.
+
+Every observability surface this repo grew through r19 is post-hoc — the
+JSONL journals are read after the run ends.  The roadmap's current
+workloads cannot wait that long: week-long resumable fleet sweeps
+(``scenarios.FleetSweep``), multi-rank serve meshes under live traffic
+(``serve/mesh.py``), and real-OS-process launches via
+``scripts/multihost_launch.py``.  This package is the LIVE half of the
+telemetry plane, and it obeys the same bar the device plane set in r7:
+
+* **Bit-transparency.**  Everything here is host-plane only — it reads
+  records the engines already fetch and headers the transports already
+  carry.  A tracing-on, live-plane-on run is digest-identical to an
+  all-off run, and the device-side jaxpr is untouched (pinned by
+  ``tests/test_telemetry.py`` and the smoke gates).
+* **Never take the node down.**  Endpoint handlers, the cross-rank
+  collector, and the flight recorder swallow their own failures — an
+  ops-plane socket error must never kill a week-long sweep.
+* **jax-free imports.**  Frontend processes (the serve tier's jax-free
+  contract) import these modules without paying a backend init; anything
+  that needs the sim plane imports it lazily at call time.
+
+Pieces:
+
+* :mod:`~ringpop_tpu.obs.aggregate` — :class:`AggregatingStats`, the
+  snapshot-able ``StatsReporter`` (``util/metrics`` Histogram/Meter
+  backed) both stat planes can feed, plus the Prometheus text renderer.
+* :mod:`~ringpop_tpu.obs.endpoint` — :class:`LiveOps`: the per-rank
+  pull endpoint (``/metrics`` ``/healthz`` ``/progress``) with rank-0
+  cross-rank aggregation riding the fabric's tagged-message demux.
+* :mod:`~ringpop_tpu.obs.trace` — :class:`Tracer`: the ``ringpop-trace``
+  header (trace id + parent span id) next to ``ringpop-hops``,
+  deterministically sampled by key hash so reruns trace the SAME
+  requests; ``kind:"span"`` records for the existing JSONL journals.
+* :mod:`~ringpop_tpu.obs.flight` — :class:`FlightRecorder`: a bounded
+  per-rank ring of the most recent block/span/stat records, dumped to a
+  post-mortem JSONL on ``FabricPeerLost``/``FabricTimeout``/uncaught
+  exception, so a rank that dies mid-sweep leaves its last seconds
+  behind.  Also :func:`git_commit`, the journal-header provenance
+  helper.
+"""
+
+_EXPORTS = {
+    "AggregatingStats": "ringpop_tpu.obs.aggregate",
+    "render_prometheus": "ringpop_tpu.obs.aggregate",
+    "LiveOps": "ringpop_tpu.obs.endpoint",
+    "Tracer": "ringpop_tpu.obs.trace",
+    "Span": "ringpop_tpu.obs.trace",
+    "JsonlSink": "ringpop_tpu.obs.trace",
+    "TRACE_HEADER": "ringpop_tpu.obs.trace",
+    "trace_id_of": "ringpop_tpu.obs.trace",
+    "FlightRecorder": "ringpop_tpu.obs.flight",
+    "git_commit": "ringpop_tpu.obs.flight",
+}
+
+
+def __getattr__(name):
+    # lazy like the serve package: importing the package costs nothing
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+__all__ = list(_EXPORTS)

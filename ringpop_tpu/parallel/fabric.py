@@ -434,14 +434,51 @@ class _RecvJob(NamedTuple):
     fut: _Future
 
 
-def _aggregate_raise(errs: Sequence[BaseException]) -> None:
+# -- failure hooks (the flight-recorder seam, r20) ----------------------------
+#
+# Observers of fabric-level failures: every callable registered here is
+# invoked (best-effort, exceptions swallowed — a diagnostic hook must
+# never mask the original failure) with the typed error at the moment a
+# link goes sticky or a round's errors aggregate into a raise.  The
+# obs-plane FlightRecorder registers here to dump a rank's last seconds
+# the instant its peer vanishes (FabricPeerLost) or wedges
+# (FabricTimeout).
+
+_FAILURE_HOOKS: list = []
+
+
+def add_failure_hook(fn) -> None:
+    """Register ``fn(err: BaseException)`` to observe fabric failures."""
+    _FAILURE_HOOKS.append(fn)
+
+
+def remove_failure_hook(fn) -> None:
+    try:
+        _FAILURE_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_failure(err: BaseException) -> None:
+    for fn in list(_FAILURE_HOOKS):
+        try:
+            fn(err)
+        except Exception:
+            pass
+
+
+def _aggregate_raise(errs: Sequence[BaseException], notify: bool = True) -> None:
     """Raise ``errs[0]`` with every OTHER error attached: chained via
     ``__context__`` (so one traceback shows the whole multi-peer outage)
     and collected on ``peer_errors`` for programmatic access.  Before r16
     a round that failed on several sender threads raised only ``errs[0]``
-    and silently dropped the rest."""
+    and silently dropped the rest.  ``notify=False`` (a
+    ``notify_failures=False`` fabric) skips the failure hooks."""
     if not errs:
         return
+    if notify:
+        for e in errs:
+            _notify_failure(e)
     primary = errs[0]
     rest = [e for e in errs[1:] if e is not primary]
     node = primary
@@ -521,6 +558,8 @@ class _PeerLink:
                     f"{self.fabric.timeout_ms} ms — peer wedged or partitioned"
                 )
                 self.send_err.__cause__ = e
+                if self.fabric.notify_failures:
+                    _notify_failure(self.send_err)
                 fut.fail(self.send_err)
             except OSError as e:
                 if self.fabric._closed:
@@ -535,6 +574,8 @@ class _PeerLink:
                     f"(tag {tag}) failed ({e}) — peer process died mid-exchange"
                 )
                 self.send_err.__cause__ = e
+                if self.fabric.notify_failures:
+                    _notify_failure(self.send_err)
                 fut.fail(self.send_err)
 
     def _recv_loop(self) -> None:
@@ -558,6 +599,8 @@ class _PeerLink:
                     self._drain_failed(self.recvq, err)
                     return
                 self.recv_err = e
+                if self.fabric.notify_failures:
+                    _notify_failure(e)
                 job.fut.fail(e)
             except BaseException as e:  # decode bugs must not hang waiters
                 self.recv_err = FabricError(
@@ -642,7 +685,22 @@ class ExchangeHandle:
         finally:
             self.waited_s += time.monotonic() - t0
         if errs:
-            _aggregate_raise(errs)
+            _aggregate_raise(errs, notify=self.fabric.notify_failures)
+        return out
+
+    def poll(self) -> Optional[dict[int, Union[list, BaseException]]]:
+        """Non-blocking completion probe of the RECEIVE side: ``None``
+        while any expectation is still outstanding, else a map ``peer ->
+        decoded arrays`` (or the typed error that leg failed with —
+        returned, not raised, so a poller can keep serving the live
+        peers).  Sends are untouched — accounting, sticky errors and the
+        overlap contract behave exactly as if this was never called.
+        The obs plane's rank-0 collector harvests rounds through this."""
+        out: dict[int, Union[list, BaseException]] = {}
+        for peer, fut in self._recv_futs:
+            if not fut.ev.is_set():
+                return None
+            out[peer] = fut.err if fut.err is not None else fut.value
         return out
 
     def sends_done_s(self) -> Optional[float]:
@@ -686,6 +744,7 @@ class Fabric:
         host: str = "127.0.0.1",
         timeout_ms: int = 120_000,
         codec: bool = True,
+        notify_failures: bool = True,
     ):
         if not 0 <= rank < nprocs:
             raise ValueError(f"rank {rank} outside [0, {nprocs})")
@@ -693,6 +752,12 @@ class Fabric:
         self.kv, self.ns = kv, namespace
         self.timeout_ms = timeout_ms
         self.codec = codec
+        # notify_failures=False opts this fabric OUT of the global
+        # failure hooks (obs/flight): the obs plane's own side-channel
+        # fabric tolerates rank skew as routine — its timeouts must not
+        # burn the flight recorder's once-per-process dump that exists
+        # for ENGINE fabric failures
+        self.notify_failures = notify_failures
         self.bytes_sent = 0  # actual wire bytes
         self.bytes_recv = 0
         self.raw_bytes_sent = 0  # what the same messages cost codec-off
@@ -950,7 +1015,7 @@ class Fabric:
             if link.send_err is not None
         ]
         if sticky:
-            _aggregate_raise(sticky)
+            _aggregate_raise(sticky, notify=self.notify_failures)
         send_futs: list[tuple[int, _Future]] = []
         # packing runs HERE, serially, and that is a deliberate trade:
         # program-order packing is what keeps the XOR history and the

@@ -36,6 +36,17 @@ Wire accounting comes straight off ``Fabric.wire_stats()`` (the r15
 codec is available to every forwarded batch; random key hashes are
 incompressible so the measured-raw fallback is the honest common case,
 and the split wire/raw counters prove nothing is hidden).
+
+Span tracing (r20): pass ``tracer=`` an ``obs.trace.Tracer`` and every
+round's cross-forwarded batch emits ``mesh_request`` (sender side) and
+``mesh_answer`` (owner side) spans for its sampled keys.  NO header
+crosses the fabric — both sides derive the SAME trace and span ids from
+the batch content + the deterministic (round, sender, owner) salt, so
+the answer span's ``parent`` is computed, not propagated, and the
+journal join works exactly as it does on the channel path.  Answer spans
+carry ``gen``, joinable against the serve tier's ``ring_update``
+records.  Host-plane only: digests are bit-identical tracer-on vs off
+(pinned by ``tests/test_serve_mesh.py`` and the trace smoke).
 """
 
 from __future__ import annotations
@@ -96,6 +107,7 @@ class ServeMesh:
         codec: bool = True,
         timeout_ms: int = 60_000,
         gen: int = 0,
+        tracer=None,
     ):
         if streams % nprocs:
             raise ValueError(
@@ -125,6 +137,7 @@ class ServeMesh:
             rank, nprocs, kv if kv is not None else LocalKV(),
             namespace=namespace, codec=codec, timeout_ms=timeout_ms,
         )
+        self.tracer = tracer
         self.keys_local = 0
         self.keys_forwarded_out = 0
         self.keys_answered_for_peers = 0
@@ -193,6 +206,25 @@ class ServeMesh:
                         [stream_hashes[s][ix] for s, ix in pending[p]]
                     ).astype(np.uint32)
                 ]
+        # mesh_request spans for sampled keys in each outbound batch —
+        # begun BEFORE the exchange so the span times the full
+        # frontend → owner → answer round trip; ids are pure functions
+        # of (content, rnd, sender, dest), so the owner derives them
+        # without any header crossing the fabric
+        req_spans: dict[int, object] = {}
+        if self.tracer is not None:
+            from ringpop_tpu.obs.trace import salt_of
+
+            for p in peers:
+                batch = sends[p][0]
+                if batch.shape[0]:
+                    sp = self.tracer.begin(
+                        "mesh_request", batch,
+                        salt=salt_of("mesh", rnd, self.rank, p),
+                        rank=self.rank, dest=p, rnd=rnd,
+                    )
+                    if sp is not None:
+                        req_spans[p] = sp
         tag_req = (rnd << 8) | _TAG_REQ
         h_req = self.fabric.exchange_async(tag_req, sends, peers)
         self.messages_sent += len(peers)
@@ -223,7 +255,32 @@ class ServeMesh:
             if b == 0:
                 resp[p] = [np.empty(0, np.int32)]
                 continue
+            answer_span = None
+            if self.tracer is not None:
+                from ringpop_tpu.obs.trace import (
+                    salt_of,
+                    span_id_of,
+                    trace_id_of,
+                )
+
+                keys = self.tracer.sampled_keys(np.asarray(req, np.uint32))
+                if keys.size:
+                    # the parent is the SENDER's mesh_request span id,
+                    # derived (not propagated): same trace, the sender's
+                    # (rnd, src=p, dest=me) salt
+                    trace = trace_id_of(int(keys[0]))
+                    answer_span = self.tracer.begin(
+                        "mesh_answer", np.asarray(req, np.uint32),
+                        parent=span_id_of(
+                            trace, "mesh_request",
+                            salt_of("mesh", rnd, p, self.rank),
+                        ),
+                        salt=salt_of("mesha", rnd, self.rank, p),
+                        rank=self.rank, src=p, rnd=rnd,
+                    )
             rows = self._answer(np.asarray(req, np.uint32))
+            if answer_span is not None:
+                answer_span.finish(gen=self.gen, answered=b)
             resp[p] = [
                 np.concatenate(
                     [rows.reshape(-1), np.asarray([self.gen], np.int32)]
@@ -244,6 +301,9 @@ class ServeMesh:
                 continue
             peer_gen = int(vec[-1])
             rows = np.asarray(vec[:-1], np.int32).reshape(-1, self.n)
+            sp = req_spans.get(p)
+            if sp is not None:
+                sp.finish(gen=peer_gen, answered=rows.shape[0])
             off = 0
             for s, ix in pending[p]:
                 answers[s][ix] = rows[off : off + ix.size]
@@ -320,6 +380,8 @@ def run_serve_mesh(
     seed: int = 0,
     codec: bool = True,
     namespace: Optional[str] = None,
+    trace_sink=None,
+    trace_sample: int = 64,
 ) -> list[dict]:
     """Drive a P-rank serve mesh on LocalKV threads (the same fabric code
     paths real OS processes run — r14's threaded-twin discipline) and
@@ -341,9 +403,18 @@ def run_serve_mesh(
     def worker(rank: int) -> None:
         mesh = None
         try:
+            tracer = None
+            if trace_sink is not None:
+                from ringpop_tpu.obs.trace import Tracer
+
+                # one Tracer per rank (rank stamped on every span); the
+                # sink must be thread-safe — JsonlSink locks, lists
+                # under the test harness are append-only per CPython
+                tracer = Tracer(trace_sink, sample=trace_sample, rank=rank)
             mesh = ServeMesh(
                 rank, nprocs, servers, replica_points=replica_points, n=n,
                 streams=streams, seed=seed, kv=kv, namespace=ns, codec=codec,
+                tracer=tracer,
             )
             out[rank] = mesh.run(rounds, keys_per_stream)
         except BaseException as e:  # noqa: BLE001 - surfaced to the driver

@@ -325,6 +325,7 @@ class FleetSweep:
         mesh=None,
         global_b: Optional[int] = None,
         telemetry_tiers: Optional[bool] = None,
+        obs=None,
     ):
         if len(meta) != len(list(seeds)):
             raise ValueError(f"{len(meta)} meta entries vs {len(list(seeds))} seeds")
@@ -356,6 +357,13 @@ class FleetSweep:
         self.blocks: dict[int, list[dict]] = {i: [] for i in ids}
         self.ticks_done = 0
         self.resumed: Optional[dict] = None
+        # obs: an obs.endpoint.LiveOps — the live operations plane.
+        # Host-plane only (it ingests the SAME fetched records the sink
+        # sees), so a live-plane-on sweep is bit-identical to off; every
+        # rank's sweep must attach one when any does (obs.sync() is a
+        # deterministic per-block collective on the obs fabric).
+        self.obs = obs
+        self._last_checkpoint_tick: Optional[int] = None
 
     def header_params(self) -> dict:
         """Restore-proof fields for a journal header (OBSERVABILITY.md
@@ -393,8 +401,21 @@ class FleetSweep:
             self.ticks_done += step
             for rec in self.mc.fetch_telemetry(self.plan, id_base=self.id_base):
                 self.blocks[rec["scenario_id"]].append(rec)
+                # obs first (it never raises): if the sink dies on this
+                # record, the flight ring already holds it — the dump's
+                # tail can only MATCH the journal's, never trail it
+                if self.obs is not None:
+                    self.obs.block_record(rec)
                 if self.sink is not None:
                     self.sink(rec)
+            if self.obs is not None:
+                # per-block heartbeat + one obs collection round (the
+                # same protocol point on every rank — non-blocking)
+                self.obs.progress(
+                    self.ticks_done, self.horizon,
+                    last_checkpoint_tick=self._last_checkpoint_tick,
+                )
+                self.obs.sync()
         return self
 
     def scores(self) -> list[dict]:
@@ -478,6 +499,12 @@ class FleetSweep:
         with open(tmp, "w") as f:
             json.dump(sidecar, f)
         os.replace(tmp, os.path.join(meta_dir, f"rank{rank}.json"))
+        self._last_checkpoint_tick = self.ticks_done
+        if self.obs is not None:
+            self.obs.progress(
+                self.ticks_done, self.horizon,
+                last_checkpoint_tick=self.ticks_done,
+            )
 
     @classmethod
     def restore(
@@ -493,6 +520,7 @@ class FleetSweep:
         mesh=None,
         global_b: Optional[int] = None,
         telemetry_tiers: Optional[bool] = None,
+        obs=None,
     ) -> "FleetSweep":
         """Resume a killed sweep — at THIS process count, which need not
         match the saver's.  ``plan``/``meta``/``seeds`` are the caller's
@@ -536,6 +564,7 @@ class FleetSweep:
             horizon=head["horizon"], journal_every=head["journal_every"],
             sink=sink, scenario=scenario or head.get("scenario", "mc_chaos"),
             mesh=mesh, global_b=global_b, telemetry_tiers=telemetry_tiers,
+            obs=obs,
         )
         if sweep.global_b != head["global_b"]:
             raise ValueError(
@@ -585,6 +614,9 @@ class FleetSweep:
         sweep.mc.states = carry["states"]
         sweep.mc.telemetry = carry["telemetry"]
         sweep.ticks_done = head["ticks_done"]
+        # the restored run came FROM a checkpoint at this tick — that is
+        # what /progress should report until the next save
+        sweep._last_checkpoint_tick = head["ticks_done"]
         for s in sidecars:
             for gid_s, recs in s.get("blocks", {}).items():
                 gid = int(gid_s)

@@ -511,6 +511,11 @@ class TelemetryJournal:
             pc, pid = jax.process_count(), jax.process_index()
         except Exception:  # backend not initialized yet — header still valid
             pc, pid = 1, 0
+        # git_commit (r20): the toolchain fingerprint names the
+        # interpreter world; this names the SOURCE world — a journal is
+        # provenance-complete without the repo it was produced in
+        from ringpop_tpu.obs.flight import git_commit
+
         self._write(
             {
                 "kind": "header",
@@ -518,6 +523,7 @@ class TelemetryJournal:
                 "scenario": scenario,
                 "params": params or {},
                 "toolchain": toolchain_fingerprint(),
+                "git_commit": git_commit(),
                 "mesh_budget": mesh_budget_fingerprint(),
                 "compile_cache": cache_status(),
                 "process_count": pc,
@@ -532,6 +538,13 @@ class TelemetryJournal:
         """Append a chaos-scenario verdict (``chaos.score_blocks``) —
         the record that makes a journal a SCORED journal."""
         self._write({**_to_host(record), "kind": "score"})
+
+    def span(self, record: dict) -> None:
+        """Append one ``kind:"span"`` record (``obs/trace.py`` — span
+        values are already host scalars; pass this method as a Tracer
+        sink so traces land in the run's own journal, joinable against
+        its block/``ring_update`` records)."""
+        self._write({**record, "kind": "span"})
 
     def _write(self, obj: dict) -> None:
         self._f.write(json.dumps(obj, sort_keys=True) + "\n")

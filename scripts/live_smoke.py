@@ -1,0 +1,292 @@
+"""live-smoke — the CI gate for the r20 live operations plane (obs/).
+
+Drives a P=2 IN-PROCESS fleet sweep (LocalKV threads — the same obs
+fabric code paths real OS processes run, r14's threaded-twin
+discipline) with the full live plane attached, and asserts:
+
+1. **/progress serves both ranks** — rank 0's endpoint reports every
+   rank's ``ticks_done``/``horizon`` (scraped over real HTTP, mid-run
+   when the container is slow enough to catch it, and at completion);
+2. **aggregation is exact** — the unlabeled ``/metrics`` aggregate of
+   ``ringpop_sim_ping_send`` equals the sum of BOTH ranks' journal
+   ``ping_send`` block sums (the cross-rank collector loses nothing);
+3. **bit-transparency** — a live-plane-on P=1 sweep lands per-scenario
+   digests and score records identical to a plane-off run;
+4. **the flight recorder leaves the last seconds behind** — killing
+   rank 1 mid-sweep (its journal sink raises at a block boundary)
+   produces a flight dump whose LAST block record equals the rank's
+   journal tail record exactly.
+
+Exit 0 on success, 1 with a diagnosis on any failure.  Wall cost is a
+few seconds (n=256, B=8) — wired into ``make test``.
+
+Usage:
+    python scripts/live_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _scrape(addr: str, path: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def main() -> int:
+    import numpy as np
+
+    from ringpop_tpu.obs.endpoint import LiveOps
+    from ringpop_tpu.obs.flight import FlightRecorder
+    from ringpop_tpu.parallel.fabric import LocalKV
+    from ringpop_tpu.parallel.partition import process_block
+    from ringpop_tpu.sim import chaos, scenarios, telemetry
+    from ringpop_tpu.sim.lifecycle import LifecycleParams
+
+    failures: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="livesmoke_")
+    n, k, horizon, journal_every, seed = 256, 16, 32, 8, 0
+
+    params = LifecycleParams(n=n, k=k, suspect_ticks=6, rng="counter")
+    rng = np.random.default_rng(seed)
+    victims = sorted(rng.choice(n, size=4, replace=False).tolist())
+    doses = scenarios.mc_churn_doses(4, n // 32)
+    plan, meta = scenarios.scenario_grid(
+        n, victims=victims, doses=doses, losses=(0.0, 0.1),
+        churn_seed=seed + 777,
+    )
+    seeds = scenarios.grid_seeds(meta, seed)
+    b = len(meta)
+
+    def rank_slice(rank, nprocs):
+        lo, hi = process_block(b, rank, nprocs)
+        return chaos.slice_plan(plan, lo, hi), meta[lo:hi], seeds[lo:hi]
+
+    def run_rank(rank, nprocs, kv, ns, journal_path, *, obs=None,
+                 kill_after_blocks=None):
+        """One rank's sweep; returns (sweep, journal records)."""
+        sink_seen = [0]
+
+        def killer(rec):
+            sink_seen[0] += 1
+            if (
+                kill_after_blocks is not None
+                and sink_seen[0] >= kill_after_blocks * len(meta_s)
+            ):
+                raise RuntimeError("live-smoke: simulated mid-sweep crash")
+
+        plan_s, meta_s, seeds_s = rank_slice(rank, nprocs)
+        with telemetry.TelemetryJournal(journal_path) as journal:
+            journal.header("montecarlo", "live_smoke", {"rank": rank})
+            sink = telemetry.TelemetrySink(journal=journal, fn=killer)
+            sweep = scenarios.FleetSweep(
+                params, plan_s, meta_s, seeds_s, horizon=horizon,
+                journal_every=journal_every, scenario="live_smoke",
+                global_b=b, sink=sink, obs=obs,
+            )
+            sweep.run()
+            # score inside the journal's lifetime (scores() writes the
+            # verdict records into it)
+            return sweep, sweep.digests(), sweep.scores()
+
+    # -- legs 1+2: P=2 live endpoint + exact aggregation ----------------------
+    kv = LocalKV()
+    opses: list = [None, None]
+    sweeps: list = [None, None]
+    errs: list = [None, None]
+    journals = [os.path.join(tmp, f"rank{r}.jsonl") for r in range(2)]
+    ready = threading.Barrier(2, timeout=60)
+
+    def worker(rank):
+        try:
+            ops = LiveOps(rank, 2, kv=kv, namespace="live-smoke")
+            opses[rank] = ops
+            ready.wait()
+            sweeps[rank] = run_rank(rank, 2, kv, "live-smoke",
+                                    journals[rank], obs=ops)
+        except BaseException as e:  # noqa: BLE001
+            errs[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    # serve rank 0's endpoint as soon as its LiveOps exists, then poll
+    # /progress while the sweep runs (best-effort mid-run observation)
+    addr = None
+    midrun_seen = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and addr is None:
+        if opses[0] is not None:
+            addr = opses[0].serve()
+        else:
+            time.sleep(0.01)
+    while any(t.is_alive() for t in threads):
+        if addr is not None:
+            try:
+                p = json.loads(_scrape(addr, "/progress"))
+                if len(p["ranks"]) == 2 and midrun_seen is None:
+                    midrun_seen = p
+            except OSError:
+                pass
+        time.sleep(0.02)
+    for t in threads:
+        t.join(60)
+    if any(errs):
+        print("live-smoke: FAIL")
+        print(f"  - a sweep rank died: {errs}")
+        return 1
+
+    # final /progress must show BOTH ranks at the horizon; poll briefly
+    # for the last obs round to land (sync is non-blocking by design)
+    prog = None
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        prog = json.loads(_scrape(addr, "/progress"))
+        done = [
+            r for r in prog["ranks"].values()
+            if r.get("ticks_done") == horizon
+        ]
+        if len(done) == 2:
+            break
+        time.sleep(0.05)
+    if prog is None or len(prog["ranks"]) != 2 or any(
+        r.get("ticks_done") != horizon or r.get("horizon") != horizon
+        for r in prog["ranks"].values()
+    ):
+        failures.append(f"/progress does not show both ranks done: {prog}")
+    if midrun_seen is not None and len(midrun_seen["ranks"]) != 2:
+        failures.append(f"mid-run /progress missing a rank: {midrun_seen}")
+
+    health = json.loads(_scrape(addr, "/healthz"))
+    if not health["ok"]:
+        failures.append(f"/healthz not ok on a healthy run: {health}")
+
+    metrics = _scrape(addr, "/metrics")
+    agg = None
+    for line in metrics.splitlines():
+        if line.startswith("ringpop_sim_ping_send ") and "{" not in line:
+            agg = float(line.split()[1])
+    journal_sum = 0
+    for path in journals:
+        journal_sum += sum(
+            int(r["ping_send"]) for r in telemetry.read_journal(path)
+            if r["kind"] == "block"
+        )
+    if agg is None:
+        failures.append("no aggregated ringpop_sim_ping_send in /metrics")
+    elif int(agg) != journal_sum:
+        failures.append(
+            f"aggregated counter {agg} != ranks' journal sum {journal_sum}"
+        )
+    for o in opses:
+        if o is not None:
+            o.close()
+
+    # -- leg 3: bit-transparency (plane-on == plane-off) ----------------------
+    _, bare_digests, bare_scores = run_rank(
+        0, 1, None, "", os.path.join(tmp, "bare.jsonl"))
+    ops1 = LiveOps(0, 1, recorder=FlightRecorder(
+        capacity=128, path=os.path.join(tmp, "fl0.jsonl")))
+    ops1.serve()
+    _, live_digests, live_scores = run_rank(
+        0, 1, None, "", os.path.join(tmp, "live.jsonl"), obs=ops1)
+    ops1.close()
+    if bare_digests != live_digests:
+        failures.append(
+            f"live plane perturbed the sweep: digests {live_digests} "
+            f"vs {bare_digests}"
+        )
+    if bare_scores != live_scores:
+        failures.append("live plane perturbed the score records")
+
+    # -- leg 4: kill a rank mid-sweep -> flight dump == journal tail ----------
+    kv2 = LocalKV()
+    flight_path = os.path.join(tmp, "flight-rank1.jsonl")
+    recorder1 = FlightRecorder(capacity=64, rank=1, path=flight_path)
+    recorder1.install(fabric=False, excepthook=False, threads=True)
+    kill_errs: list = [None, None]
+    ready2 = threading.Barrier(2, timeout=60)
+    kj = [os.path.join(tmp, f"kill-rank{r}.jsonl") for r in range(2)]
+
+    def kill_worker(rank):
+        ops = LiveOps(rank, 2, kv=kv2, namespace="live-kill",
+                      recorder=recorder1 if rank == 1 else None,
+                      timeout_ms=10_000)
+        ready2.wait()
+        try:
+            run_rank(rank, 2, kv2, "live-kill", kj[rank], obs=ops,
+                     kill_after_blocks=2 if rank == 1 else None)
+        finally:
+            if rank == 0:
+                ops.close()
+        # rank 1 leaves its ops open: the thread dies with the sweep,
+        # exactly like a crashed process
+
+    kt = []
+    for r in range(2):
+        t = threading.Thread(target=kill_worker, args=(r,))
+        t.start()
+        kt.append(t)
+    for t in kt:
+        t.join(120)
+    recorder1.uninstall()
+    if recorder1.dumped is None:
+        failures.append("killing rank 1 produced no flight dump")
+    else:
+        dump = [json.loads(x) for x in open(recorder1.dumped)]
+        head = dump[0]
+        if head["kind"] != "flight_header" or "crash" not in str(
+            head.get("error")
+        ):
+            failures.append(f"flight header malformed: {head}")
+        dump_blocks = [r for r in dump if r.get("kind") == "block"]
+        jr = [
+            r for r in telemetry.read_journal(kj[1]) if r["kind"] == "block"
+        ]
+        if not dump_blocks or not jr:
+            failures.append("kill leg produced no block records to compare")
+        else:
+            last_dump = {
+                kk: v for kk, v in dump_blocks[-1].items()
+                if kk != "flight_seq"
+            }
+            if last_dump != jr[-1]:
+                failures.append(
+                    "flight dump tail != rank 1 journal tail:\n"
+                    f"    dump:    {last_dump}\n    journal: {jr[-1]}"
+                )
+
+    if failures:
+        print("live-smoke: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(json.dumps({
+        "live_smoke": {
+            "ranks": 2,
+            "horizon": horizon,
+            "progress_midrun_seen": midrun_seen is not None,
+            "aggregated_ping_send": int(agg),
+            "journal_sum": journal_sum,
+            "digests_bit_identical": True,
+            "flight_dump": os.path.basename(recorder1.dumped or ""),
+        }
+    }))
+    print("live-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

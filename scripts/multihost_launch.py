@@ -42,6 +42,7 @@ def launch(
     devices_per_proc: int = 1,
     timeout_s: float = 900.0,
     env_extra: Optional[dict] = None,
+    live_port_base: Optional[int] = None,
 ) -> list[dict]:
     """Run ``python <argv>`` as ``nprocs`` coordinated ranks; return one
     record per rank: ``{"rank", "rc", "records" (parsed JSONL),
@@ -71,6 +72,12 @@ def launch(
                 "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )
+        if live_port_base:
+            # the live operations plane (r20): rank r serves /metrics,
+            # /healthz and /progress at base + r — workers that honor
+            # RINGPOP_OBS_PORT (cli/fleet_bench.py) pick it up; others
+            # ignore it
+            env["RINGPOP_OBS_PORT"] = str(live_port_base + rank)
         env.update(env_extra or {})
         procs.append(
             subprocess.Popen(
@@ -146,6 +153,10 @@ def main(argv=None) -> int:
     p.add_argument("--nprocs", type=int, default=2)
     p.add_argument("--devices-per-proc", type=int, default=1)
     p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--live-port-base", type=int, default=0,
+                   help="export RINGPOP_OBS_PORT=base+rank per rank so "
+                   "obs-aware workers serve their live endpoints there "
+                   "(0 = off)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker argv after '--' (passed to python)")
     args = p.parse_args(argv)
@@ -153,7 +164,8 @@ def main(argv=None) -> int:
     if not cmd:
         p.error("worker command required after --")
     ranks = launch(args.nprocs, cmd, devices_per_proc=args.devices_per_proc,
-                   timeout_s=args.timeout)
+                   timeout_s=args.timeout,
+                   live_port_base=args.live_port_base or None)
     for r in ranks:
         for rec in r["records"]:
             print(json.dumps({"rank": r["rank"], **rec}))

@@ -133,6 +133,13 @@ def main() -> None:
         "plane's zero-added-collectives ratchet)",
     )
     ap.add_argument(
+        "--fail-unattributed", action="store_true",
+        help="promote the '(unattributed)' phase warning to a hard "
+        "failure (exit 6): every censused collective must carry a "
+        "named-scope phase — OBSERVABILITY.md calls an unattributed "
+        "collective a coverage bug, so CI enforces it",
+    )
+    ap.add_argument(
         "--phase-budget", action="store_true",
         help="with --compare: also ratchet the per-phase table for "
         f"{PHASE_BUDGET_PHASES} (fails on per-phase regressions that an "
@@ -268,6 +275,7 @@ def _run(args, dump: str) -> int:
     # -- 1b) exchange overlap schedule (r11, --overlap): analyzed on the
     # step module BEFORE section 2 clears the dump dir
     overlap_rc = 0
+    unattributed_rc = 0
     if args.overlap:
         from ringpop_tpu.analysis import overlap as _overlap
 
@@ -342,9 +350,20 @@ def _run(args, dump: str) -> int:
                       f"{e['bytes'] / 1e6:>8.2f} MB")
         unattr = sec["by_phase"].get("(unattributed)")
         if unattr:
-            print("    WARNING: %d collectives carry no phase scope — extend "
-                  "the named_scope coverage in sim/lifecycle.py"
-                  % sum(e["count"] for e in unattr.values()))
+            n_unattr = sum(e["count"] for e in unattr.values())
+            if args.fail_unattributed:
+                # the doc calls this a coverage bug; under the CI flag it
+                # IS one — a collective outside every named scope can
+                # hide from the per-phase budget ratchet
+                print("    FAILURE: %d collectives in %r carry no phase "
+                      "scope — extend the named_scope coverage in "
+                      "sim/lifecycle.py (--fail-unattributed)"
+                      % (n_unattr, name))
+                unattributed_rc = 6
+            else:
+                print("    WARNING: %d collectives carry no phase scope — "
+                      "extend the named_scope coverage in sim/lifecycle.py"
+                      % n_unattr)
         print("  per computation (collective-bearing only; depth = enclosing "
               "while-loop nesting):")
         for c, e in sorted(sec["by_computation"].items(),
@@ -361,8 +380,8 @@ def _run(args, dump: str) -> int:
     if args.compare:
         rc = _compare(report, args.compare, args.tolerance,
                       phase_budget=args.phase_budget)
-        return rc or overlap_rc
-    return overlap_rc
+        return rc or overlap_rc or unattributed_rc
+    return overlap_rc or unattributed_rc
 
 
 def _compare(report: dict, base_path: str, tol: float,

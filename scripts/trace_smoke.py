@@ -1,0 +1,222 @@
+"""trace-smoke — the CI gate for r20 span tracing (obs/trace.py).
+
+Three legs, all correctness-only:
+
+1. **Chain reconstruction from the journal alone**: a 2-rank block-
+   routed serve plane (LocalNetwork) with a quorum reader runs traced;
+   every span lands in a real JSONL journal via
+   ``TelemetryJournal.span``; for every key whose owner is remote the
+   chain frontend route → per-owner forward RPC → receive-side handle
+   (and the quorum wave → per-owner read legs) must reconstruct from
+   the parsed journal records, with every forward span's ``hops`` field
+   equal to the ``ringpop-hops`` header value its downstream server/
+   handle spans observed.
+2. **Rerun determinism**: the identical workload traced twice produces
+   the identical set of (trace, span, parent, leg) tuples — sampling
+   and ids are pure functions of the key hashes, so reruns trace the
+   SAME requests.
+3. **Serve-mesh bit-transparency**: a P=2 serve mesh with spans enabled
+   lands digests identical to the untraced twin and the P=1 oracle, and
+   every cross-rank ``mesh_answer`` span joins its sender's
+   ``mesh_request`` span by DERIVED parent id (no header crosses the
+   fabric) carrying the mesh generation.
+
+Exit 0 on success, 1 with a diagnosis on any failure.  A few seconds —
+wired into ``make test``.
+
+Usage:
+    python scripts/trace_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _traced_run(tracer):
+    """One traced serve-plane workload; returns (owners, gens, wave)."""
+    import numpy as np
+
+    from ringpop_tpu.forward.batch import (
+        BatchForwarder,
+        BlockRouter,
+        QuorumReader,
+    )
+    from ringpop_tpu.net.channel import LocalChannel, LocalNetwork
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+
+    servers = [f"10.41.0.{i}:3000" for i in range(2)]
+    toks, owns = build_ring_tokens(servers, 8)
+    tokens = np.asarray(toks, np.uint32)
+    owners = np.asarray(owns, np.int32)
+
+    def lookup(h, n):
+        idx = np.searchsorted(tokens, np.asarray(h, np.uint32), side="left")
+        idx = np.where(idx >= tokens.shape[0], 0, idx)
+        return np.asarray(owners[idx], np.int32), 3
+
+    net = LocalNetwork(seed=0)
+    for rank, addr in enumerate(servers):
+        chan = LocalChannel(net, addr, app="serve")
+        chan.tracer = tracer
+        router = BlockRouter(
+            rank, 2, lambda: tokens, lookup, servers,
+            BatchForwarder(chan, tracer=tracer),
+        )
+        chan.register("serve", "/lookup", router.handler())
+    client = LocalChannel(net, "10.41.0.99:1", app="cli")
+    cfwd = BatchForwarder(client, tracer=tracer)
+    frontend = BlockRouter(0, 2, lambda: tokens, lookup, servers, cfwd)
+    reader = QuorumReader(cfwd, servers, r=2)
+
+    hashes = np.asarray(
+        [0x00000010, 0x40000000, 0x80000000, 0xC0000000], np.uint32
+    )
+
+    async def go():
+        o, g = await frontend.route(hashes, n=1)
+        wave = await reader.quorum_wave(tokens, owners, 2, hashes, salt=1)
+        return o, g, wave
+
+    loop = asyncio.new_event_loop()
+    try:
+        o, g, wave = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    from ringpop_tpu.forward.batch import rank_of_hashes
+
+    return hashes, rank_of_hashes(tokens, hashes, 2), g, wave
+
+
+def main() -> int:
+    import numpy as np
+
+    from ringpop_tpu.obs import trace as tracemod
+    from ringpop_tpu.sim import telemetry
+
+    failures: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="tracesmoke_")
+
+    # -- leg 1: chain from the journal alone ----------------------------------
+    journal_path = os.path.join(tmp, "trace.jsonl")
+    with telemetry.TelemetryJournal(journal_path) as journal:
+        journal.header("serve", "trace_smoke", {})
+        tracer = tracemod.Tracer(journal.span, sample=1)
+        hashes, owner_ranks, gens, wave = _traced_run(tracer)
+    records = telemetry.read_journal(journal_path)
+    spans = [r for r in records if r["kind"] == "span"]
+    if not spans:
+        failures.append("no span records landed in the journal")
+    if not wave["answers_agree"] or wave["quorum_ok_frac"] < 1.0:
+        failures.append(f"quorum wave did not hold: {wave}")
+
+    forwarded = 0
+    for key, owner_rank in zip(hashes.tolist(), owner_ranks.tolist()):
+        ch = tracemod.chain(records, tracemod.trace_id_of(key))
+        legs = [s["leg"] for s in ch]
+        if not ch or legs[0] != "route" or ch[0]["parent"] is not None:
+            failures.append(f"key {key:#x}: chain does not root at the "
+                            f"frontend route: {legs}")
+            continue
+        if "quorum_wave" not in legs:
+            failures.append(f"key {key:#x}: quorum-read leg missing: {legs}")
+        if owner_rank != 0:
+            forwarded += 1
+            if "forward" not in legs or "handle" not in legs:
+                failures.append(
+                    f"key {key:#x}: forwarded chain incomplete: {legs}"
+                )
+        # the acceptance join: forward spans' hops == the ringpop-hops
+        # value their downstream server/handle spans carried
+        for s in ch:
+            if s["leg"] != "forward":
+                continue
+            kids = [k for k in ch if k.get("parent") == s["span"]
+                    and k["leg"] in ("server", "handle")]
+            if not kids:
+                failures.append(
+                    f"key {key:#x}: forward span {s['span']} has no "
+                    "downstream server/handle record"
+                )
+            for k in kids:
+                if k["hops"] != s["hops"]:
+                    failures.append(
+                        f"key {key:#x}: hop mismatch — forward span says "
+                        f"{s['hops']}, downstream {k['leg']} saw {k['hops']}"
+                    )
+    if forwarded == 0:
+        failures.append("workload forwarded no keys — the smoke is vacuous")
+
+    # -- leg 2: rerun determinism ---------------------------------------------
+    rerun: list[dict] = []
+    _traced_run(tracemod.Tracer(rerun.append, sample=1))
+    ids = lambda rs: sorted(  # noqa: E731
+        (s["trace"], s["span"], s.get("parent"), s["leg"])
+        for s in rs if s.get("kind") == "span"
+    )
+    if ids(spans) != ids(rerun):
+        failures.append(
+            "rerun produced different span ids — sampling/ids are not a "
+            f"pure function of the keys ({len(spans)} vs {len(rerun)} spans)"
+        )
+
+    # -- leg 3: serve-mesh bit-transparency + derived-parent join -------------
+    from ringpop_tpu.serve.mesh import run_serve_mesh
+
+    cfg = dict(n_servers=8, replica_points=16, n=3, streams=4, rounds=2,
+               keys_per_stream=256, seed=3)
+    oracle = run_serve_mesh(1, **cfg)[0]["digest"]
+    base = run_serve_mesh(2, **cfg)
+    mesh_spans: list[dict] = []
+    traced = run_serve_mesh(2, trace_sink=mesh_spans.append,
+                            trace_sample=32, **cfg)
+    if {r["digest"] for r in base} != {oracle}:
+        failures.append(f"untraced mesh digests diverge from oracle {oracle}")
+    if {r["digest"] for r in traced} != {oracle}:
+        failures.append(
+            f"TRACED mesh digests diverge from oracle {oracle}: "
+            f"{[r['digest'] for r in traced]} — tracing is not host-only"
+        )
+    reqs = {r["span"]: r for r in mesh_spans if r["leg"] == "mesh_request"}
+    answers = [r for r in mesh_spans if r["leg"] == "mesh_answer"]
+    if not answers:
+        failures.append("mesh produced no answer spans at sample=32")
+    for a in answers:
+        mate = reqs.get(a["parent"])
+        if mate is None or mate["trace"] != a["trace"]:
+            failures.append(
+                f"mesh_answer span {a['span']} does not join its sender's "
+                "mesh_request by derived parent id"
+            )
+        elif a.get("gen") != 0:
+            failures.append(f"mesh_answer span carries gen {a.get('gen')}")
+
+    if failures:
+        print("trace-smoke: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(json.dumps({
+        "trace_smoke": {
+            "spans_journaled": len(spans),
+            "keys_forwarded": forwarded,
+            "rerun_deterministic": True,
+            "mesh_digest": oracle,
+            "mesh_answer_spans": len(answers),
+        }
+    }))
+    print("trace-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

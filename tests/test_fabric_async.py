@@ -453,3 +453,67 @@ def test_plan_window_non_power_of_two_process_count():
         assert [c[0] for c in covered] == list(range(b))
         for owner, glo, glen, _ in plan:
             assert owner == glo // b, "piece assigned off its owner block"
+
+
+# -- wire_stats under concurrent senders (r20 obs satellite) ------------------
+
+
+def test_wire_stats_race_free_and_monotone_under_concurrent_senders():
+    """Both ranks enqueue rounds as fast as they can (packing in the
+    caller thread, draining on the persistent sender threads — three
+    threads touching the counters per rank) while a monitor thread
+    polls ``wire_stats()``: every snapshot must be internally
+    consistent and monotone non-decreasing, and the final totals must
+    balance exactly (rank 0's wire/raw sent == rank 1's received and
+    vice versa — a lost or double-counted update cannot balance)."""
+    rounds = 40
+    monitor_stop = threading.Event()
+    snaps: dict[int, list] = {0: [], 1: []}
+
+    def body(fab, rank):
+        peer = 1 - rank
+
+        def monitor():
+            while not monitor_stop.is_set():
+                snaps[rank].append(fab.wire_stats())
+                time.sleep(0.001)
+
+        mt = threading.Thread(target=monitor, daemon=True)
+        mt.start()
+        handles = []
+        for tick in range(rounds):
+            handles.append(
+                fab.exchange_async(
+                    tick * 16, {peer: _round_payloads(rank, tick)}, [peer]
+                )
+            )
+            if len(handles) >= 4:  # keep several rounds in flight
+                handles.pop(0).wait(join_sends=False)
+        for h in handles:
+            h.wait()  # join everything (sends too) before reading finals
+        final = fab.wire_stats()
+        mt.join(timeout=5)
+        return final
+
+    out, errs = _run_ranks(2, body, "wirestats-conc")
+    monitor_stop.set()
+    assert errs == [None, None], errs
+    for rank in range(2):
+        series = snaps[rank] + [out[rank]]
+        for prev, cur in zip(series, series[1:]):
+            for key in ("bytes_sent", "bytes_recv", "raw_bytes_sent",
+                        "raw_bytes_recv"):
+                assert cur[key] >= prev[key], (
+                    f"rank {rank}: {key} went backwards: {prev} -> {cur}"
+                )
+        # raw is never below wire (the codec only ever shrinks)
+        assert out[rank]["raw_bytes_sent"] >= out[rank]["bytes_sent"]
+    # exact cross-rank balance: totals are race-free or they don't add up
+    assert out[0]["bytes_sent"] == out[1]["bytes_recv"]
+    assert out[1]["bytes_sent"] == out[0]["bytes_recv"]
+    assert out[0]["raw_bytes_sent"] == out[1]["raw_bytes_recv"]
+    assert out[1]["raw_bytes_sent"] == out[0]["raw_bytes_recv"]
+    # per-codec sent counts: one entry per array that crossed, so the
+    # two ranks' totals agree (same deterministic payload schedule)
+    assert sum(out[0]["codec_counts"].values()) == rounds * 2
+    assert out[0]["codec_counts"] == out[1]["codec_counts"]

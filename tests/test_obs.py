@@ -1,0 +1,591 @@
+"""r20 live-operations-plane suite (``ringpop_tpu/obs/``).
+
+Covers the four obs pieces and their seams: the aggregating reporter +
+Prometheus rendering, the LiveOps endpoint (single- and multi-rank,
+cross-rank aggregation over the obs fabric, per-rank liveness), the
+deterministic span tracer (key-hash sampling, header round-trip,
+chain reconstruction with hop parity), the flight recorder (bounded
+ring, dump format, fabric-failure + excepthook triggers), and the
+hardened UDPStatsd (dead socket never raises, multi-metric datagrams).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import aggregate as agg
+from ringpop_tpu.obs import trace as tracemod
+from ringpop_tpu.obs.endpoint import LiveOps
+from ringpop_tpu.obs.flight import FlightRecorder, git_commit
+from ringpop_tpu.parallel.fabric import Fabric, FabricPeerLost, LocalKV
+
+
+# -- AggregatingStats ---------------------------------------------------------
+
+
+def test_aggregating_stats_counters_gauges_timings():
+    st = agg.AggregatingStats()
+    st.incr("a.count", 2)
+    st.incr("a.count", 3)
+    st.gauge("b.gauge", 1.5)
+    st.gauge("b.gauge", 2.5)  # last value wins
+    for v in (0.1, 0.2, 0.3):
+        st.timing("c.time", v)
+    snap = st.snapshot()
+    assert snap["counters"]["a.count"] == 5
+    assert snap["gauges"]["b.gauge"] == 2.5
+    t = snap["timings"]["c.time"]
+    assert t["count"] == 3 and t["min"] == 0.1 and t["max"] == 0.3
+    assert abs(t["mean"] - 0.2) < 1e-9
+    assert "a.count" in snap["rates_1m"]
+
+
+def test_aggregating_stats_thread_safe_totals():
+    st = agg.AggregatingStats()
+
+    def pound():
+        for _ in range(2000):
+            st.incr("k", 1)
+
+    ts = [threading.Thread(target=pound) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert st.snapshot()["counters"]["k"] == 8000
+
+
+def test_prometheus_rendering_labels_and_aggregate():
+    st = agg.AggregatingStats()
+    st.incr("ringpop.sim.ping.send", 5)
+    st.gauge("x-y.z", 2)
+    snap = st.snapshot()
+    txt = agg.render_prometheus({0: snap, 1: snap})
+    assert '# TYPE ringpop_sim_ping_send counter' in txt
+    assert 'ringpop_sim_ping_send{rank="0"} 5' in txt
+    assert 'ringpop_sim_ping_send{rank="1"} 5' in txt
+    # the unlabeled cross-rank aggregate
+    assert "\nringpop_sim_ping_send 10" in txt
+    # name sanitization: '-' and '.' both become '_'
+    assert 'x_y_z{rank="0"} 2' in txt
+    # single-rank rendering emits no aggregate duplicate
+    solo = agg.render_prometheus({0: snap})
+    assert "\nringpop_sim_ping_send 5\n" not in solo
+    assert agg.merge_counter_totals({0: snap, 1: snap}) == {
+        "ringpop.sim.ping.send": 10.0
+    }
+
+
+# -- Tracer -------------------------------------------------------------------
+
+
+def test_tracer_sampling_is_pure_function_of_key_hash():
+    records_a, records_b = [], []
+    ta = tracemod.Tracer(records_a.append, sample=8)
+    tb = tracemod.Tracer(records_b.append, sample=8)
+    h = np.arange(256, dtype=np.uint32)
+    assert (ta.sample_mask(h) == tb.sample_mask(h)).all()
+    assert ta.sample_mask(h).sum() == 32
+    sa = ta.begin("forward", h, salt=7)
+    sb = tb.begin("forward", h, salt=7)
+    assert sa.trace == sb.trace and sa.span == sb.span
+    sa.finish()
+    sb.finish()
+    assert records_a[0]["keys"] == records_b[0]["keys"]
+    assert records_a[0]["traces"] == records_b[0]["traces"]
+    # an unsampled batch emits nothing at all
+    assert ta.begin("forward", np.asarray([1, 2, 3], np.uint32)) is None
+    assert records_a[0]["trace"] == tracemod.trace_id_of(0)
+
+
+def test_tracer_header_round_trip_and_follow():
+    records = []
+    tr = tracemod.Tracer(records.append, sample=1, rank=3)
+    sp = tr.begin("forward", np.asarray([42], np.uint32), hops=2)
+    headers = {
+        tracemod.TRACE_HEADER: sp.header_value(),
+        "ringpop-hops": "2",
+    }
+    child = tr.follow(headers, "server", salt=1)
+    assert child.trace == sp.trace
+    assert child.record["parent"] == sp.span
+    assert child.record["hops"] == 2
+    # malformed/absent headers: no span, no raise
+    assert tr.follow({}, "server") is None
+    assert tr.follow({tracemod.TRACE_HEADER: "zzz"}, "server") is None
+    assert tr.follow({tracemod.TRACE_HEADER: "12:34:56"}, "server") is None
+
+
+def test_tracer_sink_failure_never_raises():
+    def bad_sink(rec):
+        raise RuntimeError("disk full")
+
+    tr = tracemod.Tracer(bad_sink, sample=1)
+    sp = tr.begin("forward", np.asarray([0], np.uint32))
+    sp.finish()  # swallowed
+    assert tr.spans_dropped == 1 and tr.spans_emitted == 0
+
+
+def test_span_chain_reconstruction_orders_parent_first():
+    records = []
+    tr = tracemod.Tracer(records.append, sample=1)
+    root = tr.begin("route", np.asarray([9], np.uint32))
+    mid = tr.begin("forward", np.asarray([9], np.uint32), parent=root.span,
+                   salt=1)
+    leaf = tr.begin("handle", np.asarray([9], np.uint32), parent=mid.span,
+                    salt=2)
+    # finish out of order: chain ordering comes from parent links
+    leaf.finish()
+    root.finish()
+    mid.finish()
+    ch = tracemod.chain(records, tracemod.trace_id_of(9))
+    assert [s["leg"] for s in ch] == ["route", "forward", "handle"]
+
+
+# -- forwarding-plane spans (route -> forward -> handle, hop parity) ----------
+
+
+def _lookup_fixture(n_servers=2, points=8):
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+
+    servers = [f"10.31.0.{i}:3000" for i in range(n_servers)]
+    toks, owns = build_ring_tokens(servers, points)
+    tokens = np.asarray(toks, np.uint32)
+    owners = np.asarray(owns, np.int32)
+
+    def lookup(h, n):
+        idx = np.searchsorted(tokens, np.asarray(h, np.uint32), side="left")
+        idx = np.where(idx >= tokens.shape[0], 0, idx)
+        return np.asarray(owners[idx], np.int32), 7
+
+    return servers, tokens, owners, lookup
+
+
+def test_forwarded_span_chain_hops_match_header():
+    """The acceptance join: a forwarded key's chain reconstructs
+    frontend route -> forward RPC -> receive-side handle from the
+    records alone, and every forward span's ``hops`` equals the
+    ``ringpop-hops`` value its downstream server/handle spans saw."""
+    import asyncio
+
+    from ringpop_tpu.forward.batch import BatchForwarder, BlockRouter
+    from ringpop_tpu.net.channel import LocalChannel, LocalNetwork
+
+    servers, tokens, owners, lookup = _lookup_fixture()
+    net = LocalNetwork(seed=0)
+    records = []
+    tr = tracemod.Tracer(records.append, sample=1)
+    for rank, addr in enumerate(servers):
+        chan = LocalChannel(net, addr, app="serve")
+        chan.tracer = tr
+        router = BlockRouter(
+            rank, len(servers), lambda: tokens, lookup, servers,
+            BatchForwarder(chan, tracer=tr),
+        )
+        chan.register("serve", "/lookup", router.handler())
+    client = LocalChannel(net, "10.31.0.99:1", app="cli")
+    frontend = BlockRouter(
+        0, len(servers), lambda: tokens, lookup, servers,
+        BatchForwarder(client, tracer=tr),
+    )
+    hashes = np.asarray([0x10, 0xF0000000, 0x7F000000], np.uint32)
+
+    loop = asyncio.new_event_loop()
+    try:
+        o, g = loop.run_until_complete(frontend.route(hashes, n=1))
+    finally:
+        loop.close()
+    assert (g == 7).all()
+
+    from ringpop_tpu.forward.batch import rank_of_hashes
+
+    ranks = rank_of_hashes(tokens, hashes, len(servers))
+    assert (ranks != 0).any(), "fixture must forward at least one key"
+    for key, owner_rank in zip(hashes.tolist(), ranks.tolist()):
+        ch = tracemod.chain(records, tracemod.trace_id_of(key))
+        legs = [s["leg"] for s in ch]
+        assert legs[0] == "route" and ch[0]["parent"] is None
+        if owner_rank != 0:
+            # a cross-block key must show the full forwarded chain
+            assert "forward" in legs and "handle" in legs, legs
+        for s in ch:
+            if s["leg"] != "forward":
+                continue
+            kids = [k for k in ch if k.get("parent") == s["span"]
+                    and k["leg"] in ("server", "handle")]
+            assert kids, f"forward span {s['span']} has no downstream record"
+            for k in kids:
+                assert k["hops"] == s["hops"], (s, k)
+
+
+# -- LiveOps ------------------------------------------------------------------
+
+
+def _scrape(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_liveops_single_rank_endpoints():
+    ops = LiveOps(0, 1)
+    ops.stats.incr("ringpop.sim.ping.send", 4)
+    ops.progress(16, 64, last_checkpoint_tick=8)
+    addr = ops.serve()
+    try:
+        m = _scrape(addr, "/metrics")
+        assert 'ringpop_sim_ping_send{rank="0"} 4' in m
+        assert 'ringpop_obs_progress_ticks_done{rank="0"} 16' in m
+        h = json.loads(_scrape(addr, "/healthz"))
+        assert h["ok"] and h["rank"] == 0 and h["ranks"]["0"]["live"]
+        p = json.loads(_scrape(addr, "/progress"))
+        assert p["ranks"]["0"] == {
+            "ticks_done": 16, "horizon": 64, "last_checkpoint_tick": 8,
+        }
+        # unknown path is a 404, not a crash
+        with pytest.raises(urllib.error.HTTPError):
+            _scrape(addr, "/nope")
+    finally:
+        ops.close()
+
+
+def test_liveops_cross_rank_aggregation_and_liveness():
+    kv = LocalKV()
+    opses = [None, None]
+    errs = [None, None]
+
+    def worker(rank):
+        try:
+            ops = LiveOps(rank, 2, kv=kv, namespace="obs-agg-t")
+            opses[rank] = ops
+            ops.stats.incr("ringpop.sim.ping.send", 10 * (rank + 1))
+            ops.progress(4 + rank, 32)
+            for _ in range(3):
+                ops.sync()
+                time.sleep(0.01)
+        except BaseException as e:  # noqa: BLE001
+            errs[rank] = e
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert errs == [None, None], errs
+    addr = opses[0].serve()
+    try:
+        m = _scrape(addr, "/metrics")
+        assert 'ringpop_sim_ping_send{rank="0"} 10' in m
+        assert 'ringpop_sim_ping_send{rank="1"} 20' in m
+        assert "\nringpop_sim_ping_send 30" in m
+        p = json.loads(_scrape(addr, "/progress"))
+        assert p["ranks"]["0"]["ticks_done"] == 4
+        assert p["ranks"]["1"]["ticks_done"] == 5
+        h = json.loads(_scrape(addr, "/healthz"))
+        assert set(h["ranks"]) == {"0", "1"} and h["ok"]
+    finally:
+        for o in opses:
+            o.close()
+
+
+def test_liveops_sync_never_raises_after_peer_death():
+    """A dead peer degrades the plane (liveness shows it) but sync on
+    the survivor keeps returning — the ops plane must never take the
+    sweep down."""
+    kv = LocalKV()
+    opses = [None, None]
+    barrier = threading.Barrier(2, timeout=30)
+
+    def worker(rank):
+        ops = LiveOps(rank, 2, kv=kv, namespace="obs-death-t",
+                      timeout_ms=2_000)
+        opses[rank] = ops
+        barrier.wait()
+        ops.sync()
+        if rank == 1:
+            ops.close()  # rank 1 dies abruptly after one round
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    ops0 = opses[0]
+    deadline = time.monotonic() + 10
+    # keep syncing; eventually the dead peer surfaces in health, and no
+    # sync call may raise
+    while time.monotonic() < deadline:
+        ops0.sync()
+        h = ops0.health()
+        if not h["ranks"].get("1", {"live": True})["live"] or h["degraded"]:
+            break
+        time.sleep(0.05)
+    h = ops0.health()
+    assert (not h["ranks"].get("1", {"live": True})["live"]) or h["degraded"]
+    ops0.close()
+
+
+# -- FlightRecorder -----------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounds_and_dump_schema(tmp_path):
+    rec = FlightRecorder(capacity=8, rank=2,
+                         path=str(tmp_path / "flight.jsonl"))
+    for i in range(20):
+        rec.record({"kind": "block", "tick": i})
+    kept = rec.records()
+    assert len(kept) == 8 and kept[-1]["tick"] == 19 and kept[0]["tick"] == 12
+    assert [r["flight_seq"] for r in kept] == list(range(12, 20))
+    path = rec.dump("unit_test", error=RuntimeError("boom"))
+    lines = [json.loads(x) for x in open(path)]
+    head = lines[0]
+    assert head["kind"] == "flight_header"
+    assert head["reason"] == "unit_test" and "boom" in head["error"]
+    assert head["rank"] == 2 and head["dropped"] == 12
+    assert head["git_commit"] == git_commit()
+    assert [r["tick"] for r in lines[1:]] == list(range(12, 20))
+    # second dump is suppressed (first failure wins) unless forced
+    assert rec.dump("again") is None
+    assert rec.dump("forced", force=True) is not None
+
+
+def test_flight_recorder_dumps_on_fabric_peer_lost(tmp_path):
+    """Kill one rank's fabric mid-exchange: the surviving rank's
+    FabricPeerLost must trigger the installed recorder's dump."""
+    rec = FlightRecorder(capacity=16, rank=0,
+                         path=str(tmp_path / "peer_lost.jsonl"))
+    rec.install(fabric=True, excepthook=False, threads=False)
+    try:
+        kv = LocalKV()
+        fabs = [None, None]
+        ready = threading.Barrier(2, timeout=30)
+
+        def run(rank):
+            fab = Fabric(rank, 2, kv, namespace="obs-fl-t", timeout_ms=5_000)
+            fabs[rank] = fab
+            ready.wait()
+            if rank == 1:
+                time.sleep(0.1)
+                fab.close()  # dies without sending
+                return
+            rec.record({"kind": "block", "tick": 99})
+            with pytest.raises(FabricPeerLost):
+                fab.exchange(7, {1: [np.ones(4, np.uint32)]}, [1])
+            fab.close()
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert rec.dumped is not None
+        lines = [json.loads(x) for x in open(rec.dumped)]
+        assert lines[0]["reason"] == "fabric:FabricPeerLost"
+        assert lines[-1]["kind"] == "block" and lines[-1]["tick"] == 99
+    finally:
+        rec.uninstall()
+
+
+def test_flight_recorder_dumps_on_thread_exception(tmp_path):
+    rec = FlightRecorder(capacity=4, rank=1,
+                         path=str(tmp_path / "thread.jsonl"))
+    rec.install(fabric=False, excepthook=False, threads=True)
+    try:
+        rec.record({"kind": "block", "tick": 5})
+
+        def boom():
+            raise ValueError("mid-sweep crash")
+
+        t = threading.Thread(target=boom)
+        t.start()
+        t.join(10)
+        assert rec.dumped is not None
+        lines = [json.loads(x) for x in open(rec.dumped)]
+        assert lines[0]["reason"] == "uncaught_thread_exception"
+        assert "mid-sweep crash" in lines[0]["error"]
+    finally:
+        rec.uninstall()
+
+
+def test_git_commit_matches_git(tmp_path):
+    import subprocess
+
+    got = git_commit()
+    assert got and len(got) == 40
+    try:
+        want = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=str(tmp_path.parents[0] / ".."), timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        pytest.skip("git unavailable")
+    # run against the repo root, not tmp_path
+    import ringpop_tpu
+
+    repo = ringpop_tpu.__file__.rsplit("/", 2)[0]
+    want = subprocess.run(
+        ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+        cwd=repo, timeout=10,
+    )
+    if want.returncode != 0:
+        pytest.skip("not a git checkout")
+    assert got == want.stdout.strip()
+    # non-repo directory: honest None, no raise
+    assert git_commit(str(tmp_path)) is None
+
+
+# -- UDPStatsd hardening (r20 satellite) --------------------------------------
+
+
+def _udp_pair():
+    from ringpop_tpu.cli.stats import UDPStatsd
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    return UDPStatsd(f"127.0.0.1:{recv.getsockname()[1]}"), recv
+
+
+def test_udp_statsd_dead_socket_never_raises():
+    udp, recv = _udp_pair()
+    udp.incr("pre", 1)  # first emit flushes immediately
+    assert recv.recv(256) == b"pre:1|c"
+    # kill the UNDERLYING socket without telling the reporter — every
+    # emit and the close must swallow the OSError
+    udp._sock.close()
+    udp.incr("a", 1)
+    udp.gauge("b", 2.0)
+    udp.timing("c", 0.5)
+    udp.flush()
+    udp.close()
+    udp.incr("post-close", 1)  # dropped, not raised
+    recv.close()
+
+
+def test_udp_statsd_coalesces_multi_metric_datagrams():
+    udp, recv = _udp_pair()
+    udp.incr("first", 1)  # flushes alone (cold buffer)
+    assert recv.recv(256) == b"first:1|c"
+    # a quick burst inside the flush window coalesces; explicit flush
+    # ships them as ONE newline-separated statsd multi-metric packet
+    udp.incr("a", 1)
+    udp.gauge("b", 2.5)
+    udp.timing("c", 0.002)
+    udp.flush()
+    assert recv.recv(512) == b"a:1|c\nb:2.5|g\nc:2.000|ms"
+    udp.close()
+    recv.close()
+
+
+def test_udp_statsd_datagram_size_cap_splits_packets():
+    from ringpop_tpu.cli.stats import UDPStatsd
+
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    udp = UDPStatsd(
+        f"127.0.0.1:{recv.getsockname()[1]}", max_datagram=24, flush_s=3600
+    )
+    udp.incr("warm", 1)  # cold-buffer flush
+    assert recv.recv(64) == b"warm:1|c"
+    for i in range(4):
+        udp.incr(f"key{i}", i)  # 8 bytes each; cap 24 → flush mid-burst
+    udp.close()  # final flush
+    got = [recv.recv(64) for _ in range(2)]
+    lines = [ln for g in got for ln in g.split(b"\n")]
+    assert lines == [b"key0:0|c", b"key1:1|c", b"key2:2|c", b"key3:3|c"]
+    for g in got:
+        assert len(g) <= 24
+    recv.close()
+
+
+def test_span_ids_distinct_across_route_and_quorum_paths_default_salts():
+    """Review fix (r20): the same key forwarded to the same dest at the
+    same hop level through TWO upstream paths (frontend route, then a
+    quorum wave) must emit fully distinct span ids at DEFAULT salts —
+    the parent rides the id — and both chains keep their own
+    downstream server/handle records."""
+    import asyncio
+
+    from ringpop_tpu.forward.batch import (
+        BatchForwarder,
+        BlockRouter,
+        QuorumReader,
+    )
+    from ringpop_tpu.net.channel import LocalChannel, LocalNetwork
+
+    servers, tokens, owners, lookup = _lookup_fixture()
+    net = LocalNetwork(seed=0)
+    records = []
+    tr = tracemod.Tracer(records.append, sample=1)
+    for rank, addr in enumerate(servers):
+        chan = LocalChannel(net, addr, app="serve")
+        chan.tracer = tr
+        router = BlockRouter(
+            rank, 2, lambda: tokens, lookup, servers,
+            BatchForwarder(chan, tracer=tr),
+        )
+        chan.register("serve", "/lookup", router.handler())
+    client = LocalChannel(net, "10.31.0.98:1", app="cli")
+    cfwd = BatchForwarder(client, tracer=tr)
+    frontend = BlockRouter(0, 2, lambda: tokens, lookup, servers, cfwd)
+    reader = QuorumReader(cfwd, servers, r=2)
+    key = np.asarray([0xF0000000], np.uint32)  # remote-owned
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(frontend.route(key, n=1))
+        loop.run_until_complete(
+            reader.quorum_wave(tokens, owners, 2, key)  # default salt
+        )
+    finally:
+        loop.close()
+    ids = [s["span"] for s in records]
+    assert len(ids) == len(set(ids)), (
+        f"span id collision: {[(s['leg'], s['span']) for s in records]}"
+    )
+    ch = tracemod.chain(records, tracemod.trace_id_of(0xF0000000))
+    forwards = [s for s in ch if s["leg"] == "forward"]
+    assert len(forwards) >= 2  # the route path AND a quorum read
+    for s in forwards:
+        kids = [k for k in ch if k.get("parent") == s["span"]
+                and k["leg"] in ("server", "handle")]
+        assert kids and all(k["hops"] == s["hops"] for k in kids)
+
+
+def test_obs_fabric_failures_do_not_burn_the_flight_dump(tmp_path):
+    """Review fix (r20): a ``notify_failures=False`` fabric (the obs
+    plane's side channel) must NOT trigger the global failure hooks —
+    its peer losses/timeouts are routine rank skew, and the flight
+    recorder's once-per-process dump belongs to ENGINE fabric failures."""
+    rec = FlightRecorder(capacity=8, rank=0,
+                         path=str(tmp_path / "quiet.jsonl"))
+    rec.install(fabric=True, excepthook=False, threads=False)
+    try:
+        kv = LocalKV()
+        ready = threading.Barrier(2, timeout=30)
+
+        def run(rank):
+            fab = Fabric(rank, 2, kv, namespace="obs-quiet-t",
+                         timeout_ms=5_000, notify_failures=False)
+            ready.wait()
+            if rank == 1:
+                time.sleep(0.1)
+                fab.close()
+                return
+            with pytest.raises(FabricPeerLost):
+                fab.exchange(9, {1: [np.ones(2, np.uint32)]}, [1])
+            fab.close()
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert rec.dumped is None, "quiet fabric burned the flight dump"
+    finally:
+        rec.uninstall()

@@ -126,3 +126,30 @@ def test_mesh_codec_off_digest_identical():
     assert on[0]["digest"] == off[0]["digest"]
     for a, b in zip(on, off):
         assert a["wire"]["bytes_sent"] <= b["wire"]["bytes_sent"]
+
+
+def test_mesh_digests_bit_identical_with_spans_enabled():
+    """r20: span tracing is host-plane only — a traced P=2 mesh run
+    lands the SAME per-rank digests as the untraced twin AND the P=1
+    oracle, while the span records themselves join across ranks (every
+    mesh_answer's computed parent is an emitted mesh_request span of
+    the same trace, generation attached)."""
+    records = []
+    base = run_serve_mesh(2, n=3, streams=4, **CFG)
+    traced = run_serve_mesh(
+        2, n=3, streams=4, trace_sink=records.append, trace_sample=16, **CFG
+    )
+    oracle = run_serve_mesh(1, n=3, streams=4, **CFG)[0]["digest"]
+    assert {r["digest"] for r in base} == {oracle}
+    assert {r["digest"] for r in traced} == {oracle}
+    reqs = {r["span"]: r for r in records if r["leg"] == "mesh_request"}
+    answers = [r for r in records if r["leg"] == "mesh_answer"]
+    assert reqs and answers, "sampled keys must have produced both legs"
+    for a in answers:
+        mate = reqs.get(a["parent"])
+        assert mate is not None, "answer span parent not an emitted request"
+        assert mate["trace"] == a["trace"]
+        assert a["gen"] == 0  # the mesh runs at generation 0
+        # opposite directions of the same peer pair in the same round
+        assert (mate["rank"], mate["dest"]) == (a["src"], a["rank"])
+        assert mate["rnd"] == a["rnd"]

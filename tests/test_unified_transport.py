@@ -241,3 +241,51 @@ def test_tcp_channel_wire_accounting():
         assert cs["bytes_sent"] > 0 and ss["bytes_sent"] > 0
 
     _run(main())
+
+
+def test_tcp_channel_wire_stats_race_free_under_concurrent_senders():
+    """Many concurrent in-flight calls (the multiplexed-by-id pool) with
+    a poller sampling ``wire_stats()`` between completions: every sample
+    monotone non-decreasing, and the final totals exact — frames_sent
+    equals the call count on both roles and bytes_sent equals the sum of
+    the frames actually written (r20 obs satellite)."""
+
+    async def main():
+        server = TCPChannel(app="srv")
+
+        async def handle(body, headers):
+            await asyncio.sleep(0.001 * (body.get("x", 0) % 4))
+            return {"x": body.get("x")}
+
+        server.register("svc", "/echo", handle)
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="cli")
+        samples = []
+        stop = asyncio.Event()
+
+        async def poll():
+            while not stop.is_set():
+                samples.append((client.wire_stats(), server.wire_stats()))
+                await asyncio.sleep(0.001)
+
+        poller = asyncio.ensure_future(poll())
+        n = 64
+        results = await asyncio.gather(
+            *(client.call(addr, "svc", "/echo", {"x": i}, timeout=10)
+              for i in range(n))
+        )
+        stop.set()
+        await poller
+        cs, ss = client.wire_stats(), server.wire_stats()
+        await client.close()
+        await server.close()
+        assert sorted(r["x"] for r in results) == list(range(n))
+        samples.append((cs, ss))
+        for (pc, ps), (cc, cs_) in zip(samples, samples[1:]):
+            for prev, cur in ((pc, cc), (ps, cs_)):
+                assert cur["frames_sent"] >= prev["frames_sent"]
+                assert cur["bytes_sent"] >= prev["bytes_sent"]
+        assert cs["frames_sent"] == n and ss["frames_sent"] == n
+        assert cs["bytes_sent"] > 0 and ss["bytes_sent"] > 0
+
+    _run(main())
