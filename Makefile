@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke transport-smoke
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke transport-smoke lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # live-operations-plane gate (r20, obs/): a P=2 in-process fleet sweep
@@ -40,6 +40,14 @@ live-smoke:
 # digests are bit-identical tracing-on vs off.
 trace-smoke:
 	$(PY) scripts/trace_smoke.py
+
+# one-transport-plane gate (r21): serve lookups (shm zero-copy + folded
+# TCP), a gossip window exchange, an obs-class snapshot and a mesh-style
+# forward all through the unified transport — owner digests equal the
+# host-bisect oracle, every merged-ledger class row reconciles with the
+# transport's legacy counters, and copy_bytes reads 0.
+transport-smoke:
+	$(PY) scripts/transport_smoke.py
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
 # parseable JSONL journal AND end digest-equal to a telemetry-off run;

@@ -280,6 +280,44 @@ def main() -> None:
     )
 
 
+def _transport_rtt_us(reps: int) -> float:
+    """Small-RPC echo round-trip (p50, µs) over the folded TCP channel —
+    the r21 one-transport-plane path (channel on the fabric's RPC plane:
+    persistent per-link threads, vectored sends, pooled receive arenas,
+    opportunistic inline send).  msgpack codec, in-process server."""
+    import asyncio
+
+    from ringpop_tpu.net import TCPChannel
+
+    async def run() -> float:
+        server = TCPChannel(app="bench", codec="msgpack")
+
+        async def echo(body: dict, headers: dict) -> dict:
+            return body
+
+        server.register("bench", "/echo", echo)
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="bench-cli", codec="msgpack")
+        payload = {"x": 7, "k": "bench"}
+        for _ in range(20):  # warm the link + demux path
+            await client.call(addr, "bench", "/echo", payload, timeout=10)
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            await client.call(addr, "bench", "/echo", payload, timeout=10)
+            samples.append(time.perf_counter() - t0)
+        await client.close()
+        await server.close()
+        samples.sort()
+        return samples[len(samples) // 2] * 1e6
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(run())
+    finally:
+        loop.close()
+
+
 def run_bench() -> None:
     import jax
 
@@ -511,6 +549,18 @@ def run_bench() -> None:
     jax.block_until_ready(_serve_loop(sring, hashes))
     serve_qps = batch * 10 / (time.perf_counter() - t_r)
 
+    # -- secondary: transport RTT (r21 one-transport-plane fold) ------------
+    # the folded channel's small-RPC p50 vs the retired asyncio channel's
+    # captured baseline (same probe methodology, same container class —
+    # PERF.md r21).  A thread-hop regression in the RPC plane shows up
+    # here without waiting for a serve-tier wall-clock drift.
+    transport_rtt_baseline = 82.1  # pre-fold asyncio channel, msgpack p50 µs
+    try:
+        transport_rtt = round(_transport_rtt_us(200 if fast else 1000), 1)
+        transport_rtt_err = None
+    except Exception as e:  # never let the side probe kill the headline
+        transport_rtt, transport_rtt_err = None, f"{type(e).__name__}: {e}"
+
     baseline_s = 60.0  # BASELINE.json north star
     baseline_n = 1_000_000
     # vs_baseline is only honest when the metric's scale matches the
@@ -564,6 +614,9 @@ def run_bench() -> None:
         "delta_aot_error": delta_aot["error"],
         "ring_lookup_qps": round(ring_qps, 0),
         "serve_lookup_qps": round(serve_qps, 0),
+        "transport_rtt_us": transport_rtt,
+        "transport_rtt_baseline_us": transport_rtt_baseline,
+        "transport_rtt_error": transport_rtt_err,
         "view_checksum_s": round(checksum_s, 4),
         "platform": platform,
         # lets the parent purge exactly this dir if the XLA:CPU AOT loader

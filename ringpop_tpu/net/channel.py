@@ -18,8 +18,11 @@ Design notes, mirroring reference decisions:
   (isolated ``ringpop`` subchannel, ``ringpop.go:163``).
 
 Two implementations:
-* :class:`TCPChannel` — real sockets, newline-delimited JSON frames,
-  connection pool per peer, request multiplexing by id.
+* :class:`TCPChannel` — real sockets on the fabric's RPC plane (r21:
+  persistent per-peer links, vectored sends, pooled receive arenas —
+  ``parallel/fabric.py`` owns the socket loop; this module owns only the
+  frame-dict schema and the JSON/msgpack body encodings), request
+  multiplexing by id.
 * :class:`LocalChannel`/:class:`LocalNetwork` — in-process loopback with
   first-class fault injection (drop probability, partitions, black holes) —
   the test-harness analog of the reference's RFC-5737 black-hole addresses
@@ -112,29 +115,31 @@ _warned_msgpack_missing = False
 # (asyncio's 64 KiB default would break large full syncs).
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
-async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
-    """Read one frame of either encoding; None on EOF or garbage."""
-    try:
-        first = await reader.readexactly(1)
-    except (asyncio.IncompleteReadError, ConnectionError):
+def _decode_frame_body(data) -> Optional[dict]:
+    """Decode one frame body of either encoding; None on garbage.
+
+    r21: the fabric RPC plane delimits bodies exactly (one body per
+    transport frame), so this is pure decode — no stream reading.  The
+    first byte keeps the mixed-codec auto-detection (``{`` = JSON object,
+    ``0xC1`` = msgpack magic + uint32-be length) byte-compatible with the
+    pre-fold frame format, so the golden corpus and mixed-codec clusters
+    are unaffected.  ``data`` may be a memoryview into a pooled receive
+    arena — it is only valid for the duration of the call, and both
+    decoders materialize fresh objects from it."""
+    if len(data) == 0:
         return None
-    if first == b"{":
+    first = data[0]
+    if first == 0x7B:  # "{" — one compact JSON object (+ trailing newline)
         try:
-            line = await reader.readline()
-        except ValueError:  # line exceeded the stream limit
-            return None
-        try:
-            frame = json.loads(first + line)
-        except json.JSONDecodeError:
+            frame = json.loads(bytes(data))
+        except (json.JSONDecodeError, UnicodeDecodeError):
             return None
         return frame if isinstance(frame, dict) else None
-    if first == _MSGPACK_MAGIC:
-        try:
-            ln = int.from_bytes(await reader.readexactly(4), "big")
-            if ln > MAX_FRAME_BYTES:
-                return None
-            payload = await reader.readexactly(ln)
-        except (asyncio.IncompleteReadError, ConnectionError):
+    if first == 0xC1:  # _MSGPACK_MAGIC
+        if len(data) < 5:
+            return None
+        ln = int.from_bytes(bytes(data[1:5]), "big")
+        if ln > MAX_FRAME_BYTES or len(data) < 5 + ln:
             return None
         if _msgpack is None:
             # fail LOUDLY: dropping the connection surfaces the
@@ -150,7 +155,7 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
                 )
             return None
         try:
-            frame = _msgpack.unpackb(payload, raw=False)
+            frame = _msgpack.unpackb(data[5:5 + ln], raw=False)
         except Exception:
             return None
         return frame if isinstance(frame, dict) else None
@@ -177,20 +182,20 @@ async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
 
 _FABRIC_ARRAY_KEY = "_fab"
 
-
-def _fabric_frame(a) -> bytes:
-    """One array, fabric-framed (``parallel.fabric.frame_array``):
-    byte-identical to what the same array costs inside a fabric
-    exchange message."""
-    from ringpop_tpu.parallel.fabric import frame_array
-
-    return frame_array(a)
-
-
-def _fabric_unframe(data: bytes):
-    from ringpop_tpu.parallel.fabric import unframe_array
-
-    return unframe_array(data)
+# r21 (one transport plane): the fabric's codec stack is IMPORTED, not
+# re-implemented — channel.py owns no array codec and no socket loop.
+# ``frame_array``/``unframe_array`` are the same bytes an array costs
+# inside a fabric exchange message; ``RpcEndpoint`` is the persistent-link
+# transport TCPChannel rides; ``TransportLedger`` is the merged per-class
+# byte ledger.  parallel.fabric is numpy-only (parallel/__init__ is lazy),
+# so this import keeps frontends jax-free (pinned by
+# tests/test_unified_transport.py).
+from ringpop_tpu.parallel.fabric import (  # noqa: E402
+    RpcEndpoint,
+    TransportLedger,
+    frame_array,
+    unframe_array,
+)
 
 
 def encode_array(arr, codec: str, dtype: str = "<u4", fabric: bool = False):
@@ -202,7 +207,7 @@ def encode_array(arr, codec: str, dtype: str = "<u4", fabric: bool = False):
     import numpy as _np
 
     if fabric:
-        data = _fabric_frame(_np.asarray(arr, dtype=dtype))
+        data = frame_array(_np.asarray(arr, dtype=dtype))
         if codec == "msgpack":
             return {_FABRIC_ARRAY_KEY: data}
         import base64 as _b64
@@ -228,7 +233,7 @@ def decode_array(value, dtype: str = "<u4"):
             import base64 as _b64
 
             data = _b64.b64decode(data)
-        out = _fabric_unframe(bytes(data))
+        out = unframe_array(bytes(data))
         # fabric frames carry their own dtype; the caller's expectation
         # reinterprets (two's-complement view, same as the plain lane's
         # frombuffer) rather than converting
@@ -348,47 +353,49 @@ class BaseChannel:
 # ---------------------------------------------------------------------------
 
 
-class _PeerConn:
-    """One pooled connection to a peer, multiplexing requests by id."""
-
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        self.reader = reader
-        self.writer = writer
-        self.pending: dict[int, asyncio.Future] = {}
-        self.next_id = 0
-        self.reader_task: Optional[asyncio.Task] = None
-        self.closed = False
-
-    def close(self) -> None:
-        self.closed = True
-        if self.reader_task:
-            self.reader_task.cancel()
-        try:
-            self.writer.close()
-        except Exception:
-            pass
-        for fut in self.pending.values():
-            if not fut.done():
-                fut.set_exception(PeerUnreachableError("connection closed"))
-        self.pending.clear()
-
-
 class TCPChannel(BaseChannel):
-    """JSON-over-TCP channel: one listener, pooled outbound connections
-    (parity: TChannel peer pool, ``swim/ping_sender.go:83``)."""
+    """Framed RPC channel on the fabric core (parity: TChannel peer pool,
+    ``swim/ping_sender.go:83``).
 
-    def __init__(self, app: str = "", codec: Optional[str] = None):
+    r21 (one transport plane): the channel no longer owns a socket loop —
+    connection handling, framing, retry surface and the peer registry all
+    live in the fabric's :class:`~ringpop_tpu.parallel.fabric.RpcEndpoint`
+    (persistent per-link sender/reader threads, vectored sends, pooled
+    receive arenas, sticky ``FabricError`` failures).  What remains here
+    is the channel's SEMANTIC layer: the request/response frame-dict
+    schema, the JSON/msgpack body encodings (unchanged bytes — the golden
+    corpus and mixed-codec clusters are unaffected), handler dispatch,
+    and the asyncio bridge (replies hop from reader threads onto the
+    event loop via ``call_soon_threadsafe``).
+
+    Wire format change vs pre-r21: each body now rides ONE fabric
+    transport frame (16-byte ``_HDR``: RPC tag + request id, blob count,
+    body length) instead of being self-delimiting on a bare socket.  The
+    body bytes themselves are byte-identical."""
+
+    def __init__(self, app: str = "", codec: Optional[str] = None,
+                 ledger: Optional[TransportLedger] = None):
         super().__init__(app)
         self.codec = codec or default_codec()
         self._encode = _encoder_for(self.codec)
-        self._server: Optional[asyncio.AbstractServer] = None
-        self._conns: dict[str, _PeerConn] = {}
-        self._serving_tasks: set[asyncio.Task] = set()
-        self._client_writers: set[asyncio.StreamWriter] = set()
-        # frame-level byte accounting (the fabric's wire_stats contract,
-        # transplanted): every frame this endpoint writes, both roles
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # ``ledger`` merges this channel's wire bytes into a shared
+        # per-class TransportLedger (class "rpc"); default = private.
+        self._ep = RpcEndpoint(
+            self._on_request, ledger=ledger, ledger_class="rpc",
+            max_body_bytes=MAX_FRAME_BYTES,
+        )
+        # legacy frame-level accounting (the pre-r21 keys, body bytes
+        # only): kept per-channel and loop-thread-only so existing
+        # journal consumers and the monotone-sampling pins are unmoved.
+        # The transport-level truth (incl. the 16 B/frame fabric header
+        # and the receive side) is ``self.ledger.stats()``.
         self.bytes_sent = 0
         self.frames_sent = 0
+
+    @property
+    def ledger(self) -> TransportLedger:
+        return self._ep.ledger
 
     def wire_stats(self) -> dict:
         """Counter snapshot, shaped like ``Fabric.wire_stats`` so serve
@@ -398,52 +405,32 @@ class TCPChannel(BaseChannel):
     # -- server side --------------------------------------------------------
 
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
-        self._server = await asyncio.start_server(
-            self._on_client, host, port, limit=MAX_FRAME_BYTES
-        )
-        sock = self._server.sockets[0]
-        addr = sock.getsockname()
-        self.hostport = f"{addr[0]}:{addr[1]}"
+        self._loop = asyncio.get_event_loop()
+        self.hostport = self._ep.listen(host, port)
         return self.hostport
 
     async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            # unblock handler coroutines stuck in readline so wait_closed
-            # (which awaits them since py3.12) can finish
-            for w in list(self._client_writers):
-                try:
-                    w.close()
-                except Exception:
-                    pass
-            await self._server.wait_closed()
-            self._server = None
-        for conn in list(self._conns.values()):
-            conn.close()
-        self._conns.clear()
-        for t in list(self._serving_tasks):
-            t.cancel()
+        # endpoint close joins link threads (bounded); keep it off the loop
+        await asyncio.get_event_loop().run_in_executor(None, self._ep.close)
 
-    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        self._client_writers.add(writer)
+    def _on_request(self, link, rid: int, payload) -> None:
+        """Inbound request, on the link's reader thread.  ``payload`` is a
+        memoryview into the pooled arena — decode NOW, then hop onto the
+        event loop for dispatch."""
+        frame = _decode_frame_body(payload)
+        if frame is None:
+            # garbage breaks only its own connection (pre-r21 reader
+            # semantics): raising fails this link, nothing else
+            raise FabricError("rpc request body undecodable — dropping the connection")
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
         try:
-            while True:
-                frame = await _read_frame(reader)
-                if frame is None:
-                    break
-                task = asyncio.ensure_future(self._serve_frame(frame, writer))
-                self._serving_tasks.add(task)
-                task.add_done_callback(self._serving_tasks.discard)
-        except (ConnectionError, asyncio.CancelledError):
-            pass
-        finally:
-            self._client_writers.discard(writer)
-            try:
-                writer.close()
-            except Exception:
-                pass
+            asyncio.run_coroutine_threadsafe(self._serve_frame(frame, link, rid), loop)
+        except RuntimeError:
+            pass  # loop shut down mid-flight
 
-    async def _serve_frame(self, frame: dict, writer: asyncio.StreamWriter):
+    async def _serve_frame(self, frame: dict, link, rid: int) -> None:
         res = {"id": frame.get("id"), "kind": "res"}
         try:
             body = await self.dispatch(
@@ -462,65 +449,34 @@ class TCPChannel(BaseChannel):
             # encoder with ensure_ascii handles any str; never hang the caller.
             # The id itself may be the unencodable part (a msgpack peer can
             # send bytes ids): only pass through JSON-safe ids.
-            rid = res.get("id")
-            if not isinstance(rid, (str, int, float)):
-                rid = None
+            rid_body = res.get("id")
+            if not isinstance(rid_body, (str, int, float)):
+                rid_body = None
             payload = _frame_bytes(
-                {"id": rid, "kind": "res", "ok": False,
+                {"id": rid_body, "kind": "res", "ok": False,
                  "err": f"response encode failed: {type(e).__name__}"}
             )
-        try:
-            writer.write(payload)
-            self.bytes_sent += len(payload)
-            self.frames_sent += 1
-            await writer.drain()
-        except (ConnectionError, asyncio.CancelledError):
-            pass
+        link.respond(rid, payload)
+        self.bytes_sent += len(payload)
+        self.frames_sent += 1
 
     # -- client side --------------------------------------------------------
 
-    async def _get_conn(self, peer: str) -> _PeerConn:
-        conn = self._conns.get(peer)
-        if conn is not None and not conn.closed:
-            return conn
-        host, port = peer.rsplit(":", 1)
+    async def _get_link(self, peer: str):
+        link = self._ep.get(peer)
+        if link is not None:
+            return link
+        loop = asyncio.get_event_loop()
         try:
-            reader, writer = await asyncio.open_connection(
-                host, int(port), limit=MAX_FRAME_BYTES
-            )
-        except OSError as e:
-            raise PeerUnreachableError(f"connect {peer}: {e}") from e
-        conn = _PeerConn(reader, writer)
-        conn.reader_task = asyncio.ensure_future(self._read_responses(peer, conn))
-        self._conns[peer] = conn
-        return conn
-
-    async def _read_responses(self, peer: str, conn: _PeerConn):
-        try:
-            while True:
-                frame = await _read_frame(conn.reader)
-                if frame is None:
-                    break
-                fut = conn.pending.pop(frame.get("id"), None)
-                if fut is None or fut.done():
-                    continue
-                if frame.get("ok"):
-                    fut.set_result(frame.get("body") or {})
-                else:
-                    fut.set_exception(RemoteError(frame.get("err", "remote error")))
-        except (ConnectionError, asyncio.CancelledError):
-            pass
-        finally:
-            if self._conns.get(peer) is conn:
-                del self._conns[peer]
-            conn.close()
+            # blocking dial off the loop; the endpoint caches one live
+            # link per peer (dial races resolve to the established one)
+            return await loop.run_in_executor(None, self._ep.connect, peer)
+        except FabricPeerLost as e:
+            raise PeerUnreachableError(str(e)) from e
 
     async def call(self, peer, service, endpoint, body, headers=None, timeout=None) -> dict:
-        conn = await self._get_conn(peer)
-        conn.next_id += 1
-        rid = conn.next_id
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        conn.pending[rid] = fut
+        link = await self._get_link(peer)
+        rid = link.alloc_id()
         frame = {
             "id": rid,
             "kind": "req",
@@ -532,20 +488,46 @@ class TCPChannel(BaseChannel):
         try:
             encoded = self._encode(frame)
         except Exception as e:
-            conn.pending.pop(rid, None)
             raise CallError(f"encode request for {peer}: {type(e).__name__}: {e}") from e
-        try:
-            conn.writer.write(encoded)
-            self.bytes_sent += len(encoded)
-            self.frames_sent += 1
-            await conn.writer.drain()
-        except (ConnectionError, OSError) as e:
-            conn.pending.pop(rid, None)
-            raise PeerUnreachableError(f"send to {peer}: {e}") from e
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def _post(setter, value):
+            def apply():
+                if not fut.done():
+                    setter(value)
+            try:
+                loop.call_soon_threadsafe(apply)
+            except RuntimeError:
+                pass  # loop already closed; nobody is awaiting
+
+        def on_reply(payload):
+            # reader-thread callback: payload is an arena memoryview (or
+            # the link's sticky error) — decode here, resolve on the loop
+            if isinstance(payload, BaseException):
+                err = payload if isinstance(payload, CallError) else (
+                    PeerUnreachableError(str(payload)))
+                if err is not payload and err.__cause__ is None:
+                    err.__cause__ = payload
+                _post(fut.set_exception, err)
+                return
+            res = _decode_frame_body(payload)
+            if res is None:
+                _post(fut.set_exception,
+                      PeerUnreachableError(f"undecodable response frame from {peer}"))
+                raise FabricError("rpc response undecodable — dropping the connection")
+            if res.get("ok"):
+                _post(fut.set_result, res.get("body") or {})
+            else:
+                _post(fut.set_exception, RemoteError(res.get("err", "remote error")))
+
+        link.request(rid, encoded, on_reply)
+        self.bytes_sent += len(encoded)
+        self.frames_sent += 1
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
-            conn.pending.pop(rid, None)
+            link.forget(rid)
             raise CallTimeoutError(f"call {peer} {endpoint} timed out after {timeout}s")
 
 

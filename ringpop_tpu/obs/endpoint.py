@@ -76,8 +76,13 @@ class LiveOps:
         namespace: str = "obs",
         timeout_ms: int = 3_600_000,
         stale_s: float = DEFAULT_STALE_S,
+        ledger=None,
     ):
         self.rank, self.nprocs = rank, nprocs
+        # r21: snapshot traffic accounts into the merged TransportLedger
+        # under class "obs" — pass the job's shared ledger to get one
+        # cross-plane byte view, or leave None for a private one
+        self.ledger = ledger
         self.stats = stats if stats is not None else AggregatingStats()
         self.recorder = recorder
         self.stale_s = stale_s
@@ -111,7 +116,9 @@ class LiveOps:
             self.fabric = Fabric(
                 rank, nprocs, kv, namespace=namespace,
                 timeout_ms=timeout_ms, codec=True, notify_failures=False,
+                ledger=ledger, ledger_class="obs",
             )
+            self.ledger = self.fabric.ledger
 
     # -- progress + record ingestion ------------------------------------------
 

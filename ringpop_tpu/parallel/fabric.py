@@ -407,6 +407,78 @@ def _send_exact(sock: socket.socket, data) -> None:
     sock.sendall(data)
 
 
+# Linux IOV_MAX is 1024; staying under it keeps every sendmsg call a
+# single syscall attempt instead of an EINVAL surprise on huge rounds
+_IOV_CHUNK = 512
+
+
+def _send_parts(sock: socket.socket, parts: Sequence) -> None:
+    """Vectored send of a framed message: header + per-array metadata +
+    payload buffers go to the kernel as ONE iovec (``sendmsg``) instead
+    of being ``+``-concatenated into a fresh wire-sized bytes object —
+    the send half of the r21 zero-copy contract.  Partial sends advance
+    through memoryviews; no payload bytes are ever copied host-side."""
+    bufs = [memoryview(p) for p in parts if len(p)]
+    while bufs:
+        sent = sock.sendmsg(bufs[:_IOV_CHUNK])
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent:
+            bufs[0] = bufs[0][sent:]
+
+
+class TransportLedger:
+    """The ONE merged byte ledger of the unified transport plane (r21).
+
+    Every transport class — the fabric's exchange streams (``exchange``),
+    the RPC request/response tag family the channel rides (``rpc``), the
+    obs side-channel fabric (``obs``), the serve shm ring (``shm``) —
+    accounts into the same ledger under its class key, so a run can state
+    its total wire traffic AND the per-tag-family split from one
+    snapshot.  ``copy_bytes`` counts payload bytes that took an
+    intermediate host copy on a registered-buffer path (the shm slot →
+    fused dispatch hand-off); the zero-copy acceptance bar is that it
+    reads 0 there — proven by the transport smoke, not claimed.
+
+    Per-class sums are defined to equal the legacy per-transport
+    counters (``Fabric.wire_stats``, the channel's ``wire_stats``, the
+    shm server's slot accounting) on identical traffic — the r21
+    migration contract pinned by test and by ``make transport-smoke``.
+    """
+
+    FIELDS = (
+        "bytes_sent", "bytes_recv", "raw_bytes_sent", "raw_bytes_recv",
+        "frames_sent", "frames_recv", "copy_bytes",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._classes: dict[str, dict[str, int]] = {}
+
+    def add(self, klass: str, **deltas: int) -> None:
+        with self._lock:
+            row = self._classes.setdefault(
+                klass, {f: 0 for f in self.FIELDS}
+            )
+            for k, v in deltas.items():
+                row[k] += int(v)
+
+    def stats(self) -> dict:
+        """Snapshot: ``{"classes": {class: {field: n}}, "total": {field:
+        n}, "copy_bytes": n}`` — ``copy_bytes`` is lifted to the top
+        level because it is the zero-copy certificate, not a traffic
+        counter."""
+        with self._lock:
+            classes = {k: dict(v) for k, v in sorted(self._classes.items())}
+        total = {f: sum(v[f] for v in classes.values()) for f in self.FIELDS}
+        return {
+            "classes": classes,
+            "total": total,
+            "copy_bytes": total["copy_bytes"],
+        }
+
+
 class _Future:
     """One pending send or receive: an event plus a value-or-error slot.
     ``value`` for a send is the monotonic completion timestamp (the
@@ -511,6 +583,11 @@ class _PeerLink:
         self.recvq: "queue.Queue" = queue.Queue()
         self.send_err: Optional[BaseException] = None
         self.recv_err: Optional[BaseException] = None
+        # pooled receive buffers (r21): one header buf + one growable
+        # payload arena per link, reused across every frame this
+        # receiver thread reads — frames no longer cost an allocation
+        self._hdr_buf = bytearray(_HDR.size)
+        self._arena = bytearray(1 << 16)
         self._sender = threading.Thread(
             target=self._send_loop, daemon=True,
             name=f"fabric-r{fabric.rank}-send-p{peer}",
@@ -539,6 +616,15 @@ class _PeerLink:
             fut = job.fut if isinstance(job, _RecvJob) else job[0]
             fut.fail(err)
 
+    def _arena_for(self, n: int) -> bytearray:
+        """The payload arena, grown geometrically when a frame exceeds
+        it (growth counts as ONE allocation on ``RECV_ALLOCS``; steady-
+        state frames then reuse it for free)."""
+        if len(self._arena) < n:
+            RECV_ALLOCS.bump()
+            self._arena = bytearray(max(n, 2 * len(self._arena)))
+        return self._arena
+
     def _send_loop(self) -> None:
         while True:
             job = self.sendq.get()
@@ -549,7 +635,7 @@ class _PeerLink:
                 fut.fail(self.send_err)
                 continue
             try:
-                _send_exact(self.sock, msg)
+                _send_parts(self.sock, msg)
                 fut.fulfill(time.monotonic())
             except socket.timeout as e:
                 self.send_err = FabricTimeout(
@@ -588,7 +674,7 @@ class _PeerLink:
                 continue
             try:
                 job.fut.fulfill(
-                    self.fabric._recv(self.peer, job.tag, job.stream)
+                    self.fabric._recv(self.peer, job.tag, job.stream, link=self)
                 )
             except FabricError as e:
                 if self.fabric._closed:
@@ -715,16 +801,47 @@ class ExchangeHandle:
         return done
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
+class _AllocCounter:
+    """Receive-buffer allocation counter (r21 satellite): the pooled
+    arena makes per-frame allocation a regression, so tests pin that a
+    steady-state exchange stream allocates O(1), not O(frames)."""
+
+    __slots__ = ("n", "_lock")
+
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def bump(self) -> None:
+        with self._lock:
+            self.n += 1
+
+
+RECV_ALLOCS = _AllocCounter()
+
+
+def _recv_exact(
+    sock: socket.socket, n: int, buf: Optional[bytearray] = None
+) -> memoryview:
+    """Read exactly ``n`` bytes into ``buf`` (a caller-pooled arena,
+    reused across frames) and return a sized read view.  The view is
+    valid only until the next call that reuses the same arena — decoders
+    must copy anything that outlives the frame (``decode_array`` already
+    materializes fresh arrays).  ``buf=None`` allocates, and every
+    allocation (fresh or arena-growth, which the caller does before
+    passing a bigger buf) bumps ``RECV_ALLOCS`` so the per-frame-alloc
+    regression is pinnable."""
+    if buf is None or len(buf) < n:
+        RECV_ALLOCS.bump()
+        buf = bytearray(n)
+    view = memoryview(buf)[:n]
     got = 0
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise FabricPeerLost("fabric peer closed the connection")
         got += r
-    return bytes(buf)
+    return view
 
 
 class Fabric:
@@ -745,6 +862,8 @@ class Fabric:
         timeout_ms: int = 120_000,
         codec: bool = True,
         notify_failures: bool = True,
+        ledger: Optional[TransportLedger] = None,
+        ledger_class: str = "exchange",
     ):
         if not 0 <= rank < nprocs:
             raise ValueError(f"rank {rank} outside [0, {nprocs})")
@@ -752,6 +871,14 @@ class Fabric:
         self.kv, self.ns = kv, namespace
         self.timeout_ms = timeout_ms
         self.codec = codec
+        # the merged transport ledger (r21): every legacy counter below
+        # is mirrored into it under ledger_class, so per-class ledger
+        # sums equal this fabric's own wire_stats by construction —
+        # pass a shared ledger to account several transports together
+        # (the obs fabric registers as class "obs", the channel's RPC
+        # plane as "rpc", the shm ring as "shm")
+        self.ledger = ledger if ledger is not None else TransportLedger()
+        self.ledger_class = ledger_class
         # notify_failures=False opts this fabric OUT of the global
         # failure hooks (obs/flight): the obs plane's own side-channel
         # fabric tolerates rank skew as routine — its timeouts must not
@@ -893,9 +1020,15 @@ class Fabric:
             self._tx_prev[(peer, stream, idx)] = a.tobytes()
         return enc
 
-    def _pack(self, tag: int, arrays, peer: int, stream=None) -> tuple[bytes, int]:
-        """-> (wire message, raw-equivalent size)."""
-        parts = []
+    def _pack(
+        self, tag: int, arrays, peer: int, stream=None
+    ) -> tuple[list, int, int]:
+        """-> (iovec parts, wire size, raw-equivalent size).  The parts
+        list goes to the sender thread's vectored ``sendmsg`` as-is —
+        payload buffers are never ``+``-concatenated into a wire-sized
+        copy (the r21 zero-copy send path); only the small per-array
+        metadata strips are joined."""
+        parts: list = [None]  # the _HDR slot, filled once total is known
         total = 0
         raw_total = _HDR.size
         counts: dict[int, int] = {}
@@ -909,22 +1042,31 @@ class Fabric:
             total += len(parts[-2]) + len(parts[-1])
             raw_total += len(meta) + len(dt) + len(shape) + enc.raw_nbytes
             counts[enc.codec] = counts.get(enc.codec, 0) + 1
+        parts[0] = _HDR.pack(tag, len(arrays), total)
         with self._lock:
             for c, k in counts.items():
                 self.codec_counts[c] = self.codec_counts.get(c, 0) + k
-        return _HDR.pack(tag, len(arrays), total) + b"".join(parts), raw_total
+        return parts, _HDR.size + total, raw_total
 
-    def _recv(self, peer: int, tag: int, stream=None) -> list[np.ndarray]:
+    def _recv(self, peer: int, tag: int, stream=None, link=None) -> list[np.ndarray]:
         sock = self._peers[peer]
         try:
-            hdr = _recv_exact(sock, _HDR.size)
+            hdr = _recv_exact(
+                sock, _HDR.size, link._hdr_buf if link is not None else None
+            )
             got_tag, n_arrays, total = _HDR.unpack(hdr)
             if got_tag != tag:
                 raise FabricDesync(
                     f"fabric desync: rank {self.rank} expected tag {tag} from peer "
                     f"{peer}, got {got_tag} — a leg was skipped or reordered"
                 )
-            payload = _recv_exact(sock, total)
+            # the payload lands in the link's pooled arena; every decode
+            # below materializes fresh arrays before the next frame
+            # reuses it (decode_array copies exactly where the caller
+            # outlives the arena)
+            payload = _recv_exact(
+                sock, total, link._arena_for(total) if link is not None else None
+            )
         except socket.timeout as e:
             raise FabricTimeout(
                 f"rank {self.rank}: peer {peer} sent nothing for tag {tag} "
@@ -950,7 +1092,7 @@ class Fabric:
         for idx in range(n_arrays):
             codec, dtl, ndim, nbytes = _AHDR.unpack_from(payload, off)
             off += _AHDR.size
-            dt = payload[off : off + dtl].decode()
+            dt = bytes(payload[off : off + dtl]).decode()
             off += dtl
             shape = tuple(np.frombuffer(payload, ">u8", count=ndim, offset=off).astype(int))
             off += 8 * ndim
@@ -967,6 +1109,11 @@ class Fabric:
         with self._lock:
             self.bytes_recv += len(hdr) + total
             self.raw_bytes_recv += raw_total
+        self.ledger.add(
+            self.ledger_class,
+            bytes_recv=len(hdr) + total, raw_bytes_recv=raw_total,
+            frames_recv=1,
+        )
         return out
 
     # -- rounds ---------------------------------------------------------------
@@ -1025,12 +1172,16 @@ class Fabric:
         # blocks, any P), a swing round to exactly 1; only the tiny
         # reduce words ever fan to P-1
         for peer, arrays in sends.items():
-            msg, raw = self._pack(tag, arrays, peer, stream)
+            parts, wire, raw = self._pack(tag, arrays, peer, stream)
             with self._lock:
-                self.bytes_sent += len(msg)
+                self.bytes_sent += wire
                 self.raw_bytes_sent += raw
+            self.ledger.add(
+                self.ledger_class,
+                bytes_sent=wire, raw_bytes_sent=raw, frames_sent=1,
+            )
             fut = _Future()
-            self._links[peer].sendq.put((fut, msg, tag))
+            self._links[peer].sendq.put((fut, parts, tag))
             send_futs.append((peer, fut))
         recv_futs: list[tuple[int, _Future]] = []
         for peer in recv_from:
@@ -1132,6 +1283,376 @@ class Fabric:
         descr, shape_s, body = raw.split("|", 2)
         shape = tuple(int(x) for x in shape_s.split(",") if x)
         return np.frombuffer(base64.b64decode(body), np.dtype(descr)).reshape(shape).copy()
+
+
+# -- the RPC plane (r21): request/response tag family on the fabric core -----
+#
+# The channel's TCP transport (net/channel.py) used to own its OWN asyncio
+# socket loop, framing, retry/timeout and peer registry.  r21 folds all of
+# that onto the fabric's persistent-link machinery: an RPC frame is a
+# fabric ``_HDR`` frame whose tag carries a kind byte + a 24-bit request
+# id, and whose payload is ONE opaque body blob (the channel's
+# self-describing JSON/msgpack frame bytes — the body encodings are
+# unchanged so mixed-codec endpoints keep interoperating).  Each
+# connection is an :class:`RpcLink`: a persistent sender thread draining
+# vectored frames and a reader thread demuxing request vs response frames
+# by tag kind — the exact shape of ``_PeerLink``, with the tagged-FIFO
+# expectation queue replaced by an id-keyed pending table (requests are
+# unsolicited, so the demux is a map, not a queue).  Errors are the
+# fabric family and sticky per link; bytes account into the merged
+# :class:`TransportLedger` under class ``"rpc"``.
+
+TAG_RPC_REQ = 0x51 << 24  # | (id & _RPC_ID_MASK)
+TAG_RPC_RES = 0x52 << 24
+_RPC_KIND_MASK = 0xFF000000
+_RPC_ID_MASK = 0x00FFFFFF
+
+# one RPC body may not exceed this — same bound (and same rationale) as
+# the channel's MAX_FRAME_BYTES: caps what a desynced or malicious peer
+# can make the reader arena hold
+MAX_RPC_BODY_BYTES = 64 * 1024 * 1024
+
+
+class RpcLink:
+    """One RPC connection, either role (dialed or accepted).
+
+    ``request`` registers a callback under a fresh 24-bit id and
+    enqueues the frame on the sender thread; the reader thread invokes
+    the callback with the response payload (a memoryview into the pooled
+    arena, valid only for the duration of the call) or with the link's
+    typed error.  Inbound REQUEST frames go to the endpoint's handler on
+    the reader thread — the handler must fully consume (or copy) the
+    payload before returning.  A socket failure is sticky: every pending
+    and future callback on this link fails with the same FabricError."""
+
+    def __init__(self, ep: "RpcEndpoint", sock: socket.socket,
+                 peer: Optional[str] = None):
+        self.ep = ep
+        self.sock = sock
+        self.peer = peer  # hostport this side dialed, None for accepted
+        self.err: Optional[BaseException] = None
+        self.sendq: "queue.Queue" = queue.Queue()
+        self._pending: dict[int, object] = {}  # rid -> callback(payload|exc)
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()  # serializes wire writes
+        self._hdr_buf = bytearray(_HDR.size)
+        self._arena = bytearray(1 << 16)
+        name = peer or "accepted"
+        self._sender = threading.Thread(
+            target=self._send_loop, daemon=True, name=f"rpc-send-{name}")
+        self._reader = threading.Thread(
+            target=self._recv_loop, daemon=True, name=f"rpc-recv-{name}")
+        self._sender.start()
+        self._reader.start()
+
+    # -- client role ----------------------------------------------------------
+
+    def alloc_id(self) -> int:
+        """A fresh request id (24-bit, wraps; callers embed it in the
+        body BEFORE sending, so allocation is a separate step)."""
+        with self._lock:
+            while True:
+                self._next_id = (self._next_id + 1) & _RPC_ID_MASK or 1
+                if self._next_id not in self._pending:
+                    return self._next_id
+
+    def request(self, rid: int, body: bytes, on_reply) -> None:
+        """Send ``body`` as request ``rid``; ``on_reply`` is invoked on
+        the reader thread with the response payload memoryview, or with
+        a BaseException (link failure / endpoint close)."""
+        with self._lock:
+            if self.err is not None:
+                err = self.err
+            else:
+                self._pending[rid] = on_reply
+                err = None
+        if err is not None:
+            on_reply(err)
+            return
+        self._enqueue(TAG_RPC_REQ | (rid & _RPC_ID_MASK), body)
+
+    def forget(self, rid: int) -> None:
+        """Drop a pending request (caller-side timeout): a late response
+        frame for it is discarded by the demux."""
+        with self._lock:
+            self._pending.pop(rid, None)
+
+    # -- server role ----------------------------------------------------------
+
+    def respond(self, rid: int, body: bytes) -> None:
+        """Send ``body`` as the response to request ``rid`` (thread-safe
+        enqueue; a dead link drops the response — the caller's retry
+        policy owns that failure, exactly as a dropped TCP write would)."""
+        self._enqueue(TAG_RPC_RES | (rid & _RPC_ID_MASK), body)
+
+    # -- machinery ------------------------------------------------------------
+
+    # inline-send cap: frames up to this ride the CALLING thread when the
+    # sender is idle (one socket-buffer flush, bounded stall); bigger
+    # frames always take the sender thread so a slow-reading peer can
+    # only ever stall the dedicated sender, not the caller's loop
+    _INLINE_SEND_MAX = 256 * 1024
+
+    def _enqueue(self, tag: int, body: bytes) -> None:
+        parts = [_HDR.pack(tag, 1, len(body)), body]
+        self.ep.ledger.add(
+            self.ep.ledger_class,
+            bytes_sent=_HDR.size + len(body), frames_sent=1,
+        )
+        # opportunistic inline send: when nothing is queued and no other
+        # thread is mid-write, push the frame from THIS thread — saves a
+        # cross-thread wakeup per frame, which dominates small-RPC RTT.
+        # RPC frames are independent (tagged demux), so a frame slipping
+        # ahead of one the sender thread just dequeued is harmless.
+        if (
+            len(body) <= self._INLINE_SEND_MAX
+            and self.sendq.empty()
+            and self._send_lock.acquire(blocking=False)
+        ):
+            try:
+                if self.err is None:
+                    _send_parts(self.sock, parts)
+                return
+            except (OSError, ValueError) as e:
+                self._fail(FabricPeerLost(
+                    f"rpc send to {self.peer or 'peer'} failed ({e})"), e)
+                return
+            finally:
+                self._send_lock.release()
+        self.sendq.put(parts)
+
+    def _send_loop(self) -> None:
+        while True:
+            parts = self.sendq.get()
+            if parts is None:
+                return
+            if self.err is not None:
+                continue
+            try:
+                with self._send_lock:
+                    _send_parts(self.sock, parts)
+            except (OSError, ValueError) as e:
+                self._fail(FabricPeerLost(
+                    f"rpc send to {self.peer or 'peer'} failed ({e})"), e)
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                hdr = _recv_exact(self.sock, _HDR.size, self._hdr_buf)
+                tag, n_blobs, total = _HDR.unpack(hdr)
+                kind = tag & _RPC_KIND_MASK
+                if (
+                    n_blobs != 1
+                    or total > self.ep.max_body_bytes
+                    or kind not in (TAG_RPC_REQ, TAG_RPC_RES)
+                ):
+                    raise FabricError(
+                        f"rpc frame from {self.peer or 'peer'} malformed "
+                        f"(tag {tag:#x}, {n_blobs} blobs, {total} bytes) — "
+                        "dropping the connection"
+                    )
+                if len(self._arena) < total:
+                    RECV_ALLOCS.bump()
+                    self._arena = bytearray(max(total, 2 * len(self._arena)))
+                payload = _recv_exact(self.sock, total, self._arena)
+            except BaseException as e:
+                if not isinstance(e, FabricError):
+                    e = FabricPeerLost(
+                        f"rpc connection to {self.peer or 'peer'} lost ({e})")
+                self._fail(e, e.__cause__)
+                return
+            self.ep.ledger.add(
+                self.ep.ledger_class,
+                bytes_recv=_HDR.size + total, frames_recv=1,
+            )
+            rid = tag & _RPC_ID_MASK
+            try:
+                if kind == TAG_RPC_RES:
+                    with self._lock:
+                        cb = self._pending.pop(rid, None)
+                    if cb is not None:
+                        cb(payload)
+                else:
+                    self.ep._handle_request(self, rid, payload)
+            except BaseException as e:
+                # an undecodable frame is a broken peer (the pre-r21
+                # reader dropped the connection on garbage; same here)
+                if not isinstance(e, FabricError):
+                    e = FabricError(
+                        f"rpc frame from {self.peer or 'peer'} undecodable: "
+                        f"{type(e).__name__}: {e}")
+                self._fail(e, e.__cause__)
+                return
+
+    def _fail(self, err: BaseException, cause=None) -> None:
+        if cause is not None and err.__cause__ is None:
+            err.__cause__ = cause
+        with self._lock:
+            if self.err is None:
+                self.err = err
+            pending = list(self._pending.values())
+            self._pending.clear()
+        self.ep._unregister(self)
+        # shutdown BEFORE close: a reader blocked in recv holds the
+        # kernel file reference, so a bare close() would neither wake it
+        # nor send FIN — the peer would never learn the link died
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for cb in pending:
+            try:
+                cb(err)
+            except Exception:  # pragma: no cover - reply sinks must not throw
+                pass
+
+    def close(self, err: Optional[BaseException] = None) -> None:
+        self.sendq.put(None)
+        self._fail(err or FabricError("rpc link closed"))
+        self._sender.join(timeout=2.0)
+        if threading.current_thread() is not self._reader:
+            self._reader.join(timeout=2.0)
+
+
+class RpcEndpoint:
+    """One node's endpoint on the RPC plane: a listener (accept thread)
+    plus a dial-once outbound link registry — the connection handling,
+    framing, retry surface and peer registry that ``TCPChannel`` used to
+    implement on its own asyncio loop, now on the fabric core's
+    persistent links.  ``handler(link, rid, payload)`` runs on reader
+    threads for inbound requests; answer via ``link.respond(rid, body)``
+    from any thread."""
+
+    def __init__(
+        self,
+        handler=None,
+        *,
+        ledger: Optional[TransportLedger] = None,
+        ledger_class: str = "rpc",
+        max_body_bytes: int = MAX_RPC_BODY_BYTES,
+    ):
+        self.handler = handler
+        self.ledger = ledger if ledger is not None else TransportLedger()
+        self.ledger_class = ledger_class
+        self.max_body_bytes = max_body_bytes
+        self.hostport = ""
+        self._srv: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._links: dict[str, RpcLink] = {}  # outbound, by hostport
+        self._accepted: set[RpcLink] = set()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- server side ----------------------------------------------------------
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(128)
+        self._srv = srv
+        addr = srv.getsockname()
+        self.hostport = f"{addr[0]}:{addr[1]}"
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.hostport}")
+        self._accept_thread.start()
+        return self.hostport
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                s, _ = self._srv.accept()
+            except OSError:
+                return  # listener closed
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            link = RpcLink(self, s)
+            with self._lock:
+                closed = self._closed
+                if not closed:
+                    self._accepted.add(link)
+            if closed:
+                link.close()  # outside the lock: close unregisters
+                return
+
+    def _handle_request(self, link: RpcLink, rid: int, payload) -> None:
+        if self.handler is None:
+            raise FabricError("rpc request received but no handler installed")
+        self.handler(link, rid, payload)
+
+    # -- client side ----------------------------------------------------------
+
+    def get(self, peer: str) -> Optional[RpcLink]:
+        """The cached live link to ``peer``, or None (never dials)."""
+        with self._lock:
+            link = self._links.get(peer)
+            return link if link is not None and link.err is None else None
+
+    def connect(self, peer: str) -> RpcLink:
+        """Dial-once outbound link (blocking; run off the event loop).
+        A dead cached link is replaced; refusal raises FabricPeerLost."""
+        with self._lock:
+            if self._closed:
+                raise FabricError("rpc endpoint is closed")
+            link = self._links.get(peer)
+            if link is not None and link.err is None:
+                return link
+        host, port = peer.rsplit(":", 1)
+        try:
+            s = socket.create_connection((host, int(port)))
+        except OSError as e:
+            raise FabricPeerLost(f"connect {peer}: {e}") from e
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        link = RpcLink(self, s, peer)
+        with self._lock:
+            cur = self._links.get(peer)
+            if cur is None or cur.err is not None:
+                self._links[peer] = link
+                return link
+        # lost a dial race; keep the established one.  close() OUTSIDE
+        # the lock — it unregisters, which takes the lock again
+        link.close()
+        return cur
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _unregister(self, link: RpcLink) -> None:
+        with self._lock:
+            if self._links.get(link.peer) is link:
+                del self._links[link.peer]
+            self._accepted.discard(link)
+
+    def wire_stats(self) -> dict:
+        """This endpoint's class row of the merged ledger — the channel
+        keeps its legacy ``{bytes_sent, frames_sent}`` keys from this."""
+        st = self.ledger.stats()
+        return st["classes"].get(
+            self.ledger_class, {f: 0 for f in TransportLedger.FIELDS}
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            links = list(self._links.values()) + list(self._accepted)
+            self._links.clear()
+            self._accepted.clear()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        err = FabricPeerLost("connection closed")
+        for link in links:
+            link.close(err)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
 
 
 # -- cyclic-window arithmetic (shared by both endpoints of every leg) ---------

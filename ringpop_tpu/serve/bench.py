@@ -207,10 +207,13 @@ class ServiceThread:
                  flush_us: float = 0.0, inline_resolve_max: int = 4096,
                  journal=None, stats=None, journal_every: int = 64,
                  shm_slots: int = 0, shm_key_cap: int = 1 << 16,
-                 shm_max_n: int = 4):
+                 shm_max_n: int = 4, ledger=None):
         from ringpop_tpu.serve.service import RingService
 
         self.store = store
+        # ledger: a shared TransportLedger — the TCP channel accounts as
+        # class "rpc" and the shm ring as class "shm" in ONE place
+        self.ledger = ledger
         self.service = RingService(
             store, max_batch=max_batch, flush_us=flush_us,
             inline_resolve_max=inline_resolve_max, journal=journal,
@@ -224,6 +227,7 @@ class ServiceThread:
         self._started = threading.Event()
         self.hostport: Optional[str] = None
         self.shm_server = None
+        self.channel = None
         self._thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self) -> None:
@@ -232,7 +236,8 @@ class ServiceThread:
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         self._loop = loop
-        chan = TCPChannel(app="serve", codec=self._codec)
+        chan = TCPChannel(app="serve", codec=self._codec, ledger=self.ledger)
+        self.channel = chan
 
         async def boot():
             await chan.listen("127.0.0.1", 0)
@@ -244,6 +249,7 @@ class ServiceThread:
                 self.shm_server = ShmServer(
                     self.service, slots=self._shm_slots,
                     key_cap=self._shm_key_cap, max_n=self._shm_max_n,
+                    ledger=self.ledger,
                 )
                 self.shm_server.attach(loop)
             self._started.set()

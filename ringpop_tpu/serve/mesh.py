@@ -108,6 +108,7 @@ class ServeMesh:
         timeout_ms: int = 60_000,
         gen: int = 0,
         tracer=None,
+        ledger=None,
     ):
         if streams % nprocs:
             raise ValueError(
@@ -133,10 +134,15 @@ class ServeMesh:
         self.block = process_block(count, rank, nprocs)
         self.ring = device_ring(self.tokens, self.owners, _next_pow2(2 * count),
                                 gen=gen)
+        # r21: mesh exchange bytes account into the merged TransportLedger
+        # under class "exchange"; pass a shared ledger for one cross-plane
+        # byte view (wire_stats() keeps its legacy per-fabric shape)
         self.fabric = Fabric(
             rank, nprocs, kv if kv is not None else LocalKV(),
             namespace=namespace, codec=codec, timeout_ms=timeout_ms,
+            ledger=ledger,
         )
+        self.ledger = self.fabric.ledger
         self.tracer = tracer
         self.keys_local = 0
         self.keys_forwarded_out = 0

@@ -230,7 +230,13 @@ class RingService:
         future resolves to ``(owners, generation)``.  This is the ONE
         entry point both transports share — the TCP ``/lookup`` endpoint
         and the shared-memory server feed the same pending queue, so
-        cross-transport requests coalesce into the same dispatches."""
+        cross-transport requests coalesce into the same dispatches.
+
+        ``hashes`` may be a READ-ONLY VIEW of a transport buffer (r21
+        registered-buffer zero-copy): the collector never mutates it and
+        consumes it in the flush's single staging gather — the caller
+        must keep the buffer stable until its sink is delivered (the shm
+        server holds the slot unpublished exactly that long)."""
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
         loop = loop or asyncio.get_event_loop()
@@ -370,17 +376,25 @@ class RingService:
             groups.setdefault(r.n, []).append(r)
         gen = self.store.gen  # fallback if every group's dispatch fails
         for n, reqs in groups.items():
-            if len(reqs) == 1:
-                hashes = reqs[0].hashes
-            else:
-                hashes = np.concatenate([r.hashes for r in reqs])
-            total = int(hashes.shape[0])
+            # r21 zero-copy: requests may hand in read-only views of
+            # transport buffers (shm ring slots).  Gather them ONCE,
+            # directly into the padded staging buffer the device upload
+            # reads — the old concatenate-then-pad pair cost two copies
+            # of every payload byte; the slot-copy in the shm scan was a
+            # third.  The single gather below is the dispatch's own input
+            # materialization, after which the transport buffers are free
+            # to be republished.
+            total = sum(int(r.hashes.shape[0]) for r in reqs)
             p2 = _next_pow2(total)
-            if p2 == total:
-                padded = np.asarray(hashes, np.uint32)
+            if len(reqs) == 1 and p2 == total:
+                padded = np.asarray(reqs[0].hashes, np.uint32)
             else:
                 padded = np.zeros(p2, np.uint32)
-                padded[:total] = hashes
+                off = 0
+                for r in reqs:
+                    b = int(r.hashes.shape[0])
+                    padded[off:off + b] = r.hashes
+                    off += b
             dev_hashes = jnp.asarray(padded)
             try:
                 # journal the generation the dispatch ACTUALLY answered

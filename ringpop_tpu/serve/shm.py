@@ -38,6 +38,7 @@ import numpy as np
 
 from ringpop_tpu import logging as logging_mod
 from ringpop_tpu.errors import FabricPeerLost, FabricTimeout
+from ringpop_tpu.parallel.fabric import TransportLedger
 
 _logger = logging_mod.logger("serve.shm")
 
@@ -99,7 +100,23 @@ class ShmRing:
     def close(self, unlink: bool = False) -> None:
         # drop the numpy views before closing the mmap (BufferError otherwise)
         self._headers = self._hashes = self._owners = None
-        self.shm.close()
+        try:
+            self.shm.close()
+        except BufferError:
+            # r21 zero-copy: a dispatch may still hold a slot view (or a
+            # CPU jax array aliasing one) at teardown.  Collect the
+            # dropped references and retry; if a live view remains, defer
+            # the unmap to process exit — unlink below must still happen
+            # so the segment name is reclaimed either way.
+            import gc
+
+            gc.collect()
+            try:
+                self.shm.close()
+            except BufferError:
+                _logger.debug(
+                    "shm segment close deferred: exported slot views alive"
+                )
         if unlink:
             try:
                 self.shm.unlink()
@@ -112,8 +129,16 @@ class ShmServer:
     requests to a ``RingService`` collector and writes responses back."""
 
     def __init__(self, service, *, slots: int = 16, key_cap: int = 1 << 16,
-                 max_n: int = 4, burst_us: float = 500.0):
+                 max_n: int = 4, burst_us: float = 500.0,
+                 ledger: Optional[TransportLedger] = None):
         self.service = service
+        # merged transport accounting (r21), class "shm": request payload
+        # bytes read out of the ring, response bytes written back, and
+        # ``copy_bytes`` — payload bytes COPIED out of a slot before the
+        # dispatch's own staging gather.  The zero-copy contract is that
+        # this stays 0: slots are handed to the collector as read-only
+        # views and not republished until the dispatch consumed them.
+        self.ledger = ledger if ledger is not None else TransportLedger()
         # after SMALL-batch activity (count <= 64: the latency-sensitive
         # point-lookup class) the server keeps rescanning the slots for
         # ``burst_us`` before falling back to the wakeup socket — one epoll
@@ -214,17 +239,25 @@ class ShmServer:
                 # — this is the B=1 latency path
                 s, req, count, n = picked[0]
                 self._inflight.add(s)
-                hashes = ring._hashes[s][:count].copy()
-                svc.dispatch_direct(hashes, n, self._responder(s, req))
+                self.ledger.add("shm", bytes_recv=count * 4, frames_recv=1)
+                svc.dispatch_direct(
+                    self._slot_view(s, count), n, self._responder(s, req)
+                )
                 return found
             for s, req, count, n in picked:
                 self._inflight.add(s)
-                # copy out of the segment: the collector concatenates
-                # across requests anyway, and the client may reuse the
-                # slot buffer the moment resp_seq publishes
-                hashes = ring._hashes[s][:count].copy()
+                self.ledger.add("shm", bytes_recv=count * 4, frames_recv=1)
+                # r21 zero-copy: hand the collector a READ-ONLY VIEW of
+                # the slot — no copy out of the segment.  Lifetime is
+                # explicit: the slot stays in ``_inflight`` (and
+                # ``resp_seq`` unpublished, so the client keeps its hands
+                # off the buffer) until the responder runs, which is
+                # strictly after the dispatch's staging gather consumed
+                # the view.  ``flush_now`` below dispatches synchronously
+                # within this scan.
                 svc.submit_nowait(
-                    hashes, n=n, loop=self._loop, callback=self._responder(s, req)
+                    self._slot_view(s, count), n=n, loop=self._loop,
+                    callback=self._responder(s, req),
                 )
             svc.flush_now()
         except Exception as e:
@@ -238,6 +271,15 @@ class ShmServer:
                     self._responder(s, req)(None, -1)
         return found
 
+    def _slot_view(self, slot: int, count: int) -> np.ndarray:
+        """A read-only numpy view of a slot's pending hashes — the
+        registered-buffer hand-off.  Zero bytes are copied; the returned
+        view aliases the shared segment and is valid until the slot's
+        responder publishes ``resp_seq``."""
+        view = self.ring._hashes[slot][:count].view()
+        view.flags.writeable = False
+        return view
+
     def _responder(self, slot: int, req: int):
         def respond(rows, gen) -> None:
             ring = self.ring
@@ -249,8 +291,22 @@ class ShmServer:
                 ring._owners[slot][: flat.shape[0]] = flat
                 hdr[_GEN] = np.uint32(gen)
                 hdr[_STATUS] = STATUS_OK
+                self.ledger.add("shm", bytes_sent=int(flat.shape[0]) * 4,
+                                frames_sent=1)
             self._inflight.discard(slot)
             hdr[_RESP_SEQ] = np.uint32(req)
+            # retry-while-held: if the client gave up waiting and posted a
+            # NEW request into this slot while the old one was in flight,
+            # ``req_seq`` has moved past what we just answered — the wake
+            # datagram for it was already drained, so without a rescan the
+            # retry would strand until the next unrelated wake.  (This
+            # responder may run on the executor thread; scan() is
+            # loop-only, hence the threadsafe hop.)
+            if int(hdr[_REQ_SEQ]) != req and self._loop is not None:
+                try:
+                    self._loop.call_soon_threadsafe(self.scan)
+                except RuntimeError:  # pragma: no cover - loop shut down
+                    pass
 
         return respond
 
@@ -301,7 +357,10 @@ class ShmClient:
         self._hashes[:count] = np.asarray(hashes, np.uint32)
         hdr[_COUNT] = np.uint32(count)
         hdr[_N] = np.uint32(n)
-        req = np.uint32(int(hdr[_REQ_SEQ]) + 1)
+        # mask before the uint32 construction: at seq 0xFFFFFFFF the +1
+        # would overflow (newer numpy raises OverflowError instead of
+        # wrapping) — the protocol is modular, wrap-around is legitimate
+        req = np.uint32((int(hdr[_REQ_SEQ]) + 1) & 0xFFFFFFFF)
         hdr[_REQ_SEQ] = req
         try:
             self._sock.send(b"\x01")

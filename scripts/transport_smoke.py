@@ -1,0 +1,227 @@
+"""transport-smoke: the CI gate for the r21 one-transport-plane refactor.
+
+ONE script drives all four traffic families through the unified
+transport — serve lookups (shm ring + the channel folded onto the
+fabric's RPC plane), a gossip-style window exchange, an obs-class
+snapshot exchange, and a mesh-style batch forward — and asserts the
+refactor's contracts:
+
+* digests: owners from every transport lane are bit-identical to the
+  pre-refactor host-bisect oracle (sha256 over the owner bytes);
+* merged ledger: every class row of the shared ``TransportLedger``
+  equals the transport's own legacy counters — "exchange"/"obs" mirror
+  ``Fabric.wire_stats`` exactly, "rpc" equals the channel's legacy body
+  bytes plus the 16 B/frame fabric header (the OBSERVABILITY.md
+  migration mapping), and the ledger total is the sum of its classes;
+* zero-copy: ``copy_bytes`` reads 0 for the shm→dispatch path (and
+  everywhere else — no transport in the plane takes an intermediate
+  copy it has to confess).
+"""
+
+import hashlib
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _digest(arr) -> str:
+    import numpy as np
+
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def main() -> int:
+    import asyncio
+
+    import numpy as np
+
+    from ringpop_tpu.forward.batch import BatchForwarder
+    from ringpop_tpu.net import TCPChannel
+    from ringpop_tpu.parallel.fabric import (
+        _HDR,
+        Fabric,
+        LocalKV,
+        TransportLedger,
+    )
+    from ringpop_tpu.serve.bench import ServiceThread
+    from ringpop_tpu.serve.client import HostBisectFrontend, ServeClient
+    from ringpop_tpu.serve.shm import ShmClient
+    from ringpop_tpu.serve.state import RingStore
+
+    failures: list[str] = []
+    shared = TransportLedger()
+
+    # -- serve lookups: shm ring (zero-copy) + folded TCP channel ---------
+    servers = [f"10.9.0.{i}:3000" for i in range(32)]
+    store = RingStore(servers, replica_points=10)
+    th = ServiceThread(store, flush_us=0.0, shm_slots=2, shm_key_cap=4096,
+                       shm_max_n=4, ledger=shared)
+    th.start()
+    h = np.random.default_rng(7).integers(0, 2**32, size=600, dtype=np.uint32)
+    oracle = _digest(HostBisectFrontend(servers, 10).lookup_hashes(h))
+
+    name, sock, slots, cap, max_n = th.shm_address()
+    cl = ShmClient(name, sock, 0, slots=slots, key_cap=cap, max_n=max_n)
+    owners_shm, gen_shm = cl.lookup_hashes(h)  # >64 keys: collector lane
+    owners_b1, _ = cl.lookup_hashes(h[:8])  # <=64: B=1 direct lane
+    cl.close()
+    if _digest(owners_shm) != oracle:
+        failures.append("shm collector-lane owners diverged from the oracle")
+    if _digest(owners_b1) != _digest(
+        HostBisectFrontend(servers, 10).lookup_hashes(h[:8])
+    ):
+        failures.append("shm B=1 direct-lane owners diverged from the oracle")
+
+    async def tcp_leg():
+        chan = TCPChannel(app="smoke", ledger=shared)
+        sc = ServeClient(chan, th.hostport)
+        o_tcp, g = await sc.lookup_hashes(h)
+        # mesh-style forward: the reference HandleOrForward RPC shape,
+        # retries + hop guard, over the same folded channel
+        fwd = BatchForwarder(chan, fabric_arrays=True)
+        o_fwd, g2 = await fwd.forward_batch(th.hostport, h)
+        legacy = dict(chan.wire_stats())
+        await chan.close()
+        return o_tcp, g, o_fwd, g2, legacy, fwd.stats()
+
+    o_tcp, gen_tcp, o_fwd, gen_fwd, cli_legacy, fwd_stats = (
+        asyncio.new_event_loop().run_until_complete(tcp_leg())
+    )
+    if _digest(o_tcp) != oracle:
+        failures.append("TCP (folded channel) owners diverged from the oracle")
+    if _digest(o_fwd) != oracle:
+        failures.append("mesh forward owners diverged from the oracle")
+    if not (gen_shm == gen_tcp == gen_fwd):
+        failures.append(
+            f"generation skew across transports: shm={gen_shm} "
+            f"tcp={gen_tcp} fwd={gen_fwd}"
+        )
+    if fwd_stats["rpcs"] != 1 or fwd_stats["retries"] != 0:
+        failures.append(f"forward took retries on a healthy link: {fwd_stats}")
+    srv_legacy = dict(th.channel.wire_stats())
+    th.stop()
+
+    # -- gossip window exchange + obs snapshot on fabric pairs ------------
+    def fabric_pair(klass: str, ns: str, ticks: int, width: int):
+        kv = LocalKV()
+        legacy = [None, None]
+        sent = [None, None]
+        got = [None, None]
+        errs: list[BaseException] = []
+
+        def run(rank: int):
+            try:
+                with Fabric(rank, 2, kv, namespace=ns, timeout_ms=60_000,
+                            ledger=shared, ledger_class=klass) as fab:
+                    peer = 1 - rank
+                    rng = np.random.default_rng(40 + rank)
+                    mine, theirs = [], []
+                    for tick in range(ticks):
+                        arrs = [rng.integers(0, 2**32, width,
+                                             dtype=np.uint32)]
+                        mine.append(arrs[0])
+                        res = fab.exchange_async(
+                            tick + 1, {peer: arrs}, [peer]
+                        ).wait()
+                        theirs.append(res[peer][0])
+                    legacy[rank] = fab.wire_stats()
+                    sent[rank] = mine
+                    got[rank] = theirs
+            except BaseException as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        if errs or any(t.is_alive() for t in ts):
+            failures.append(f"{klass} fabric pair failed: {errs}")
+            return
+        for rank in (0, 1):
+            want = [_digest(a) for a in sent[1 - rank]]
+            have = [_digest(a) for a in got[rank]]
+            if want != have:
+                failures.append(f"{klass} exchange payloads corrupted")
+        row = shared.stats()["classes"].get(klass, {})
+        leg = {
+            k: legacy[0][k] + legacy[1][k]
+            for k in ("bytes_sent", "bytes_recv",
+                      "raw_bytes_sent", "raw_bytes_recv")
+        }
+        for k, v in leg.items():
+            if row.get(k) != v:
+                failures.append(
+                    f"ledger class {klass!r} {k}={row.get(k)} != "
+                    f"legacy fabric sum {v}"
+                )
+
+    fabric_pair("exchange", "transport-smoke-gossip", ticks=4, width=1 << 12)
+    fabric_pair("obs", "transport-smoke-obs", ticks=2, width=257)
+
+    # -- merged-ledger contracts ------------------------------------------
+    st = shared.stats()
+    classes = st["classes"]
+    want_classes = {"rpc", "shm", "exchange", "obs"}
+    if set(classes) != want_classes:
+        failures.append(
+            f"ledger classes {sorted(classes)} != {sorted(want_classes)}"
+        )
+
+    # rpc row == legacy channel counters (body bytes) + 16 B/frame header.
+    # Client and server channels share the ledger, so the row sums both.
+    rpc = classes.get("rpc", {})
+    legacy_frames = cli_legacy["frames_sent"] + srv_legacy["frames_sent"]
+    legacy_bytes = cli_legacy["bytes_sent"] + srv_legacy["bytes_sent"]
+    if rpc.get("frames_sent") != legacy_frames:
+        failures.append(
+            f"rpc frames_sent {rpc.get('frames_sent')} != legacy "
+            f"channel frame sum {legacy_frames}"
+        )
+    if rpc.get("bytes_sent") != legacy_bytes + _HDR.size * legacy_frames:
+        failures.append(
+            f"rpc bytes_sent {rpc.get('bytes_sent')} != legacy "
+            f"{legacy_bytes} + {_HDR.size}*{legacy_frames}"
+        )
+    if rpc.get("frames_recv") != legacy_frames:  # both ends on one ledger
+        failures.append("rpc frames_recv != frames_sent on a shared ledger")
+
+    # shm row: request/response words accounted, NOTHING copied
+    shm_row = classes.get("shm", {})
+    if shm_row.get("frames_recv", 0) < 2 or shm_row.get("frames_sent", 0) < 2:
+        failures.append(f"shm ring traffic unaccounted: {shm_row}")
+    if shm_row.get("bytes_recv") != (600 + 8) * 4:
+        failures.append(
+            f"shm bytes_recv {shm_row.get('bytes_recv')} != request words"
+        )
+
+    # zero-copy: nothing in the whole plane confessed an intermediate copy
+    if st["copy_bytes"] != 0:
+        failures.append(f"copy_bytes {st['copy_bytes']} != 0 — a transport "
+                        "took an intermediate copy")
+
+    # total == sum of classes (the merge is lossless)
+    for k in ("bytes_sent", "bytes_recv", "frames_sent", "frames_recv"):
+        if st["total"][k] != sum(row[k] for row in classes.values()):
+            failures.append(f"ledger total[{k}] != sum of class rows")
+
+    if failures:
+        print("transport-smoke FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(
+        "transport-smoke OK: serve(shm+tcp)/gossip/obs/forward digests == "
+        f"oracle; ledger classes {sorted(classes)} reconcile with legacy "
+        f"counters; copy_bytes=0 "
+        f"(total {st['total']['bytes_sent']}B sent / "
+        f"{st['total']['bytes_recv']}B recv)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,10 +292,28 @@ def test_tcp_msgpack_unencodable_error_still_answers():
 
 
 def test_tcp_reader_survives_garbage_frames():
-    """Scalar msgpack payloads, oversized length prefixes, and empty JSON
-    frames must not crash the reader: garbage breaks only its own
-    connection, and '{}' gets a normal 'no handler' error reply."""
+    """Garbage bodies (scalar msgpack payloads, empty JSON objects) and
+    malformed transport headers must not crash the reader: garbage breaks
+    only its own connection, and '{}' gets a normal 'no handler' error
+    reply.  r21: raw clients speak the fabric RPC framing — a 16-byte
+    header (RPC tag | request id, blob count, body length) before each
+    body; the body encodings themselves are the pre-fold bytes."""
     import struct
+
+    from ringpop_tpu.net.channel import MAX_FRAME_BYTES
+    from ringpop_tpu.parallel.fabric import _HDR, TAG_RPC_REQ, TAG_RPC_RES
+
+    def req_frame(rid: int, body: bytes) -> bytes:
+        return _HDR.pack(TAG_RPC_REQ | rid, 1, len(body)) + body
+
+    async def dropped(r) -> bool:
+        # a drop may surface as EOF or as RST (the server closes without
+        # draining the bad payload); both mean "connection terminated,
+        # nothing delivered" — what this test pins
+        try:
+            return await r.read(64) == b""
+        except ConnectionError:
+            return True
 
     async def main():
         server = TCPChannel(app="t")
@@ -303,30 +321,41 @@ def test_tcp_reader_survives_garbage_frames():
         server.register("svc", "/ok", lambda b, h: {"ok": True})
         host, port = server.hostport.rsplit(":", 1)
 
-        # msgpack frame that unpacks to a scalar -> clean connection drop
+        # msgpack body that unpacks to a scalar -> clean connection drop
         r, w = await asyncio.open_connection(host, int(port))
-        w.write(b"\xc1" + struct.pack(">I", 1) + b"\x05")
+        w.write(req_frame(1, b"\xc1" + struct.pack(">I", 1) + b"\x05"))
         await w.drain()
-        assert await r.read(64) == b""  # server closed, no crash
+        assert await dropped(r)  # server closed, no crash
         w.close()
 
-        # oversized length prefix -> clean drop, nothing buffered
+        # transport header declaring an oversized body -> clean drop
+        # BEFORE the server buffers anything
         r, w = await asyncio.open_connection(host, int(port))
-        w.write(b"\xc1" + struct.pack(">I", 0xFFFFFFFF))
+        w.write(_HDR.pack(TAG_RPC_REQ | 2, 1, MAX_FRAME_BYTES + 1))
         await w.drain()
         w.write_eof()
-        assert await r.read(64) == b""
+        assert await dropped(r)
         w.close()
 
-        # a bare '{}' JSON frame is a real (malformed) request: it must get
+        # a non-RPC tag (an exchange-stream tag on the RPC port) is a
+        # desynced peer -> clean drop
+        r, w = await asyncio.open_connection(host, int(port))
+        w.write(_HDR.pack(0x01000003, 1, 4) + b"ABCD")
+        await w.drain()
+        assert await dropped(r)
+        w.close()
+
+        # a bare '{}' JSON body is a real (malformed) request: it must get
         # an error REPLY, not be silently swallowed
         r, w = await asyncio.open_connection(host, int(port))
-        w.write(b"{}\n")
+        w.write(req_frame(3, b"{}\n"))
         await w.drain()
-        line = await asyncio.wait_for(r.readline(), timeout=2.0)
+        hdr = await asyncio.wait_for(r.readexactly(_HDR.size), timeout=2.0)
+        tag, n_blobs, total = _HDR.unpack(hdr)
+        assert tag == (TAG_RPC_RES | 3) and n_blobs == 1
         import json as _json
 
-        res = _json.loads(line)
+        res = _json.loads(await asyncio.wait_for(r.readexactly(total), timeout=2.0))
         assert res["ok"] is False and "no handler" in res["err"]
         w.close()
 

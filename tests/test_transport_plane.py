@@ -1,0 +1,525 @@
+"""r21 "one transport plane" suite.
+
+Pins the tentpole's two halves and the satellites:
+
+* registered-buffer zero-copy — the shm ring hands the collector
+  READ-ONLY VIEWS of its slots (``np.shares_memory`` proof, not a
+  counter claim), the slot is not republished until the dispatch's
+  staging gather consumed it, and the merged ledger's ``copy_bytes``
+  reads 0 for the shm→dispatch path;
+* slot lifetime under the zero-copy protocol — seq-word wrap-around, a
+  dispatch still holding a slot view when the frontend retries (the
+  responder's rescan), STATUS_ERR republication under a mid-scan
+  exception (the r13 poison pin, extended to views);
+* pooled receive buffers — ``_recv_exact``'s allocation-count pin (the
+  per-frame ``bytearray`` can't regress back);
+* the merged ``TransportLedger`` — per-class sums equal the legacy
+  per-transport ledgers on identical traffic (exchange == the fabric's
+  ``wire_stats``; rpc == the channel's legacy body counters + the
+  16 B/frame fabric header);
+* the deduped codec stack — channel body/array wire bytes unchanged
+  (round-trip + exact-bytes pins on the thin JSON/base64 leg).
+"""
+
+import asyncio
+import base64
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.net.channel import (
+    MAX_FRAME_BYTES,
+    TCPChannel,
+    _decode_frame_body,
+    _frame_bytes,
+    _msgpack_frame_bytes,
+    decode_array,
+    encode_array,
+)
+from ringpop_tpu.parallel.fabric import (
+    _HDR,
+    RECV_ALLOCS,
+    Fabric,
+    LocalKV,
+    RpcEndpoint,
+    TransportLedger,
+    _recv_exact,
+    frame_array,
+)
+from ringpop_tpu.serve import shm as shm_mod
+from ringpop_tpu.serve.shm import (
+    _COUNT,
+    _GEN,
+    _N,
+    _REQ_SEQ,
+    _RESP_SEQ,
+    _STATUS,
+    STATUS_ERR,
+    STATUS_OK,
+    ShmClient,
+    ShmRing,
+    ShmServer,
+)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class _CapturingService:
+    """RingService stand-in: records what the shm server hands it.
+
+    ``scan()`` routes a lone small request (count <= 64, nothing queued)
+    through ``dispatch_direct`` and everything else through
+    ``submit_nowait`` + ``flush_now`` — tests that want the collector
+    lane post > 64 hashes."""
+
+    def __init__(self):
+        self._pending = []  # (hashes, n, callback) awaiting flush
+        self.submitted = []  # every submit_nowait ever, same triples
+        self.direct = []  # every dispatch_direct
+        self.answer_on_flush = True
+        self.raise_on_flush = None
+
+    def submit_nowait(self, hashes, n, callback, loop=None):
+        self.submitted.append((hashes, n, callback))
+        self._pending.append((hashes, n, callback))
+
+    def flush_now(self):
+        if self.raise_on_flush is not None:
+            raise self.raise_on_flush
+        if not self.answer_on_flush:
+            return  # dispatch "holds" the slot views
+        pend, self._pending[:] = list(self._pending), []
+        for hashes, n, cb in pend:
+            cb(np.zeros(len(hashes) * n, np.int32), 7)
+
+    def dispatch_direct(self, hashes, n, callback):
+        self.direct.append((hashes, n, callback))
+        callback(np.zeros(len(hashes) * n, np.int32), 7)
+
+
+def _post(server: ShmServer, slot: int, hashes: np.ndarray, n: int = 1) -> int:
+    """Write a request into a slot the way ShmClient does; returns req."""
+    ring = server.ring
+    hdr = ring._headers[slot]
+    ring._hashes[slot][: len(hashes)] = hashes
+    hdr[_COUNT] = np.uint32(len(hashes))
+    hdr[_N] = np.uint32(n)
+    req = (int(hdr[_REQ_SEQ]) + 1) & 0xFFFFFFFF
+    hdr[_REQ_SEQ] = np.uint32(req)
+    return req
+
+
+# -- registered-buffer zero-copy ---------------------------------------------
+
+
+def test_shm_scan_hands_collector_a_shared_readonly_view():
+    """The shm→dispatch hand-off is ZERO-copy, proven by aliasing: the
+    array the collector receives shares memory with the ring segment, is
+    read-only, and the ledger's copy_bytes stays 0."""
+    svc = _CapturingService()
+    server = ShmServer(svc, slots=2, key_cap=256, max_n=2)
+    try:
+        hashes = np.arange(100, dtype=np.uint32) + 5
+        _post(server, 0, hashes)
+        assert server.scan() == 1
+        (got, n, _cb), = svc.submitted
+        assert n == 1
+        assert np.shares_memory(got, server.ring._hashes[0])
+        assert not got.flags.writeable
+        assert np.array_equal(got, hashes)
+        row = server.ledger.stats()["classes"]["shm"]
+        assert row["copy_bytes"] == 0
+        assert row["bytes_recv"] == hashes.nbytes and row["frames_recv"] == 1
+        # the responder answered (capturing service answers on flush):
+        # the slot republished only AFTER the collector consumed the view
+        hdr = server.ring._headers[0]
+        assert int(hdr[_RESP_SEQ]) == int(hdr[_REQ_SEQ])
+        assert int(hdr[_STATUS]) == STATUS_OK
+        assert row["bytes_sent"] == hashes.nbytes and row["frames_sent"] == 1
+        del got, hdr  # release segment views so close() can unmap
+        svc.submitted.clear()
+    finally:
+        server.close()
+
+
+def test_shm_direct_lane_is_zero_copy_too():
+    svc = _CapturingService()
+    server = ShmServer(svc, slots=1, key_cap=256, max_n=2)
+    try:
+        _post(server, 0, np.arange(8, dtype=np.uint32), n=2)
+        assert server.scan() == 1
+        (got, n, _cb), = svc.direct
+        assert n == 2 and np.shares_memory(got, server.ring._hashes[0])
+        assert not got.flags.writeable
+        assert server.ledger.stats()["copy_bytes"] == 0
+        del got
+        svc.direct.clear()
+    finally:
+        server.close()
+
+
+def test_shm_slot_not_republished_until_dispatch_consumes():
+    """Explicit lifetime: while the dispatch holds the slot view
+    (callback not yet delivered), resp_seq stays unpublished and the
+    slot stays in _inflight — the client cannot reuse the buffer under
+    the dispatch."""
+    svc = _CapturingService()
+    svc.answer_on_flush = False  # hold the view
+    server = ShmServer(svc, slots=2, key_cap=256, max_n=1)
+    try:
+        req = _post(server, 0, np.arange(100, dtype=np.uint32))
+        server.scan()
+        hdr = server.ring._headers[0]
+        assert int(hdr[_RESP_SEQ]) != req and 0 in server._inflight
+        # ... dispatch completes later:
+        (_got, _n, cb), = svc._pending
+        svc._pending.clear()
+        cb(np.zeros(100, np.int32), 3)
+        assert int(hdr[_RESP_SEQ]) == req and 0 not in server._inflight
+        assert int(hdr[_GEN]) == 3
+        del _got
+        svc.submitted.clear()
+    finally:
+        server.close()
+
+
+# -- slot lifetime property tests --------------------------------------------
+
+
+def test_shm_seq_word_wraparound():
+    """req_seq is modular uint32: a client sitting at 0xFFFFFFFF must
+    wrap to 0 (numpy would raise OverflowError on the naive +1) and the
+    whole request/response protocol keeps working across the wrap."""
+    svc = _CapturingService()
+    server = ShmServer(svc, slots=1, key_cap=64, max_n=1)
+    name, sock_path = server.address
+    client = ShmClient(name, sock_path, 0, slots=1, key_cap=64, max_n=1,
+                       timeout=5.0, spin_us=50.0)
+    # park the slot one bump below the wrap
+    client._hdr[_REQ_SEQ] = np.uint32(0xFFFFFFFF)
+    client._hdr[_RESP_SEQ] = np.uint32(0xFFFFFFFF)
+
+    # fake server loop: answer posted requests like scan+dispatch would
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            if server.scan() == 0:
+                time.sleep(0.0005)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        for k in range(3):  # crosses the wrap on the first post
+            owners, gen = client.lookup_hashes(np.arange(4, dtype=np.uint32))
+            assert owners.shape == (4,) and gen == 7
+        assert int(client._hdr[_REQ_SEQ]) == 2  # 0xFFFFFFFF -> 0 -> 1 -> 2
+    finally:
+        stop.set()
+        t.join(2)
+        client.close()
+        server.close()
+
+
+def test_shm_retry_while_dispatch_holds_slot():
+    """A frontend that times out and reposts into its slot while the old
+    dispatch still holds the view: the old answer publishes under the
+    OLD req (the client ignores it), and the responder's rescan picks up
+    the retry even though its wake datagram was already drained."""
+    svc = _CapturingService()
+    svc.answer_on_flush = False
+    server = ShmServer(svc, slots=1, key_cap=256, max_n=1)
+
+    async def main():
+        loop = asyncio.get_event_loop()
+        server.attach(loop)
+        old_req = _post(server, 0, np.arange(100, dtype=np.uint32))
+        server.scan()
+        assert 0 in server._inflight
+        # frontend gives up and retries with DIFFERENT data (no datagram:
+        # it was already drained in the real interleaving)
+        new_req = _post(server, 0, np.arange(100, dtype=np.uint32) + 7)
+        assert new_req != old_req
+        # old dispatch finally completes -> responder publishes old req,
+        # notices req_seq moved, schedules a rescan on the loop
+        (_got, _n, cb), = svc._pending
+        svc._pending.clear()
+        svc.answer_on_flush = True
+        cb(np.zeros(100, np.int32), 7)
+        hdr = server.ring._headers[0]
+        assert int(hdr[_RESP_SEQ]) == old_req  # stale answer, client ignores
+        for _ in range(50):  # let the rescan run
+            await asyncio.sleep(0.01)
+            if int(hdr[_RESP_SEQ]) == new_req:
+                break
+        assert int(hdr[_RESP_SEQ]) == new_req, "retry stranded — rescan missing"
+        assert len(svc.submitted) == 2
+        assert np.array_equal(
+            svc.submitted[1][0], np.arange(100, dtype=np.uint32) + 7
+        )
+        svc.submitted.clear()
+
+    try:
+        _run(main())
+    finally:
+        server._loop = None
+        server.close()
+
+
+def test_shm_status_err_republication_on_mid_scan_exception():
+    """The r13 poison pin on the zero-copy path: a collector that blows
+    up mid-scan must answer STATUS_ERR on every picked slot (views and
+    all), leave nothing in _inflight, and keep serving afterwards."""
+    svc = _CapturingService()
+    svc.raise_on_flush = RuntimeError("deliberate poison")
+    server = ShmServer(svc, slots=2, key_cap=256, max_n=1)
+    try:
+        r0 = _post(server, 0, np.arange(70, dtype=np.uint32))
+        r1 = _post(server, 1, np.arange(70, dtype=np.uint32))
+        server.scan()
+        for s, req in ((0, r0), (1, r1)):
+            hdr = server.ring._headers[s]
+            assert int(hdr[_RESP_SEQ]) == req
+            assert int(hdr[_STATUS]) == STATUS_ERR
+        assert not server._inflight
+        # next scan still works
+        svc.raise_on_flush = None
+        svc._pending.clear()  # the poisoned flush never drained these
+        r0b = _post(server, 0, np.arange(70, dtype=np.uint32))
+        server.scan()
+        hdr = server.ring._headers[0]
+        assert int(hdr[_RESP_SEQ]) == r0b and int(hdr[_STATUS]) == STATUS_OK
+        del hdr
+        svc.submitted.clear()
+        svc._pending.clear()
+    finally:
+        server.close()
+
+
+# -- pooled receive buffers ---------------------------------------------------
+
+
+def test_recv_exact_pooled_buffer_allocation_pin():
+    """With a pooled buffer, _recv_exact must not allocate per frame —
+    the regression this pins out cost one bytearray per received frame."""
+    a, b = socket.socketpair()
+    try:
+        pool = bytearray(1 << 12)
+        payload = bytes(range(256)) * 8  # 2 KiB
+        base = RECV_ALLOCS.n
+        for _ in range(50):
+            a.sendall(payload)
+            got = _recv_exact(b, len(payload), pool)
+            assert bytes(got) == payload
+        assert RECV_ALLOCS.n == base, "pooled receive allocated per frame"
+        # without a pool (or an undersized one) it must count the alloc
+        a.sendall(payload)
+        _recv_exact(b, len(payload))
+        a.sendall(payload)
+        _recv_exact(b, len(payload), bytearray(8))
+        assert RECV_ALLOCS.n == base + 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_returns_sized_view():
+    a, b = socket.socketpair()
+    try:
+        pool = bytearray(64)
+        a.sendall(b"xyz")
+        got = _recv_exact(b, 3, pool)
+        assert isinstance(got, memoryview) and len(got) == 3
+        assert got.obj is pool  # really the pooled storage, no copy
+    finally:
+        a.close()
+        b.close()
+
+
+# -- merged ledger: per-class sums equal the legacy ledgers -------------------
+
+
+def test_fabric_ledger_class_equals_legacy_wire_stats():
+    """Class "exchange" of the merged ledger mirrors the fabric's legacy
+    wire counters at the same accounting sites — equal by construction,
+    pinned here on real two-rank traffic."""
+    kv = LocalKV()
+    out = [None, None]
+    errs = []
+
+    def run(rank):
+        try:
+            with Fabric(rank, 2, kv, namespace="t-ledger",
+                        timeout_ms=30_000) as fab:
+                peer = 1 - rank
+                rng = np.random.default_rng(rank)
+                for tick in range(3):
+                    arrs = [rng.integers(0, 2**32, 257, dtype=np.uint32)]
+                    fab.exchange_async(tick + 1, {peer: arrs}, [peer]).wait()
+                out[rank] = (fab.wire_stats(), fab.ledger.stats())
+        except BaseException as e:  # surfaces in the main thread's assert
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(r,), daemon=True) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs and not any(t.is_alive() for t in ts), errs
+    for ws, ls in out:
+        row = ls["classes"]["exchange"]
+        assert row["bytes_sent"] == ws["bytes_sent"] > 0
+        assert row["bytes_recv"] == ws["bytes_recv"] > 0
+        assert row["raw_bytes_sent"] == ws["raw_bytes_sent"]
+        assert row["raw_bytes_recv"] == ws["raw_bytes_recv"]
+        assert row["frames_sent"] == row["frames_recv"] == 3
+        assert row["copy_bytes"] == 0
+
+
+def test_channel_ledger_class_maps_to_legacy_counters():
+    """Class "rpc" vs the channel's legacy {bytes_sent, frames_sent}:
+    frames match exactly; transport bytes are the legacy body bytes plus
+    the 16 B/frame fabric header (the documented migration mapping)."""
+
+    async def main():
+        shared = TransportLedger()
+        server = TCPChannel(app="srv", ledger=shared)
+        server.register("svc", "/echo", lambda b, h: {"x": b.get("x")})
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="cli", ledger=shared)
+        for i in range(5):
+            await client.call(addr, "svc", "/echo", {"x": i}, timeout=5)
+        legacy = client.wire_stats(), server.wire_stats()
+        row = shared.stats()["classes"]["rpc"]
+        await client.close()
+        await server.close()
+        frames = sum(s["frames_sent"] for s in legacy)
+        body_bytes = sum(s["bytes_sent"] for s in legacy)
+        assert row["frames_sent"] == frames == 10
+        assert row["bytes_sent"] == body_bytes + _HDR.size * frames
+        # both endpoints share the ledger, so recv mirrors send exactly
+        assert row["frames_recv"] == frames
+        assert row["bytes_recv"] == row["bytes_sent"]
+        assert row["copy_bytes"] == 0
+
+    _run(main())
+
+
+def test_ledger_total_sums_classes():
+    led = TransportLedger()
+    led.add("a", bytes_sent=3, frames_sent=1)
+    led.add("b", bytes_sent=5, bytes_recv=2, copy_bytes=4)
+    st = led.stats()
+    assert st["total"]["bytes_sent"] == 8
+    assert st["total"]["bytes_recv"] == 2
+    assert st["copy_bytes"] == 4
+    assert set(st["classes"]) == {"a", "b"}
+
+
+# -- the folded channel's wire behavior ---------------------------------------
+
+
+def test_rpc_frame_wire_format():
+    """A channel request on the wire is exactly one fabric _HDR frame
+    around the UNCHANGED body encoding — pinned byte-for-byte so a
+    desync between folded endpoints can't hide."""
+    body = _frame_bytes({"id": 1, "kind": "req", "svc": "s", "ep": "/e",
+                         "body": {"x": 1}, "headers": {}})
+    # the body leg is the pre-fold json line, byte-identical
+    assert body == (
+        b'{"id":1,"kind":"req","svc":"s","ep":"/e","body":{"x":1},"headers":{}}\n'
+    )
+    decoded = _decode_frame_body(memoryview(body))
+    assert decoded["id"] == 1 and decoded["body"] == {"x": 1}
+    # msgpack leg: 0xC1 magic + uint32-be length + msgpack payload
+    mp = _msgpack_frame_bytes({"id": 2, "ok": True})
+    assert mp[0] == 0xC1
+    ln = int.from_bytes(mp[1:5], "big")
+    assert len(mp) == 5 + ln
+    assert _decode_frame_body(memoryview(mp)) == {"id": 2, "ok": True}
+    # garbage stays garbage
+    assert _decode_frame_body(b"") is None
+    assert _decode_frame_body(b"\x00junk") is None
+    assert _decode_frame_body(b"\xc1\x00\x00\x00\x02\x05") is None  # scalar
+
+
+@pytest.mark.parametrize("codec", ["json", "msgpack"])
+def test_encode_array_thin_wrapper_round_trip(codec):
+    """Satellite pin: the array lanes survive the codec dedupe with the
+    wire format unchanged — plain lane bytes are exactly tobytes/base64,
+    fabric lane bytes are exactly frame_array's."""
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 2**32, 513, dtype=np.uint32)
+    plain = encode_array(arr, codec, "<u4")
+    if codec == "msgpack":
+        assert plain == arr.tobytes()
+    else:
+        assert plain == base64.b64encode(arr.tobytes()).decode("ascii")
+    assert np.array_equal(decode_array(plain, "<u4"), arr)
+    fab = encode_array(arr, codec, "<u4", fabric=True)
+    raw = fab["_fab"] if codec == "msgpack" else base64.b64decode(fab["_fab"])
+    assert bytes(raw) == frame_array(arr)
+    assert np.array_equal(decode_array(fab, "<u4"), arr)
+
+
+def test_channel_close_fails_pending_with_fabric_family():
+    """Closing the server while a call is in flight surfaces as the
+    fabric error family (the only transport error surface)."""
+    from ringpop_tpu.errors import FabricPeerLost
+
+    async def main():
+        server = TCPChannel(app="srv")
+
+        async def slow(body, headers):
+            await asyncio.sleep(30)
+            return {}
+
+        server.register("svc", "/slow", slow)
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="cli")
+        task = asyncio.ensure_future(
+            client.call(addr, "svc", "/slow", {}, timeout=20)
+        )
+        await asyncio.sleep(0.1)
+        await server.close()
+        with pytest.raises(FabricPeerLost):
+            await task
+        await client.close()
+
+    _run(main())
+
+
+def test_rpc_endpoint_concurrent_requests_demux_by_id():
+    """The tagged demux under concurrency: interleaved responses land on
+    the right callers (the multiplex the asyncio reader used to do)."""
+
+    async def main():
+        server = TCPChannel(app="srv")
+
+        async def echo(body, headers):
+            await asyncio.sleep(0.001 * (body["x"] % 5))
+            return {"x": body["x"]}
+
+        server.register("svc", "/echo", echo)
+        addr = await server.listen("127.0.0.1", 0)
+        client = TCPChannel(app="cli")
+        res = await asyncio.gather(
+            *(client.call(addr, "svc", "/echo", {"x": i}, timeout=10)
+              for i in range(40))
+        )
+        assert [r["x"] for r in res] == list(range(40))
+        await client.close()
+        await server.close()
+
+    _run(main())
