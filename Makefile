@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke transport-smoke
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke transport-smoke gameday-smoke bench-trend bench-trend-report
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke transport-smoke lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke transport-smoke gameday-smoke bench-trend-report lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # live-operations-plane gate (r20, obs/): a P=2 in-process fleet sweep
@@ -40,6 +40,28 @@ live-smoke:
 # digests are bit-identical tracing-on vs off.
 trace-smoke:
 	$(PY) scripts/trace_smoke.py
+
+# closed-observability-loop gate (r22, obs/rules.py + obs/controller.py):
+# one in-process game day — zone cut into a live P=2 fleet with the rule
+# engine + OpsController attached; the controller must drain the cut
+# zone's ring block strictly EARLIER than the no-controller twin's
+# organic SWIM declaration, controller-on == controller-off == bare
+# no-obs digests bit for bit, the drain's effect probe reads 0, and
+# obs.chain() reconstructs alert -> action -> effect from the journal.
+gameday-smoke:
+	$(PY) scripts/gameday_smoke.py
+
+# perf-trajectory tripwire (r22): a fresh quick measurement (transport
+# RTT best-of-N p50, no jax) against the newest committed BENCH_*.json
+# value per tracked row, direction-aware; exit 1 on a >15% regression.
+# bench-trend-report is the make-test wiring — same comparison, always
+# exit 0 (the 2-core CI container reports trends, it does not gate on
+# them; gate deliberately via make bench-trend).
+bench-trend:
+	$(PY) scripts/bench_trend.py
+
+bench-trend-report:
+	$(PY) scripts/bench_trend.py --report-only
 
 # one-transport-plane gate (r21): serve lookups (shm zero-copy + folded
 # TCP), a gossip window exchange, an obs-class snapshot and a mesh-style

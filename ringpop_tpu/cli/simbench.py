@@ -2586,6 +2586,74 @@ def bench_swing_overlap(seed: int, full: bool) -> dict:
     }
 
 
+def bench_gameday(seed: int, full: bool) -> dict:
+    """r22 closed-loop game day: inject a correlated failure (r18
+    topology scenarios) into a live P=2 fleet with the alert-rule
+    engine + OpsController attached and judge TIME-TO-MITIGATE against
+    the digest-identical no-controller twin.  The controller acts on
+    the probe-timeout spike one journal block after the cut; the twin
+    waits for SWIM's organic declaration (suspect_ticks + spread), so
+    a working loop is strictly earlier.  Certification (zone cut):
+    mitigated strictly earlier, controller-on == controller-off ==
+    bare-HEAD digests bit for bit, drain effect probe reads 0, and the
+    alert→action→effect chain reconstructs from the journal alone.
+    ``full`` adds the switch-flap scenario (reported, not gating — a
+    flap HEALS itself; draining on it is aggressive-but-sound, the
+    zone cut is the canonical judged event)."""
+    from ringpop_tpu.obs.gameday import bare_digests, gameday_pair
+
+    n = 128 if full else 64
+    horizon = 64 if full else 48
+    scenarios_run = ("zone_cut", "switch_flap") if full else ("zone_cut",)
+    out: dict = {"metric": "gameday", "n_nodes": n, "horizon": horizon}
+    for scenario in scenarios_run:
+        pair = gameday_pair(scenario=scenario, n=n, seed=seed, horizon=horizon)
+        head = bare_digests(scenario=scenario, n=n, seed=seed, horizon=horizon)
+        on, off = pair["on"], pair["off"]
+        drains = [
+            a for a in on["actions"]
+            if a["action"] == "drain" and a["ok"]
+        ]
+        effects = [
+            a for a in on["actions"]
+            if a["action"] == "effect" and a["ok"]
+        ]
+        chain_ok = bool(on["chains"]) and all(
+            ch and ch[0]["kind"] == "alert"
+            and any(c["kind"] == "action" for c in ch)
+            for ch in on["chains"]
+        )
+        out[scenario] = {
+            "cut_at": on["cut_at"],
+            "ttm_on": pair["ttm_on"],
+            "ttm_off": pair["ttm_off"],
+            "mitigated_earlier": pair["mitigated_earlier"],
+            "digest_equal": pair["digest_equal"],
+            "digest_matches_head": off["digests"] == head,
+            "alerts": len(on["alerts"]),
+            "twin_actions": len(off["actions"]),
+            "drains_ok": len(drains),
+            "effects_ok": len(effects),
+            "chain_ok": chain_ok,
+            "stray_rules": sorted(
+                {a["rule"] for a in on["alerts"]} - {"probe-timeout-spike"}
+            ),
+        }
+    zc = out["zone_cut"]
+    out["value"] = round(zc["ttm_on"] / max(zc["ttm_off"], 1), 3)
+    out["unit"] = "controller_over_twin_ttm"
+    out["certified"] = bool(
+        zc["mitigated_earlier"]
+        and zc["digest_equal"]
+        and zc["digest_matches_head"]
+        and zc["twin_actions"] == 0
+        and zc["drains_ok"] >= 1
+        and zc["effects_ok"] >= 1
+        and zc["chain_ok"]
+    )
+    return out
+
+
 BENCHES = {
     "host10": bench_host10,
     "loss1k": bench_loss1k,
@@ -2611,6 +2679,7 @@ BENCHES = {
     "flap1k": bench_flap1k,
     "asym_partition": bench_asym_partition,
     "topo_chaos": bench_topo_chaos,
+    "gameday": bench_gameday,
 }
 
 

@@ -235,6 +235,19 @@ def rank_of_hashes(tokens: np.ndarray, hashes, nprocs: int) -> np.ndarray:
     return (idx // (count // nprocs)).astype(np.int32)
 
 
+def rank_load(tokens: np.ndarray, hashes, nprocs: int) -> np.ndarray:
+    """Per-serve-process key-share histogram (length ``nprocs``) of a
+    hash population under :func:`rank_of_hashes` — the load-skew signal
+    the closed-loop rules engine watches (``obs/rules.py``
+    CrossRankSkew gauges one rank's share against the fleet mean).
+    Block shares renumber with the token count on membership changes,
+    so drain EFFECTS are probed per server name (``lookup_batch``), not
+    through this block view."""
+    return np.bincount(
+        rank_of_hashes(tokens, hashes, nprocs), minlength=nprocs
+    ).astype(np.int64)
+
+
 class BlockRouter:
     """HandleOrForward over ring blocks: the frontend-side (and
     receive-side) routing plane of the serve mesh's TCP flavor.

@@ -456,9 +456,13 @@ class TCPChannel(BaseChannel):
                 {"id": rid_body, "kind": "res", "ok": False,
                  "err": f"response encode failed: {type(e).__name__}"}
             )
-        link.respond(rid, payload)
+        # Count BEFORE handing the frame to the link: once respond() writes
+        # the socket the client can observe the reply and read wire_stats()
+        # from another thread — counting after the write races that read
+        # (the ledger counts at write time and would show one more frame).
         self.bytes_sent += len(payload)
         self.frames_sent += 1
+        link.respond(rid, payload)
 
     # -- client side --------------------------------------------------------
 

@@ -40,6 +40,19 @@ Pieces:
   exception, so a rank that dies mid-sweep leaves its last seconds
   behind.  Also :func:`git_commit`, the journal-header provenance
   helper.
+* :mod:`~ringpop_tpu.obs.rules` — :class:`RuleEngine` (r22): declarative
+  alert rules (threshold / rate-of-change / staleness / cross-rank
+  skew) with hysteresis, evaluated over the endpoint's snapshots and
+  health views; transitions land as span-carrying ``kind:"alert"``
+  records.
+* :mod:`~ringpop_tpu.obs.controller` — :class:`OpsController` (r22):
+  alert-driven mitigations through pre-existing seams (DGRO re-score,
+  ring drain, elastic resize); every action is a ``kind:"action"``
+  record parented on its alert's span, so :func:`chain` reconstructs
+  alert → action → effect from the journal alone.
+* :mod:`~ringpop_tpu.obs.gameday` — the scored game day: a correlated
+  failure injected into a live P=2 fleet, controller judged on
+  time-to-mitigate against a digest-identical no-controller twin.
 """
 
 _EXPORTS = {
@@ -53,6 +66,16 @@ _EXPORTS = {
     "trace_id_of": "ringpop_tpu.obs.trace",
     "FlightRecorder": "ringpop_tpu.obs.flight",
     "git_commit": "ringpop_tpu.obs.flight",
+    "SPAN_KINDS": "ringpop_tpu.obs.trace",
+    "chain": "ringpop_tpu.obs.trace",
+    "RuleEngine": "ringpop_tpu.obs.rules",
+    "Threshold": "ringpop_tpu.obs.rules",
+    "RateOfChange": "ringpop_tpu.obs.rules",
+    "Staleness": "ringpop_tpu.obs.rules",
+    "CrossRankSkew": "ringpop_tpu.obs.rules",
+    "OpsController": "ringpop_tpu.obs.controller",
+    "run_gameday": "ringpop_tpu.obs.gameday",
+    "gameday_pair": "ringpop_tpu.obs.gameday",
 }
 
 

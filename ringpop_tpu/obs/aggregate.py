@@ -130,8 +130,14 @@ def render_prometheus(snapshots: dict[int, dict]) -> str:
     present an UNLABELED aggregate sample follows per counter/gauge
     metric (counters sum, gauges sum — the cross-rank totals the
     live-smoke certifies against the ranks' journal sums).  Timings
-    render as ``<name>_count`` / ``<name>_sum``-less summary gauges per
-    quantile (the reservoir holds samples, not an exact sum)."""
+    render as real Prometheus ``summary`` families: quantile-labeled
+    samples (``name{rank="0",quantile="0.5"}``) plus a ``<name>_count``
+    line per rank, with mean/min/max kept as auxiliary ``<name>_mean``
+    etc. gauges.  There is deliberately NO ``<name>_sum`` sample: the
+    backing :class:`~ringpop_tpu.util.metrics.Histogram` is a uniform
+    reservoir (``sample_size`` retained values), so an exact sum over
+    all observations does not exist — the exposition comment on each
+    summary states this so scrapers don't infer rates from it."""
     lines: list[str] = []
     ranks = sorted(snapshots)
     multi = len(ranks) > 1
@@ -155,18 +161,41 @@ def render_prometheus(snapshots: dict[int, dict]) -> str:
 
     emit_family("counters", "counter", agg=True)
     emit_family("gauges", "gauge", agg=True)
-    # timing summaries: one gauge per statistic, rank-labeled
+    # timing summaries: quantile-labeled samples + _count, per rank
     tkeys = sorted({k for r in ranks for k in snapshots[r].get("timings", {})})
     for key in tkeys:
         base = prom_name(key)
-        stats = sorted(
+        lines.append(f"# TYPE {base} summary")
+        lines.append(
+            f"# {base}: reservoir-sampled quantiles "
+            "(uniform sample, not an exact sum — no _sum line; "
+            "do not derive rates from this family)"
+        )
+        aux = sorted(
             {
                 s
                 for r in ranks
                 for s in snapshots[r].get("timings", {}).get(key, {})
+                if s not in ("count",) and not s.startswith("p")
             }
         )
-        for stat in stats:
+        for r in ranks:
+            entry = snapshots[r].get("timings", {}).get(key)
+            if not entry:
+                continue
+            for stat in sorted(entry):
+                if not stat.startswith("p") or not stat[1:].isdigit():
+                    continue
+                q = int(stat[1:]) / 100.0
+                lines.append(
+                    f'{base}{{rank="{r}",quantile="{_fmt(q)}"}} '
+                    f"{_fmt(entry[stat])}"
+                )
+            if "count" in entry:
+                lines.append(
+                    f'{base}_count{{rank="{r}"}} {_fmt(entry["count"])}'
+                )
+        for stat in aux:
             name = f"{base}_{stat}"
             lines.append(f"# TYPE {name} gauge")
             for r in ranks:

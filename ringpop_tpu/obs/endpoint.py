@@ -77,6 +77,7 @@ class LiveOps:
         timeout_ms: int = 3_600_000,
         stale_s: float = DEFAULT_STALE_S,
         ledger=None,
+        rejoin: bool = False,
     ):
         self.rank, self.nprocs = rank, nprocs
         # r21: snapshot traffic accounts into the merged TransportLedger
@@ -97,8 +98,18 @@ class LiveOps:
         self._degraded: Optional[str] = None
         # rank 0: peer snapshots {rank: {"t_recv", "snap", "progress"}}
         self._peers: dict[int, dict] = {}
-        self._pending: list = []  # rank 0: (seq, ExchangeHandle)
+        self._pending: list = []  # rank 0: (seq, ExchangeHandle, epochs)
         self._dead: set[int] = set()
+        # rank-restart support: a rank that died and came back under the
+        # same rank id constructs LiveOps(..., rejoin=True) — its fabric
+        # advertises a rejoin listener instead of redoing bring-up, and
+        # rank 0 dials dead peers' adverts from sync().  _epoch counts
+        # link incarnations per peer so a failure on a pre-reconnect
+        # round can't re-mark the fresh link dead; _adopted gates the
+        # restarted rank's seq adoption from the dial's token.
+        self._rejoin = rejoin
+        self._adopted = not rejoin
+        self._epoch: dict[int, int] = {}
         self._server = None
         self._server_thread = None
         self.fabric = None
@@ -116,7 +127,7 @@ class LiveOps:
             self.fabric = Fabric(
                 rank, nprocs, kv, namespace=namespace,
                 timeout_ms=timeout_ms, codec=True, notify_failures=False,
-                ledger=ledger, ledger_class="obs",
+                ledger=ledger, ledger_class="obs", rejoin=rejoin,
             )
             self.ledger = self.fabric.ledger
 
@@ -184,6 +195,37 @@ class LiveOps:
         receive expectations and harvests any completed earlier rounds."""
         if self.fabric is None or self._degraded is not None:
             return
+        if self.rank != 0 and not self._adopted:
+            # rejoining rank: no link until rank 0 dials our advert —
+            # skip the round entirely (consuming seqs while link-less
+            # would desync the tag sequence we're about to adopt)
+            if not self.fabric.has_link(0):
+                return
+            self._seq = self.fabric.rejoin_token
+            self._adopted = True
+        if self.rank == 0 and self._dead:
+            # dial any dead peer that has published a NEW rejoin advert;
+            # token = the seq this very round will use, so the restarted
+            # rank adopts the live tag sequence.  Per-peer try/except:
+            # a failed dial is routine (peer still down), never degrades
+            with self._lock:
+                dead = sorted(self._dead)
+            for peer in dead:
+                try:
+                    if self.fabric.reconnect_peer(peer, token=self._seq):
+                        with self._lock:
+                            self._epoch[peer] = self._epoch.get(peer, 0) + 1
+                        if self.recorder is not None:
+                            self.recorder(
+                                {
+                                    "kind": "obs_peer_rejoin",
+                                    "peer": peer,
+                                    "seq": self._seq,
+                                    "t": time.time(),
+                                }
+                            )
+                except Exception:
+                    pass
         seq = self._seq
         self._seq += 1
         tag = (_TAG_OBS + seq) & 0xFFFFFFFF
@@ -194,7 +236,7 @@ class LiveOps:
             peers = [p for p in range(self.nprocs) if p != 0]
             h = self.fabric.exchange_async(tag, {}, peers)
             with self._lock:
-                self._pending.append((seq, h))
+                self._pending.append((seq, h, dict(self._epoch)))
         except Exception as e:  # ops must never kill the sweep
             self._degraded = f"{type(e).__name__}: {e}"
             return
@@ -210,7 +252,7 @@ class LiveOps:
         with self._lock:
             pending = list(self._pending)
         done: set[int] = set()
-        for seq, h in pending:
+        for seq, h, epochs in pending:
             got = h.poll()
             if got is None:
                 continue
@@ -218,7 +260,12 @@ class LiveOps:
             for peer, val in got.items():
                 if isinstance(val, BaseException):
                     with self._lock:
-                        self._dead.add(peer)
+                        # a round enqueued against a PRE-reconnect link
+                        # incarnation fails when the old link shuts
+                        # down — that must not re-mark the fresh link's
+                        # peer dead (epoch bumped at reconnect)
+                        if epochs.get(peer, 0) == self._epoch.get(peer, 0):
+                            self._dead.add(peer)
                     if self.recorder is not None:
                         self.recorder(
                             {

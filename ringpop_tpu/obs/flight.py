@@ -119,8 +119,18 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
-        self.dumped: Optional[str] = None  # path of the first dump
+        # first dump path PER SCOPE: the engine's once-per-process dump
+        # ("engine" — fabric failures, uncaught exceptions) must survive
+        # a controller mitigation dumping first, so each scope gets its
+        # own once-only slot and its own default filename
+        self.dumps: dict[str, str] = {}
         self._installed: list = []
+
+    @property
+    def dumped(self) -> Optional[str]:
+        """Path of the first ENGINE-scope dump (the once-per-process
+        crash dump; controller-scope dumps do not consume it)."""
+        return self.dumps.get("engine")
 
     # -- recording ------------------------------------------------------------
 
@@ -158,20 +168,30 @@ class FlightRecorder:
         error: Optional[BaseException] = None,
         path: Optional[str] = None,
         force: bool = False,
+        scope: str = "engine",
     ) -> Optional[str]:
         """Write the post-mortem JSONL; returns its path (None when a
-        previous dump already exists and ``force`` is False, or on any
-        write failure — never raises)."""
+        previous dump in the same ``scope`` already exists and ``force``
+        is False, or on any write failure — never raises).  Dumping is
+        once-per-process PER SCOPE: the default ``"engine"`` scope is
+        the crash dump the fabric/excepthook triggers own; a failing
+        controller mitigation dumps under ``scope="controller"`` with a
+        ``-controller``-suffixed filename, leaving the engine dump
+        unburned for a real fabric failure."""
         try:
             with self._lock:
-                if self.dumped is not None and not force:
+                if scope in self.dumps and not force:
                     return None
                 target = path or self.path
+                if path is None and scope != "engine":
+                    root, ext = os.path.splitext(self.path)
+                    target = f"{root}-{scope}{ext}"
                 records = list(self._ring)
                 seq = self._seq
             header = {
                 "kind": "flight_header",
                 "reason": reason,
+                "scope": scope,
                 "error": None if error is None else (
                     f"{type(error).__name__}: {error}"
                 ),
@@ -190,8 +210,7 @@ class FlightRecorder:
                     f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
             os.replace(tmp, target)
             with self._lock:
-                if self.dumped is None:
-                    self.dumped = target
+                self.dumps.setdefault(scope, target)
             return target
         except Exception:
             return None

@@ -323,15 +323,26 @@ def tee(*sinks: Callable[[dict], None]) -> Callable[[dict], None]:
     return fan
 
 
+# record kinds that participate in the span graph: plain spans plus the
+# closed-loop pair (obs/rules.py alerts, obs/controller.py actions) —
+# each carries trace/span/parent fields, so one chain() walk joins
+# alert -> decision -> action -> effect with the forwarding-plane spans
+SPAN_KINDS = ("span", "alert", "action")
+
+
 def chain(records: list[dict], trace: int) -> list[dict]:
     """Reconstruct one trace's span chain from journal records: the
-    spans whose ``trace`` (or ``traces`` list) matches, PLUS their
-    ancestors by parent link — a batch-level RPC span records only the
-    batch's primary trace, but it carries every rider key, so a rider's
-    chain pulls it in through the parent pointer of its own spans.
-    Ordered parent-first (roots first, then children, ties in record
-    order) — the join the trace smoke and the acceptance test walk."""
-    all_spans = [r for r in records if r.get("kind") == "span"]
+    span-carrying records (``kind`` in :data:`SPAN_KINDS`) whose
+    ``trace`` (or ``traces`` list) matches, PLUS their ancestors by
+    parent link — a batch-level RPC span records only the batch's
+    primary trace, but it carries every rider key, so a rider's chain
+    pulls it in through the parent pointer of its own spans.  Ordered
+    parent-first (roots first, then children, ties in record order) —
+    the join the trace smoke and the acceptance test walk."""
+    all_spans = [
+        r for r in records
+        if r.get("kind") in SPAN_KINDS and "span" in r and "trace" in r
+    ]
     by_span: dict[int, dict] = {}
     for s in all_spans:
         by_span.setdefault(s["span"], s)

@@ -864,6 +864,7 @@ class Fabric:
         notify_failures: bool = True,
         ledger: Optional[TransportLedger] = None,
         ledger_class: str = "exchange",
+        rejoin: bool = False,
     ):
         if not 0 <= rank < nprocs:
             raise ValueError(f"rank {rank} outside [0, {nprocs})")
@@ -901,10 +902,22 @@ class Fabric:
         self._links: dict[int, _PeerLink] = {}
         self._closed = False
         self._lock = threading.Lock()
+        # rejoin support: a RESTARTED rank cannot redo the normal
+        # bring-up (its peers' accept listeners closed after the mesh
+        # came up) — rejoin=True instead advertises a fresh listener in
+        # the KV and waits for a surviving rank's reconnect_peer() to
+        # dial it.  The dial hello carries a 4-byte token the dialer
+        # chooses (the obs plane passes its sync seq so the restarted
+        # rank adopts the live tag sequence); it lands in rejoin_token.
+        self.rejoin_token = 0
+        self._rejoin_seen: dict[int, str] = {}
         if nprocs > 1:
-            self._connect(host)
-            for peer, s in self._peers.items():
-                self._links[peer] = _PeerLink(self, peer, s)
+            if rejoin:
+                self._rejoin_listen(host)
+            else:
+                self._connect(host)
+                for peer, s in self._peers.items():
+                    self._links[peer] = _PeerLink(self, peer, s)
 
     # -- bring-up -------------------------------------------------------------
 
@@ -946,6 +959,95 @@ class Fabric:
             (peer,) = struct.unpack(">I", _recv_exact(s, 4))
             self._peers[peer] = s
         srv.close()
+
+    def _rejoin_listen(self, host: str) -> None:
+        """Restarted-rank bring-up: advertise a one-shot listener under
+        ``{ns}/rejoin/{rank}`` (stamped with ``time_ns`` so a surviving
+        rank distinguishes this incarnation's advert from a stale one)
+        and accept exactly one :meth:`reconnect_peer` dial in the
+        background — the fabric is usable immediately, link-less, and
+        ``has_link`` turns true once the dial lands."""
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, 0))
+        srv.listen(self.nprocs)
+        srv.settimeout(self.timeout_ms / 1000.0)
+        port = srv.getsockname()[1]
+        self.kv.key_value_set(
+            f"{self.ns}/rejoin/{self.rank}", f"{time.time_ns()}:{host}:{port}"
+        )
+
+        def accept_one() -> None:
+            try:
+                s, _ = srv.accept()
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(self.timeout_ms / 1000.0)
+                peer, token = struct.unpack(">II", _recv_exact(s, 8))
+                with self._lock:
+                    if self._closed:
+                        s.close()
+                        return
+                    self.rejoin_token = token
+                    self._peers[peer] = s
+                    self._links[peer] = _PeerLink(self, peer, s)
+            except (OSError, struct.error, FabricError):
+                pass
+            finally:
+                srv.close()
+
+        threading.Thread(
+            target=accept_one, daemon=True,
+            name=f"fabric-rejoin-{self.ns}-{self.rank}",
+        ).start()
+
+    def has_link(self, peer: int) -> bool:
+        """Whether a live(-looking) link to ``peer`` exists — rejoining
+        ranks poll this to learn when their advert has been dialed."""
+        with self._lock:
+            return peer in self._links
+
+    def reconnect_peer(self, peer: int, token: int = 0) -> bool:
+        """Dial a restarted ``peer``'s rejoin advert and swap in a fresh
+        link (the old link, if any, is shut down).  Returns False — and
+        never raises — when no NEW advert exists (no advert published,
+        or the same incarnation was already dialed) or the dial fails;
+        True once the new link is installed.  ``token`` rides the hello
+        into the peer's ``rejoin_token``."""
+        try:
+            advert = self.kv.blocking_key_value_get(f"{self.ns}/rejoin/{peer}", 1)
+        except Exception:
+            return False
+        if advert == self._rejoin_seen.get(peer):
+            return False
+        try:
+            _stamp, rest = advert.split(":", 1)
+            h, p = rest.rsplit(":", 1)
+            s = socket.create_connection(
+                (h, int(p)), timeout=self.timeout_ms / 1000.0
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(self.timeout_ms / 1000.0)
+            _send_exact(s, struct.pack(">II", self.rank, token & 0xFFFFFFFF))
+        except (OSError, ValueError):
+            return False
+        with self._lock:
+            old_link = self._links.pop(peer, None)
+            old_sock = self._peers.pop(peer, None)
+        if old_link is not None:
+            old_link.shutdown()
+        if old_sock is not None:
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            if self._closed:
+                s.close()
+                return False
+            self._peers[peer] = s
+            self._links[peer] = _PeerLink(self, peer, s)
+        self._rejoin_seen[peer] = advert
+        return True
 
     def close(self) -> None:
         self._closed = True

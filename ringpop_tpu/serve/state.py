@@ -256,7 +256,42 @@ class RingStore:
                 return None
             return self._commit(added=list(add or []), removed=list(remove or []))
 
-    def _commit(self, added: list[str], removed: list[str]) -> dict:
+    def drain(self, servers) -> Optional[dict]:
+        """Route a degrading server's ring block away BEFORE its peers
+        declare it faulty: remove it from the ring and commit the next
+        generation, stamped ``"drain": True`` so journal readers (and
+        the game-day judge) distinguish a controller-initiated drain
+        from an organic membership loss.  Returns the commit record
+        (None when none of the servers are in the ring)."""
+        with self._lock:
+            removed = list(servers)
+            if not self.ring.add_remove_servers([], removed):
+                return None
+            return self._commit(added=[], removed=removed, drain=True)
+
+    def rescore_placement(self) -> Optional[dict]:
+        """Drop the sticky DGRO candidate and re-score from scratch at
+        the CURRENT membership, committing the result.  The scorer is
+        deliberately sticky (a candidate flip moves every token); this
+        is the telemetry-triggered exception — observed skew says the
+        replayed candidate has degraded enough to pay the movement.
+        Only meaningful under ``placement="dgro"`` (None otherwise);
+        the record carries ``"rescored": True`` plus the fresh scorer
+        report's movement/imbalance/diameter summary."""
+        if self.placement != "dgro":
+            return None
+        with self._lock:
+            self._dgro_salt = None
+            self._dgro_moves = {}
+            return self._commit(added=[], removed=[], rescored=True)
+
+    def _commit(
+        self,
+        added: list[str],
+        removed: list[str],
+        drain: bool = False,
+        rescored: bool = False,
+    ) -> dict:
         tokens, owners = self._placed_arrays()
         self.host_tokens = np.asarray(tokens, np.uint32)
         self.host_owners = np.asarray(owners, np.int32)
@@ -302,6 +337,23 @@ class RingStore:
             "added": added,
             "removed": removed,
         }
+        # controller-initiated commits carry their provenance; ORGANIC
+        # commits keep the exact r13 record shape (no new keys), so
+        # existing journal readers and digests are untouched
+        if drain:
+            record["drain"] = True
+        if rescored:
+            record["rescored"] = True
+            report = getattr(self, "placement_report", None) or {}
+            record["placement"] = {
+                k: report[k]
+                for k in (
+                    "chosen", "salt", "movement_chosen", "movement_random",
+                    "imbalance_chosen", "imbalance_random",
+                    "diameter_chosen", "diameter_random",
+                )
+                if k in report
+            }
         if self.on_update is not None:
             self.on_update(record)
         return record

@@ -326,6 +326,7 @@ class FleetSweep:
         global_b: Optional[int] = None,
         telemetry_tiers: Optional[bool] = None,
         obs=None,
+        on_block=None,
     ):
         if len(meta) != len(list(seeds)):
             raise ValueError(f"{len(meta)} meta entries vs {len(list(seeds))} seeds")
@@ -363,6 +364,12 @@ class FleetSweep:
         # rank's sweep must attach one when any does (obs.sync() is a
         # deterministic per-block collective on the obs fabric).
         self.obs = obs
+        # on_block(sweep) runs after each block's obs.sync() — the
+        # closed-loop hook (obs/gameday.py evaluates its rule engine +
+        # controller here).  Host-side only, AFTER the block's records
+        # are journaled: a hook cannot change what the sim computed, so
+        # hook-on vs hook-off sweeps stay digest-identical.
+        self.on_block = on_block
         self._last_checkpoint_tick: Optional[int] = None
 
     def header_params(self) -> dict:
@@ -416,6 +423,8 @@ class FleetSweep:
                     last_checkpoint_tick=self._last_checkpoint_tick,
                 )
                 self.obs.sync()
+            if self.on_block is not None:
+                self.on_block(self)
         return self
 
     def scores(self) -> list[dict]:

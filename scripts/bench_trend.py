@@ -1,0 +1,175 @@
+"""bench-trend — regression tripwire over the committed BENCH_*.json
+trajectory.
+
+Every round that runs ``bench.py`` commits its one-line JSON artifact as
+``BENCH_rNN.json`` (``{"n", "cmd", "rc", "tail", "parsed": {...}}``).
+Those files form a perf trajectory nobody was reading: a slow drift in
+a secondary metric (the r21 honest-cost note: ``transport_rtt_us``) can
+ride along unnoticed for rounds.  This script closes that gap:
+
+1. load every committed ``BENCH_*.json``, keep each tracked row's
+   NEWEST committed value (highest ``n`` whose ``parsed`` carries it);
+2. take a fresh measurement — by default the quick path (only
+   ``transport_rtt_us`` via ``bench._transport_rtt_us``, a few seconds,
+   no jax import), or a full pre-captured bench JSON via ``--fresh``;
+3. compare direction-aware: a row regresses when it is worse than the
+   newest committed value by more than ``--threshold`` (default 15%).
+   "Worse" respects each metric's direction — RTT up is a regression,
+   lookup qps down is a regression.  Headline ``value`` rows are only
+   comparable when the ``metric`` names match exactly (a 100k-node
+   detect time vs a 1M-node one is not a trend, it's a scale change).
+
+Exit 1 on any regression, 0 otherwise; ``--report-only`` always exits 0
+(how ``make test`` wires it — the tripwire reports in CI, gates only
+when invoked as ``make bench-trend``).  Prints one JSON summary line.
+
+Usage:
+    python scripts/bench_trend.py                  # quick, gating
+    python scripts/bench_trend.py --report-only    # quick, report only
+    python scripts/bench_trend.py --fresh out.json # compare a full run
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# tracked rows: key -> direction ("lower" is better / "higher" is
+# better).  Only unambiguous rows belong here — compile times etc. are
+# too noisy per container to gate on.
+DIRECTIONS = {
+    "value": "lower",  # headline detect/convergence seconds (same metric only)
+    "transport_rtt_us": "lower",
+    "ring_lookup_qps": "higher",
+    "serve_lookup_qps": "higher",
+    "ticks_per_s": "higher",
+    "delta_converge_s": "lower",
+}
+
+
+def load_committed() -> dict[str, dict]:
+    """Newest committed value per tracked row: key -> {n, value, metric}."""
+    newest: dict[str, dict] = {}
+    for path in glob.glob(os.path.join(REPO, "BENCH_*.json")):
+        m = re.search(r"BENCH_r?(\d+)\.json$", os.path.basename(path))
+        if m is None:
+            continue
+        n = int(m.group(1))
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue  # a truncated artifact is not a trend point
+        for key in DIRECTIONS:
+            v = parsed.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if key not in newest or n > newest[key]["n"]:
+                newest[key] = {
+                    "n": n, "value": float(v),
+                    "metric": parsed.get("metric"),
+                }
+    return newest
+
+
+def fresh_quick() -> dict:
+    """The quick fresh measurement: transport RTT only (no jax).
+
+    Best-of-N p50: single p50s swing ~25% with scheduler luck on shared
+    CPU containers; the min measures the channel's floor, which is what
+    actually trends when the RPC plane grows a thread hop.  The
+    committed BENCH_r22 row was taken the same way (best-of-3); the
+    fresh side takes 5 for extra margin against a one-sided gate."""
+    import bench
+
+    return {
+        "metric": "transport_rtt_quick",
+        "transport_rtt_us": round(
+            min(bench._transport_rtt_us(400) for _ in range(5)), 1
+        ),
+    }
+
+
+def compare(fresh: dict, committed: dict[str, dict], threshold: float) -> list:
+    rows = []
+    for key, base in sorted(committed.items()):
+        v = fresh.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue  # fresh run did not measure this row
+        if key == "value" and fresh.get("metric") != base["metric"]:
+            continue  # headline seconds only trend at identical scale
+        direction = DIRECTIONS[key]
+        baseline = base["value"]
+        if baseline == 0:
+            continue
+        change = (float(v) - baseline) / abs(baseline)
+        worse = change if direction == "lower" else -change
+        rows.append({
+            "row": key,
+            "fresh": float(v),
+            "committed": baseline,
+            "committed_round": base["n"],
+            "direction": direction,
+            "change_pct": round(change * 100, 1),
+            "regressed": worse > threshold,
+        })
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", metavar="JSON", default=None,
+                    help="path to a full bench.py JSON artifact (the "
+                         "one-line result or a BENCH_rNN.json wrapper); "
+                         "default: quick in-process transport RTT probe")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="regression threshold as a fraction (default 0.15)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="always exit 0 (make test wiring)")
+    args = ap.parse_args()
+
+    committed = load_committed()
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+        fresh = fresh.get("parsed", fresh)  # accept either shape
+    else:
+        fresh = fresh_quick()
+
+    rows = compare(fresh, committed, args.threshold)
+    regressed = [r for r in rows if r["regressed"]]
+    print(json.dumps({
+        "bench_trend": {
+            "fresh_metric": fresh.get("metric"),
+            "threshold_pct": args.threshold * 100,
+            "rows": rows,
+            "regressions": [r["row"] for r in regressed],
+        }
+    }))
+    if not rows:
+        print("bench-trend: no comparable rows (fresh run measured none of "
+              "the committed trajectory) — nothing to gate")
+        return 0
+    if regressed:
+        for r in regressed:
+            arrow = "rose" if r["direction"] == "lower" else "fell"
+            print(f"bench-trend: REGRESSION {r['row']} {arrow} "
+                  f"{abs(r['change_pct'])}% vs BENCH_r{r['committed_round']:02d} "
+                  f"({r['committed']} -> {r['fresh']})")
+        return 0 if args.report_only else 1
+    print(f"bench-trend: OK ({len(rows)} rows within "
+          f"{args.threshold * 100:.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

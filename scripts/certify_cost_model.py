@@ -343,6 +343,58 @@ def judge_fleet_scale():
     )
 
 
+def judge_gameday():
+    """The r22 closed-loop verdict from the committed SIMBENCH_r22.json —
+    host-certifiable.  The zone-cut game day certifies when the
+    controller mitigated STRICTLY earlier than the no-controller twin
+    AND the controller-on / controller-off / bare-HEAD digests are bit
+    identical (slower-than-twin or a digest split REFUTES — a loop that
+    perturbs the sim is worse than no loop).  The switch-flap scenario
+    is reported, not gating.  Returns a (name, ok, detail) tuple, or
+    None when the artifact does not exist."""
+    path = os.path.join(REPO, "SIMBENCH_r22.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return ("closed-loop game day", None,
+                f"unreadable SIMBENCH_r22.json: {e}")
+    sc = next(
+        (s for s in data.get("scenarios", [])
+         if str(s.get("metric", "")).startswith("gameday")),
+        None,
+    )
+    if sc is None:
+        return ("closed-loop game day", None,
+                "SIMBENCH_r22.json carries no gameday scenario")
+    zc = sc.get("zone_cut") or {}
+    ok = (
+        bool(zc.get("mitigated_earlier"))
+        and bool(zc.get("digest_equal"))
+        and bool(zc.get("digest_matches_head"))
+        and zc.get("twin_actions") == 0
+        and bool(zc.get("chain_ok"))
+        and bool(sc.get("certified"))
+    )
+    flap = sc.get("switch_flap") or {}
+    flap_note = (
+        f"; switch_flap ttm {flap.get('ttm_on')} vs {flap.get('ttm_off')} "
+        f"(reported only)" if flap else ""
+    )
+    return (
+        f"closed-loop game day (n={sc.get('n_nodes')}, "
+        f"horizon={sc.get('horizon')})",
+        ok,
+        f"zone_cut ttm {zc.get('ttm_on')} vs twin {zc.get('ttm_off')} "
+        f"(strictly-earlier required); digest_equal={zc.get('digest_equal')} "
+        f"matches_head={zc.get('digest_matches_head')} "
+        f"twin_actions={zc.get('twin_actions')} chain_ok={zc.get('chain_ok')}"
+        f"{flap_note}",
+    )
+
+
 def _print_solo(host_verdicts) -> int:
     """Render the host-level verdicts (dcn_wire r15, swing_overlap r16)
     when no on-chip capture is judgeable — these claims never wait on
@@ -370,7 +422,7 @@ def _print_solo(host_verdicts) -> int:
 
 def main() -> int:
     host = [judge_dcn_wire(), judge_swing_overlap(), judge_serve_fanin(),
-            judge_fleet_scale()]
+            judge_fleet_scale(), judge_gameday()]
     path = sys.argv[1] if len(sys.argv) > 1 else newest_ksweep()
     if not path:
         print("no ksweep capture found (run make tpu-watch and wait for a window)")

@@ -1,0 +1,148 @@
+"""gameday-smoke — the CI gate for the r22 closed observability loop.
+
+One in-process game day (P=2 fleet threads over a LocalKV) with the
+full loop attached — AggregatingStats → LiveOps → RuleEngine →
+OpsController — and a zone cut injected mid-run, judged on four legs:
+
+1. **The controller mitigates.**  The probe-timeout spike rule fires
+   one journal block after the cut; the controller drains the cut
+   zone's ring block (a RingStore generation commit) and the effect
+   probe confirms the drained server's key share over the probe
+   population reads 0 against the post-drain ring.
+2. **Strictly earlier than SWIM.**  Time-to-mitigate beats the
+   no-controller twin, whose "mitigation" is the organic faulty
+   declaration (suspect_ticks + dissemination).
+3. **Bit-transparency, twice over.**  The controller-on fleet, the
+   controller-off twin, and a bare P=1 run with NO obs plane at all
+   (the HEAD oracle) land identical digests — observing and reacting
+   on the host plane never perturbs the simulation.
+4. **The chain reconstructs from the journal alone.**  For the drain's
+   trace, ``obs.chain()`` returns alert → action → effect with the
+   action's parent equal to the alert's span and the effect's parent
+   equal to the action's span; the twin journals zero actions and only
+   the spike rule ever fired (the skew/staleness rules stayed quiet).
+
+Exit 0 on success, 1 with a diagnosis on any failure.  ~15 s — wired
+into ``make test``.
+
+Usage:
+    python scripts/gameday_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CFG = dict(scenario="zone_cut", n=64, seed=0, horizon=48, journal_every=8)
+
+
+def main() -> int:
+    from ringpop_tpu.obs.gameday import bare_digests, gameday_pair
+
+    failures: list[str] = []
+    pair = gameday_pair(**CFG)
+    on, off = pair["on"], pair["off"]
+
+    # -- leg 1: the controller mitigated --------------------------------------
+    drains = [a for a in on["actions"] if a["action"] == "drain" and a["ok"]]
+    effects = [a for a in on["actions"] if a["action"] == "effect" and a["ok"]]
+    if not drains:
+        failures.append(f"controller took no successful drain: {on['actions']}")
+    if not effects:
+        failures.append(
+            "drain effect probe did not read share 0 for the drained server: "
+            f"{[a for a in on['actions'] if a['action'] == 'effect']}"
+        )
+    if on["mitigation_tick"] is None:
+        failures.append("no mitigation tick recorded on the controller run")
+    elif on["mitigation_tick"] <= on["cut_at"]:
+        failures.append(
+            f"mitigation at tick {on['mitigation_tick']} precedes the cut "
+            f"at {on['cut_at']} — the loop reacted to nothing"
+        )
+
+    # -- leg 2: strictly earlier than the organic twin ------------------------
+    if not pair["mitigated_earlier"]:
+        failures.append(
+            f"controller was not strictly earlier: ttm_on={pair['ttm_on']} "
+            f"vs ttm_off={pair['ttm_off']}"
+        )
+
+    # -- leg 3: digest-identical to the twin AND to bare HEAD -----------------
+    if not pair["digest_equal"]:
+        failures.append(
+            f"controller-on digests {on['digests']} != controller-off "
+            f"{off['digests']} — the loop is not host-plane-only"
+        )
+    head = bare_digests(**CFG)
+    if off["digests"] != head:
+        failures.append(
+            f"controller-off digests {off['digests']} != bare no-obs run "
+            f"{head} — the obs plane itself perturbs the sim"
+        )
+
+    # -- leg 4: chain + twin silence ------------------------------------------
+    if off["actions"]:
+        failures.append(f"the no-controller twin took actions: {off['actions']}")
+    stray = {a["rule"] for a in on["alerts"]} - {"probe-timeout-spike"}
+    if stray:
+        failures.append(f"quiet-by-construction rules fired: {sorted(stray)}")
+    if not on["chains"]:
+        failures.append("no alert→action chain reconstructed from the journal")
+    for ch in on["chains"]:
+        kinds = [r["kind"] for r in ch]
+        if not ch or ch[0]["kind"] != "alert" or ch[0]["parent"] is not None:
+            failures.append(f"chain does not root at the alert: {kinds}")
+            continue
+        acts = [r for r in ch if r["kind"] == "action"
+                and r["action"] == "drain"]
+        if not acts:
+            failures.append(f"chain carries no drain action: {kinds}")
+            continue
+        root_span = ch[0]["span"]
+        for act in acts:
+            if act["parent"] != root_span:
+                failures.append(
+                    f"drain span {act['span']} does not parent on the "
+                    f"alert span {root_span}"
+                )
+            kids = [r for r in ch if r["kind"] == "action"
+                    and r["action"] == "effect"
+                    and r.get("parent") == act["span"]]
+            if not kids:
+                failures.append(
+                    f"drain span {act['span']} has no effect record "
+                    "parented on it"
+                )
+
+    if failures:
+        print("gameday-smoke: FAIL")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(json.dumps({
+        "gameday_smoke": {
+            "scenario": pair["scenario"],
+            "cut_at": on["cut_at"],
+            "ttm_on": pair["ttm_on"],
+            "ttm_off": pair["ttm_off"],
+            "digest_equal": True,
+            "digest_matches_head": True,
+            "drains": len(drains),
+            "effects": len(effects),
+            "chains": len(on["chains"]),
+        }
+    }))
+    print("gameday-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,498 @@
+"""r22 closed-observability-loop suite (``obs/rules.py``,
+``obs/controller.py``, the flight-recorder scopes, the rank-restart
+rejoin path, and the serve/forward seams the controller drives).
+
+Covers: rule hysteresis (hold windows, hysteresis bands, the
+self-calibrating spike mode, cross-rank skew, staleness), alert-record
+determinism, controller dispatch (drain + effect probe, DGRO re-score,
+resize) with span parentage reconstructable via ``obs.chain()``,
+per-scope flight dumps (a failing mitigation must NOT burn the
+once-per-process engine crash dump), the LiveOps kill-and-rejoin
+stale→live transition over a LocalKV twin, the RingStore drain/rescore
+generation commits, and ``forward.batch.rank_load`` (the skew signal).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ringpop_tpu.obs import trace as tracemod
+from ringpop_tpu.obs.aggregate import AggregatingStats, render_prometheus
+from ringpop_tpu.obs.controller import OpsController
+from ringpop_tpu.obs.endpoint import LiveOps
+from ringpop_tpu.obs.flight import FlightRecorder
+from ringpop_tpu.obs.rules import (
+    FLEET,
+    CrossRankSkew,
+    RateOfChange,
+    RuleEngine,
+    Staleness,
+    Threshold,
+)
+from ringpop_tpu.parallel.fabric import LocalKV
+
+
+def _gauges(**kv) -> dict:
+    return {"gauges": dict(kv)}
+
+
+def _counters(**kv) -> dict:
+    return {"counters": dict(kv)}
+
+
+# -- rule hysteresis ----------------------------------------------------------
+
+
+def test_threshold_hold_window_and_band():
+    out = []
+    eng = RuleEngine(
+        [Threshold(id="hot", key="g", op=">", firing=10.0, clear=5.0,
+                   hold=2, hold_clear=2)],
+        sink=out.append,
+    )
+    # one hot evaluation is not enough (hold=2)
+    assert eng.evaluate({0: _gauges(g=12.0)}) == []
+    fired = eng.evaluate({0: _gauges(g=13.0)})
+    assert [r["state"] for r in fired] == ["firing"]
+    assert fired[0]["rule"] == "hot" and fired[0]["about_rank"] == FLEET
+    assert fired[0]["kind"] == "alert" and fired[0]["parent"] is None
+    # inside the hysteresis band (5 < v <= 10): neither clears nor refires
+    assert eng.evaluate({0: _gauges(g=7.0)}) == []
+    assert eng.state("hot") is True
+    # below the clear edge, but hold_clear=2 needs two in a row
+    assert eng.evaluate({0: _gauges(g=3.0)}) == []
+    cleared = eng.evaluate({0: _gauges(g=3.0)})
+    assert [r["state"] for r in cleared] == ["clear"]
+    assert eng.state("hot") is False
+    # the clear shares its firing's trace: one chain() pulls the episode
+    assert cleared[0]["trace"] == fired[0]["trace"]
+    assert out == fired + cleared and eng.alerts_emitted == 2
+
+
+def test_alert_spans_are_rerun_deterministic():
+    def run():
+        eng = RuleEngine(
+            [Threshold(id="hot", key="g", firing=1.0)], sink=lambda r: None
+        )
+        recs = []
+        for v in (2.0, 0.0, 2.0):
+            recs.extend(eng.evaluate({0: _gauges(g=v)}))
+        return [(r["trace"], r["span"], r["state"]) for r in recs]
+
+    first, second = run(), run()
+    assert first == second and len(first) == 3
+    # the second firing is a NEW episode: distinct trace from the first
+    assert first[0][0] != first[2][0]
+
+
+def test_rate_of_change_spike_mode_self_calibrating():
+    eng = RuleEngine(
+        [RateOfChange(id="spike", key="c", spike_ratio=4.0, floor=1.0,
+                      per_rank=False, hold=1)],
+        sink=lambda r: None,
+    )
+    # baseline deltas of 10/eval: obs #1 has no delta, #2 no prev delta,
+    # #3 is the first ratio (1.0 — quiet)
+    for v in (0.0, 10.0, 20.0):
+        assert eng.evaluate({0: _counters(c=v)}) == []
+    # a 8x step in the delta fires regardless of the absolute level
+    fired = eng.evaluate({0: _counters(c=100.0)})
+    assert [r["state"] for r in fired] == ["firing"]
+    assert fired[0]["value"] == pytest.approx(8.0)
+    # back to baseline: ratio collapses, the alert clears
+    cleared = eng.evaluate({0: _counters(c=110.0)})
+    assert [r["state"] for r in cleared] == ["clear"]
+
+
+def test_rate_of_change_stall_band():
+    eng = RuleEngine(
+        [RateOfChange(id="stall", key="c", low=1.0, per_rank=True, hold=1)],
+        sink=lambda r: None,
+    )
+    assert eng.evaluate({1: _counters(c=100.0)}) == []
+    assert eng.evaluate({1: _counters(c=110.0)}) == []  # delta 10: fine
+    fired = eng.evaluate({1: _counters(c=110.0)})  # delta 0: stalled
+    assert [(r["state"], r["about_rank"]) for r in fired] == [("firing", 1)]
+
+
+def test_cross_rank_skew_names_the_skewed_rank():
+    eng = RuleEngine(
+        [CrossRankSkew(id="skew", key="load", ratio=1.5, hold=1)],
+        sink=lambda r: None,
+    )
+    # one rank reporting -> below min_ranks, no observation at all
+    assert eng.evaluate({0: _gauges(load=10.0)}) == []
+    fired = eng.evaluate({0: _gauges(load=10.0), 1: _gauges(load=40.0)})
+    assert [(r["state"], r["about_rank"]) for r in fired] == [("firing", 1)]
+    assert fired[0]["value"] == pytest.approx(40.0 / 25.0)
+    balanced = {0: _gauges(load=24.0), 1: _gauges(load=26.0)}
+    cleared = eng.evaluate(balanced)
+    assert [(r["state"], r["about_rank"]) for r in cleared] == [("clear", 1)]
+
+
+def test_staleness_skips_self_and_holds():
+    eng = RuleEngine([Staleness(id="stale", hold=2)], sink=lambda r: None)
+    health = {"ranks": {
+        "0": {"live": True, "self": True},
+        "1": {"live": False},
+    }}
+    assert eng.evaluate({}, health=health) == []
+    fired = eng.evaluate({}, health=health)
+    assert [(r["state"], r["about_rank"]) for r in fired] == [("firing", 1)]
+    # the self entry never becomes a subject
+    assert eng.state("stale", 0) is None
+
+
+def test_engine_isolates_broken_rules_and_rejects_dup_ids():
+    class Broken(Threshold):
+        def observe(self, ctx):
+            raise RuntimeError("boom")
+
+    eng = RuleEngine(
+        [Broken(id="bad", key="g", firing=0.0),
+         Threshold(id="good", key="g", firing=1.0)],
+        sink=lambda r: None,
+    )
+    fired = eng.evaluate({0: _gauges(g=5.0)})
+    assert [r["rule"] for r in fired] == ["good"]
+    with pytest.raises(ValueError, match="duplicate rule ids"):
+        RuleEngine(
+            [Threshold(id="x", key="g"), Threshold(id="x", key="h")],
+            sink=lambda r: None,
+        )
+
+
+def test_engine_counts_sink_failures_without_raising():
+    def bad_sink(rec):
+        raise OSError("disk gone")
+
+    eng = RuleEngine([Threshold(id="t", key="g", firing=1.0)], sink=bad_sink)
+    fired = eng.evaluate({0: _gauges(g=5.0)})
+    assert len(fired) == 1  # the record still comes back to the caller
+    assert eng.alerts_dropped == 1 and eng.alerts_emitted == 0
+
+
+# -- controller dispatch + span parentage -------------------------------------
+
+
+class _StubStore:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.gen = 0
+        self.drained = []
+        self.rescored = 0
+
+    def drain(self, servers):
+        if self.fail:
+            raise RuntimeError("ring wedged")
+        self.gen += 1
+        self.drained.extend(servers)
+        return {"gen": self.gen, "removed": list(servers), "drain": True}
+
+    def rescore_placement(self):
+        self.gen += 1
+        self.rescored += 1
+        return {"gen": self.gen, "rescored": True,
+                "placement": {"movement_chosen": 0.25}}
+
+
+def _one_alert(journal, rule_id="spike", subject=FLEET):
+    eng = RuleEngine(
+        [RateOfChange(id=rule_id, key="c", spike_ratio=4.0, per_rank=False,
+                      hold=1)],
+        sink=journal.append,
+    )
+    for v in (0.0, 10.0, 20.0):
+        eng.evaluate({0: _counters(c=v)})
+    fired = eng.evaluate({0: _counters(c=200.0)})
+    assert len(fired) == 1
+    return fired
+
+
+def test_controller_drain_effect_chain_reconstructs():
+    journal: list[dict] = []
+    store = _StubStore()
+    ctl = OpsController(
+        sink=journal.append,
+        policy={"spike": "drain"},
+        ring_store=store,
+        server_of=lambda subject: "z0",
+        drain_probe=lambda server: 0,
+    )
+    alerts = _one_alert(journal)
+    acts = ctl.on_alerts(alerts, tick=24)
+    assert [a["action"] for a in acts] == ["drain", "effect"]
+    drain, effect = acts
+    alert = alerts[0]
+    # the action joins the ALERT's trace and parents on its span;
+    # the effect parents on the action
+    assert drain["trace"] == alert["trace"]
+    assert drain["parent"] == alert["span"]
+    assert effect["parent"] == drain["span"] and effect["of"] == "drain"
+    assert drain["ok"] and drain["detail"] == {"server": "z0", "gen": 1}
+    assert effect["ok"] and effect["detail"]["share"] == 0
+    assert store.drained == ["z0"]
+    # chain() over the raw journal: alert first, then action, then effect
+    ch = tracemod.chain(journal, alert["trace"])
+    assert [(r["kind"], r.get("action")) for r in ch] == [
+        ("alert", None), ("action", "drain"), ("action", "effect"),
+    ]
+    # an already-drained subject does not re-drain (nor does cooldown
+    # permit an immediate repeat)
+    assert ctl.on_alerts(alerts, tick=25) == []
+    assert store.gen == 1 and ctl.actions_taken == 1
+
+
+def test_controller_ignores_clears_and_unpoliced_rules():
+    journal: list[dict] = []
+    ctl = OpsController(
+        sink=journal.append, policy={"spike": "drain"},
+        ring_store=_StubStore(), server_of=lambda s: "z0",
+    )
+    clear = [{"kind": "alert", "rule": "spike", "state": "clear",
+              "about_rank": FLEET, "trace": 1, "span": 2}]
+    other = [{"kind": "alert", "rule": "unmapped", "state": "firing",
+              "about_rank": FLEET, "trace": 3, "span": 4}]
+    assert ctl.on_alerts(clear, tick=1) == []
+    assert ctl.on_alerts(other, tick=2) == []
+    assert journal == [] and ctl.actions_taken == 0
+
+
+def test_controller_dgro_rescore_and_resize_dispatch():
+    journal: list[dict] = []
+    store = _StubStore()
+    resized = []
+
+    def resize(rank):
+        resized.append(rank)
+        return {"target_p": 1}
+
+    ctl = OpsController(
+        sink=journal.append,
+        policy={"skew": "dgro_rescore", "stale": "resize"},
+        ring_store=store,
+        resize=resize,
+        cooldown=1,
+    )
+    skew = [{"kind": "alert", "rule": "skew", "state": "firing",
+             "about_rank": 1, "trace": 11, "span": 12}]
+    stale = [{"kind": "alert", "rule": "stale", "state": "firing",
+              "about_rank": 1, "trace": 21, "span": 22}]
+    a1 = ctl.on_alerts(skew, tick=8)
+    a2 = ctl.on_alerts(stale, tick=16)
+    assert [a["action"] for a in a1 + a2] == ["dgro_rescore", "resize"]
+    assert a1[0]["ok"] and a1[0]["detail"]["placement"] == {
+        "movement_chosen": 0.25
+    }
+    assert store.rescored == 1
+    assert a2[0]["ok"] and a2[0]["detail"] == {"target_p": 1}
+    assert resized == [1]
+    assert ctl.actions_taken == 2 and len(journal) == 2
+    with pytest.raises(ValueError, match="unknown actions"):
+        OpsController(sink=journal.append, policy={"x": "reboot_the_moon"})
+
+
+def test_controller_rejects_unknown_policy_subjects_cooldown_per_subject():
+    journal: list[dict] = []
+    ctl = OpsController(
+        sink=journal.append, policy={"skew": "dgro_rescore"},
+        ring_store=_StubStore(), cooldown=1000,
+    )
+    mk = lambda rank: [{  # noqa: E731
+        "kind": "alert", "rule": "skew", "state": "firing",
+        "about_rank": rank, "trace": rank * 10, "span": rank * 10 + 1,
+    }]
+    assert len(ctl.on_alerts(mk(1), tick=1)) == 1
+    assert ctl.on_alerts(mk(1), tick=2) == []  # cooldown holds per subject
+    assert len(ctl.on_alerts(mk(2), tick=3)) == 1  # other subject free
+
+
+# -- failing mitigation: the controller's OWN flight scope --------------------
+
+
+def test_failed_mitigation_dumps_controller_scope_only(tmp_path):
+    rec = FlightRecorder(capacity=16, rank=0,
+                         path=str(tmp_path / "flight.jsonl"))
+    rec.event("warmup", n=1)
+    journal: list[dict] = []
+    ctl = OpsController(
+        sink=journal.append, policy={"spike": "drain"},
+        ring_store=_StubStore(fail=True), server_of=lambda s: "z0",
+        recorder=rec, cooldown=1,
+    )
+    alerts = _one_alert(journal)
+    acts = ctl.on_alerts(alerts, tick=24)
+    assert len(acts) == 1 and not acts[0]["ok"]
+    assert "RuntimeError: ring wedged" in acts[0]["error"]
+    assert ctl.actions_failed == 1
+    # exactly one dump, controller-scoped, naming the failed action —
+    # and the ENGINE once-per-process slot is untouched
+    ctl_dump = tmp_path / "flight-controller.jsonl"
+    assert rec.dumps == {"controller": str(ctl_dump)}
+    assert rec.dumped is None
+    lines = ctl_dump.read_text().splitlines()
+    header = json.loads(lines[0])
+    assert header["kind"] == "flight_header"
+    assert header["scope"] == "controller"
+    assert header["reason"] == "controller:drain"
+    assert "RuntimeError" in header["error"]
+    # a second failing mitigation does not re-dump (once per scope)
+    more = _one_alert(journal, subject=FLEET)
+    ctl._drained.clear()
+    ctl.on_alerts(more, tick=32)
+    assert list(rec.dumps) == ["controller"]
+    # the engine crash dump still fires afterwards, to its own file
+    engine = rec.dump("fabric:FabricPeerLost", error=OSError("peer gone"))
+    assert engine == str(tmp_path / "flight.jsonl")
+    assert rec.dumped == engine
+    eh = json.loads((tmp_path / "flight.jsonl").read_text().splitlines()[0])
+    assert eh["scope"] == "engine" and eh["reason"] == "fabric:FabricPeerLost"
+
+
+# -- /metrics timing exposition (satellite: real summaries) -------------------
+
+
+def test_prometheus_timing_summary_exposition():
+    st = AggregatingStats()
+    for v in (0.010, 0.020, 0.030):
+        st.timing("ringpop.serve.lookup-us", v)
+    text = render_prometheus({0: st.snapshot()})
+    assert "# TYPE ringpop_serve_lookup_us summary" in text
+    # the reservoir caveat must ride the family, and no _sum may exist
+    assert "reservoir-sampled quantiles" in text
+    assert "ringpop_serve_lookup_us_sum" not in text
+    assert 'ringpop_serve_lookup_us{rank="0",quantile="0.5"}' in text
+    assert 'ringpop_serve_lookup_us{rank="0",quantile="0.99"}' in text
+    assert 'ringpop_serve_lookup_us_count{rank="0"} 3' in text
+    # aux stats stay available as explicit gauges
+    assert "# TYPE ringpop_serve_lookup_us_mean gauge" in text
+
+
+# -- LiveOps rank restart: stale -> live over the rejoin path -----------------
+
+
+def _sync_until(opses, pred, rounds=300, pause=0.02):
+    for _ in range(rounds):
+        for ops in opses:
+            ops.sync()
+        if pred():
+            return True
+        time.sleep(pause)
+    return False
+
+
+def test_liveops_rank_restart_rejoins_same_rank_id():
+    kv = LocalKV()
+    ns = "obs-rejoin-t"
+    built: dict[int, LiveOps] = {}
+
+    def boot(rank):
+        built[rank] = LiveOps(rank, 2, kv=kv, namespace=ns,
+                              timeout_ms=10_000, stale_s=120.0)
+
+    ts = [threading.Thread(target=boot, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    ops0, ops1 = built[0], built[1]
+    ops1b = None
+    try:
+        assert _sync_until(
+            [ops1, ops0],
+            lambda: ops0.health()["ranks"].get("1", {}).get("live") is True,
+        ), "initial bring-up never went live"
+
+        # rank 1 dies abruptly: its socket closes, rank 0's pending
+        # obs rounds fail, /healthz flips the rank to live=false
+        ops1.close()
+        assert _sync_until(
+            [ops0],
+            lambda: ops0.health()["ranks"]["1"]["live"] is False,
+        ), "rank 0 never marked the dead rank stale"
+
+        # the restart: SAME rank id, rejoin=True — the fabric advertises
+        # a rejoin listener instead of redoing collective bring-up, and
+        # rank 0 dials it from sync().  stale -> live is the pin.
+        ops1b = LiveOps(1, 2, kv=kv, namespace=ns,
+                        timeout_ms=10_000, stale_s=120.0, rejoin=True)
+        assert _sync_until(
+            [ops1b, ops0],
+            lambda: ops0.health()["ranks"]["1"]["live"] is True,
+        ), "restarted rank never transitioned back to live"
+
+        # and the data plane works again: fresh counters flow to rank 0
+        ops1b.stats.incr("ringpop.test.rejoin", 7)
+        assert _sync_until(
+            [ops1b, ops0],
+            lambda: ops0.snapshots().get(1, {}).get("counters", {})
+            .get("ringpop.test.rejoin") == 7,
+        ), "restarted rank's snapshots never reached rank 0"
+        assert ops0.health()["ok"] is True
+    finally:
+        for ops in (ops0, ops1, ops1b):
+            if ops is not None:
+                ops.close()
+
+
+# -- the serve/forward seams the controller drives ----------------------------
+
+
+def test_ring_store_drain_commit_and_record():
+    from ringpop_tpu.serve.state import RingStore
+
+    events: list[dict] = []
+    store = RingStore(["z0", "z1", "z2", "z3"], replica_points=16,
+                      on_update=events.append)
+    g0 = store.gen
+    rec = store.drain(["z1"])
+    assert rec is not None and rec["gen"] == g0 + 1
+    assert rec["drain"] is True and rec["removed"] == ["z1"]
+    # the listener saw the SAME stamped record (stamped before on_update)
+    assert events[-1]["drain"] is True
+    # the drained server really routes away
+    keys = [f"k{i}" for i in range(256)]
+    assert "z1" not in set(store.ring.lookup_batch(keys))
+    # draining a server that is not in the ring is a no-op
+    assert store.drain(["nope"]) is None
+    assert store.gen == g0 + 1
+
+
+def test_ring_store_rescore_only_under_dgro():
+    from ringpop_tpu.serve.state import RingStore
+
+    plain = RingStore(["a", "b"], replica_points=8)
+    assert plain.rescore_placement() is None
+
+    events: list[dict] = []
+    store = RingStore(
+        ["a", "b", "c", "d"], replica_points=16, placement="dgro",
+        placement_kw={"candidates": 2, "probes": 1 << 8},
+        on_update=events.append,
+    )
+    g0 = store.gen
+    rec = store.rescore_placement()
+    assert rec is not None and rec["gen"] == g0 + 1
+    assert rec["rescored"] is True
+    # the fresh scorer report rides the record for the journal
+    assert "placement" in rec and "movement_chosen" in rec["placement"]
+    assert events[-1].get("rescored") is True
+
+
+def test_rank_load_is_the_skew_signal():
+    from ringpop_tpu.forward.batch import rank_load
+    from ringpop_tpu.ops.ring_ops import build_ring_tokens
+
+    toks, _ = build_ring_tokens([f"s{i}" for i in range(4)], 8)
+    tokens = np.asarray(toks, np.uint32)
+    rng = np.random.default_rng(7)
+    hashes = rng.integers(0, 1 << 32, size=512, dtype=np.uint64).astype(
+        np.uint32
+    )
+    loads = rank_load(tokens, hashes, 2)
+    assert loads.shape == (2,) and loads.dtype == np.int64
+    assert int(loads.sum()) == 512
+    assert (loads > 0).all()  # 512 uniform keys never land one-sided
