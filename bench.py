@@ -280,42 +280,97 @@ def main() -> None:
     )
 
 
-def _transport_rtt_us(reps: int) -> float:
-    """Small-RPC echo round-trip (p50, µs) over the folded TCP channel —
-    the r21 one-transport-plane path (channel on the fabric's RPC plane:
-    persistent per-link threads, vectored sends, pooled receive arenas,
-    opportunistic inline send).  msgpack codec, in-process server."""
-    import asyncio
+def _trimmed_batch_median(samples: list, batches: int = 8) -> float:
+    """Trimmed median-of-batches: split ``samples`` (in arrival order)
+    into ``batches`` contiguous batches, take each batch's median, drop
+    the highest and lowest batch medians, mean the rest.
 
+    Why: a single p50 over N mixed samples is hostage to WHICH scheduler
+    regime the run landed in on a busy 2-core container — 200 fast-mode
+    reps vs 1000 full-mode reps disagreed by far more than the effect
+    being gated.  Batch medians kill per-sample outliers; trimming kills
+    whole displaced batches (a noisy-neighbor burst); the mean of the
+    surviving medians is stable enough that fast and full mode agree
+    within noise (pinned by test_bench_probe)."""
+    n = len(samples)
+    if n == 0:
+        raise ValueError("no samples")
+    batches = max(1, min(batches, n))
+    size = n / batches
+    meds = []
+    for b in range(batches):
+        chunk = sorted(samples[int(b * size): int((b + 1) * size)])
+        if chunk:
+            meds.append(chunk[len(chunk) // 2])
+    meds.sort()
+    if len(meds) > 2:
+        meds = meds[1:-1]  # drop the one high + one low batch
+    return sum(meds) / len(meds)
+
+
+def _transport_rtt_us(reps: int, codec: str = "msgpack") -> dict:
+    """Small-RPC echo round-trip over the folded TCP channel — r23
+    latency-tiered path: sync handler dispatched on the link's reader
+    thread, ``call_sync`` inline completion (zero event-loop hops end to
+    end).  In-process server, one link, spin-then-park readers.
+
+    Returns ``{"p50_us", "p99_us"}``; p50 is a trimmed median-of-batches
+    (fast-mode undersampling fix — see ``_trimmed_batch_median``)."""
     from ringpop_tpu.net import TCPChannel
 
-    async def run() -> float:
-        server = TCPChannel(app="bench", codec="msgpack")
+    server = TCPChannel(app="bench", codec=codec)
 
-        async def echo(body: dict, headers: dict) -> dict:
-            return body
+    def echo(body: dict, headers: dict) -> dict:
+        return body
 
-        server.register("bench", "/echo", echo)
-        addr = await server.listen("127.0.0.1", 0)
-        client = TCPChannel(app="bench-cli", codec="msgpack")
+    server.register("bench", "/echo", echo)
+    client = TCPChannel(app="bench-cli", codec=codec)
+    try:
+        addr = server.listen_sync("127.0.0.1", 0)
         payload = {"x": 7, "k": "bench"}
         for _ in range(20):  # warm the link + demux path
-            await client.call(addr, "bench", "/echo", payload, timeout=10)
+            client.call_sync(addr, "bench", "/echo", payload, timeout=10)
         samples = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            await client.call(addr, "bench", "/echo", payload, timeout=10)
+            client.call_sync(addr, "bench", "/echo", payload, timeout=10)
             samples.append(time.perf_counter() - t0)
-        await client.close()
-        await server.close()
-        samples.sort()
-        return samples[len(samples) // 2] * 1e6
-
-    loop = asyncio.new_event_loop()
-    try:
-        return loop.run_until_complete(run())
     finally:
-        loop.close()
+        client.close_sync()
+        server.close_sync()
+    p50 = _trimmed_batch_median(samples) * 1e6
+    ordered = sorted(samples)
+    p99 = ordered[min(len(ordered) - 1, (len(ordered) * 99) // 100)] * 1e6
+    return {"p50_us": p50, "p99_us": p99}
+
+
+def _transport_bulk_mbps(reps: int = 12, size: int = 256 * 1024) -> float:
+    """Bulk-body throughput (MB/s, msgpack) over the same channel path —
+    one ``size``-byte binary blob echoed per call; measures the vectored
+    send + pooled receive arena path, not the small-frame tiers."""
+    from ringpop_tpu.net import TCPChannel
+
+    server = TCPChannel(app="bench", codec="msgpack")
+
+    def echo(body: dict, headers: dict) -> dict:
+        return body
+
+    server.register("bench", "/echo", echo)
+    client = TCPChannel(app="bench-cli", codec="msgpack")
+    try:
+        addr = server.listen_sync("127.0.0.1", 0)
+        payload = {"blob": b"\xa5" * size}
+        for _ in range(3):
+            client.call_sync(addr, "bench", "/echo", payload, timeout=30)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            client.call_sync(addr, "bench", "/echo", payload, timeout=30)
+        dt = time.perf_counter() - t0
+    finally:
+        client.close_sync()
+        server.close_sync()
+    # bytes cross the wire twice per call (request + echoed response)
+    return (2 * reps * size) / dt / 1e6
 
 
 def run_bench() -> None:
@@ -549,17 +604,29 @@ def run_bench() -> None:
     jax.block_until_ready(_serve_loop(sring, hashes))
     serve_qps = batch * 10 / (time.perf_counter() - t_r)
 
-    # -- secondary: transport RTT (r21 one-transport-plane fold) ------------
-    # the folded channel's small-RPC p50 vs the retired asyncio channel's
-    # captured baseline (same probe methodology, same container class —
-    # PERF.md r21).  A thread-hop regression in the RPC plane shows up
-    # here without waiting for a serve-tier wall-clock drift.
+    # -- secondary: transport RTT (r21 fold, r23 latency tiers) -------------
+    # the channel's small-RPC p50/p99 vs the retired asyncio channel's
+    # captured baselines (same probe methodology, same container class —
+    # PERF.md r21/r23).  r23 measures the tiered path (reader-thread
+    # dispatch + inline completion) for BOTH codecs; the acceptance bar
+    # is p50 at or below the pre-fold asyncio numbers.
     transport_rtt_baseline = 82.1  # pre-fold asyncio channel, msgpack p50 µs
+    transport_rtt_json_baseline = 104.0  # pre-fold asyncio channel, json p50 µs
+    transport_bulk_baseline = 981.0  # r21 bulk msgpack MB/s (PERF.md r21)
+    rtt_reps = 200 if fast else 1000
     try:
-        transport_rtt = round(_transport_rtt_us(200 if fast else 1000), 1)
+        _rtt_mp = _transport_rtt_us(rtt_reps, codec="msgpack")
+        _rtt_js = _transport_rtt_us(rtt_reps, codec="json")
+        transport_rtt = round(_rtt_mp["p50_us"], 1)
+        transport_rtt_p99 = round(_rtt_mp["p99_us"], 1)
+        transport_rtt_json = round(_rtt_js["p50_us"], 1)
+        transport_rtt_json_p99 = round(_rtt_js["p99_us"], 1)
+        transport_bulk = round(_transport_bulk_mbps(6 if fast else 12), 1)
         transport_rtt_err = None
     except Exception as e:  # never let the side probe kill the headline
-        transport_rtt, transport_rtt_err = None, f"{type(e).__name__}: {e}"
+        transport_rtt = transport_rtt_p99 = None
+        transport_rtt_json = transport_rtt_json_p99 = transport_bulk = None
+        transport_rtt_err = f"{type(e).__name__}: {e}"
 
     baseline_s = 60.0  # BASELINE.json north star
     baseline_n = 1_000_000
@@ -615,7 +682,13 @@ def run_bench() -> None:
         "ring_lookup_qps": round(ring_qps, 0),
         "serve_lookup_qps": round(serve_qps, 0),
         "transport_rtt_us": transport_rtt,
+        "transport_rtt_p99_us": transport_rtt_p99,
         "transport_rtt_baseline_us": transport_rtt_baseline,
+        "transport_rtt_json_us": transport_rtt_json,
+        "transport_rtt_json_p99_us": transport_rtt_json_p99,
+        "transport_rtt_json_baseline_us": transport_rtt_json_baseline,
+        "transport_bulk_mbps": transport_bulk,
+        "transport_bulk_baseline_mbps": transport_bulk_baseline,
         "transport_rtt_error": transport_rtt_err,
         "view_checksum_s": round(checksum_s, 4),
         "platform": platform,

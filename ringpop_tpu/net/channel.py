@@ -36,6 +36,7 @@ import inspect
 import json
 import os
 import random
+import threading
 from typing import Awaitable, Callable, Optional
 
 from ringpop_tpu import logging as logging_mod
@@ -371,10 +372,23 @@ class TCPChannel(BaseChannel):
     Wire format change vs pre-r21: each body now rides ONE fabric
     transport frame (16-byte ``_HDR``: RPC tag + request id, blob count,
     body length) instead of being self-delimiting on a bare socket.  The
-    body bytes themselves are byte-identical."""
+    body bytes themselves are byte-identical.
+
+    r23 latency tiers: plain-sync handlers dispatch directly on the
+    link's reader thread (the server-side loop hop survives only for
+    coroutine handlers and traced requests), and :meth:`call_sync` gives
+    blocking callers inline completion — the reader thread fulfills a
+    condition-variable future in place, zero event-loop hops end to end.
+    ``flush_us`` enables small-frame coalescing on this endpoint's
+    links; ``shm_lane`` negotiates the same-host shm frame lane;
+    ``spin_us`` tunes the readers' spin-then-park window.  Every knob
+    preserves the body bytes bit-for-bit — lanes move frames, never
+    reshape them."""
 
     def __init__(self, app: str = "", codec: Optional[str] = None,
-                 ledger: Optional[TransportLedger] = None):
+                 ledger: Optional[TransportLedger] = None, *,
+                 flush_us: float = 0.0, shm_lane: Optional[bool] = None,
+                 spin_us: Optional[float] = None):
         super().__init__(app)
         self.codec = codec or default_codec()
         self._encode = _encoder_for(self.codec)
@@ -384,14 +398,23 @@ class TCPChannel(BaseChannel):
         self._ep = RpcEndpoint(
             self._on_request, ledger=ledger, ledger_class="rpc",
             max_body_bytes=MAX_FRAME_BYTES,
+            flush_us=flush_us, shm_lane=shm_lane, spin_us=spin_us,
         )
         # legacy frame-level accounting (the pre-r21 keys, body bytes
-        # only): kept per-channel and loop-thread-only so existing
-        # journal consumers and the monotone-sampling pins are unmoved.
+        # only): kept per-channel so existing journal consumers and the
+        # monotone-sampling pins are unmoved.  r23: sync callers and
+        # reader-thread dispatch bump these off the loop too, so the
+        # counters take a lock (reads stay lock-free int snapshots).
         # The transport-level truth (incl. the 16 B/frame fabric header
         # and the receive side) is ``self.ledger.stats()``.
         self.bytes_sent = 0
         self.frames_sent = 0
+        self._legacy_lock = threading.Lock()
+
+    def _count_sent(self, nbytes: int) -> None:
+        with self._legacy_lock:
+            self.bytes_sent += nbytes
+            self.frames_sent += 1
 
     @property
     def ledger(self) -> TransportLedger:
@@ -409,19 +432,57 @@ class TCPChannel(BaseChannel):
         self.hostport = self._ep.listen(host, port)
         return self.hostport
 
+    def listen_sync(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Loop-less listen (r23): serve PLAIN-SYNC handlers entirely on
+        the links' reader threads — no asyncio anywhere in the request
+        path.  Coroutine handlers need :meth:`listen` (they have no loop
+        to run on here; their requests would time out at the caller)."""
+        self.hostport = self._ep.listen(host, port)
+        return self.hostport
+
     async def close(self) -> None:
         # endpoint close joins link threads (bounded); keep it off the loop
         await asyncio.get_event_loop().run_in_executor(None, self._ep.close)
 
+    def close_sync(self) -> None:
+        """Blocking close for loop-less channels (``listen_sync`` /
+        pure-``call_sync`` users)."""
+        self._ep.close()
+
     def _on_request(self, link, rid: int, payload) -> None:
         """Inbound request, on the link's reader thread.  ``payload`` is a
-        memoryview into the pooled arena — decode NOW, then hop onto the
-        event loop for dispatch."""
+        memoryview into the pooled arena — decode NOW.  r23: a plain-sync
+        handler (untraced request) dispatches RIGHT HERE and responds
+        inline — zero loop hops; coroutine handlers, traced requests and
+        missing-handler errors keep the event-loop path."""
         frame = _decode_frame_body(payload)
         if frame is None:
             # garbage breaks only its own connection (pre-r21 reader
             # semantics): raising fails this link, nothing else
             raise FabricError("rpc request body undecodable — dropping the connection")
+        handler = self._handlers.get((frame.get("svc", ""), frame.get("ep", "")))
+        headers = frame.get("headers") or {}
+        if (
+            handler is not None
+            and not inspect.iscoroutinefunction(handler)
+            and (self.tracer is None or TRACE_HEADER not in headers)
+        ):
+            res = {"id": frame.get("id"), "kind": "res"}
+            try:
+                body = handler(frame.get("body") or {}, headers)
+            except Exception as e:
+                res["ok"] = False
+                res["err"] = str(e)
+            else:
+                if inspect.isawaitable(body):
+                    # a sync-def handler handed back an awaitable: only
+                    # the loop can finish it
+                    self._finish_awaitable(frame, link, rid, body)
+                    return
+                res["ok"] = True
+                res["body"] = body
+            self._respond(link, rid, res)
+            return
         loop = self._loop
         if loop is None or loop.is_closed():
             return
@@ -429,6 +490,26 @@ class TCPChannel(BaseChannel):
             asyncio.run_coroutine_threadsafe(self._serve_frame(frame, link, rid), loop)
         except RuntimeError:
             pass  # loop shut down mid-flight
+
+    def _finish_awaitable(self, frame: dict, link, rid: int, body) -> None:
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        async def finish() -> None:
+            res = {"id": frame.get("id"), "kind": "res"}
+            try:
+                res["body"] = await body
+                res["ok"] = True
+            except Exception as e:
+                res["ok"] = False
+                res["err"] = str(e)
+            self._respond(link, rid, res)
+
+        try:
+            asyncio.run_coroutine_threadsafe(finish(), loop)
+        except RuntimeError:
+            pass
 
     async def _serve_frame(self, frame: dict, link, rid: int) -> None:
         res = {"id": frame.get("id"), "kind": "res"}
@@ -441,6 +522,9 @@ class TCPChannel(BaseChannel):
         except Exception as e:  # handler error propagates as app error
             res["ok"] = False
             res["err"] = str(e)
+        self._respond(link, rid, res)
+
+    def _respond(self, link, rid: int, res: dict) -> None:
         try:
             payload = self._encode(res)
         except Exception as e:
@@ -460,8 +544,7 @@ class TCPChannel(BaseChannel):
         # the socket the client can observe the reply and read wire_stats()
         # from another thread — counting after the write races that read
         # (the ledger counts at write time and would show one more frame).
-        self.bytes_sent += len(payload)
-        self.frames_sent += 1
+        self._count_sent(len(payload))
         link.respond(rid, payload)
 
     # -- client side --------------------------------------------------------
@@ -505,7 +588,7 @@ class TCPChannel(BaseChannel):
             except RuntimeError:
                 pass  # loop already closed; nobody is awaiting
 
-        def on_reply(payload):
+        def on_reply(payload, lane="tcp"):
             # reader-thread callback: payload is an arena memoryview (or
             # the link's sticky error) — decode here, resolve on the loop
             if isinstance(payload, BaseException):
@@ -526,13 +609,76 @@ class TCPChannel(BaseChannel):
                 _post(fut.set_exception, RemoteError(res.get("err", "remote error")))
 
         link.request(rid, encoded, on_reply)
-        self.bytes_sent += len(encoded)
-        self.frames_sent += 1
+        self._count_sent(len(encoded))
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             link.forget(rid)
             raise CallTimeoutError(f"call {peer} {endpoint} timed out after {timeout}s")
+
+    def call_sync(self, peer, service, endpoint, body, headers=None,
+                  timeout=None, urgent=False) -> dict:
+        """Blocking call with INLINE COMPLETION (r23): the reply is
+        fulfilled directly on the link's reader thread via an Event —
+        no event loop in the round trip at all.  Pair with a sync
+        handler on the far side (or ``listen_sync``) for the zero-hop
+        path: caller-thread write → reader-thread wake.
+
+        ``urgent=True`` bypasses small-frame coalescing on channels
+        built with ``flush_us > 0`` (the probe escape hatch).  Must be
+        called OFF the event loop (it blocks)."""
+        try:
+            link = self._ep.connect(peer)
+        except FabricPeerLost as e:
+            raise PeerUnreachableError(str(e)) from e
+        rid = link.alloc_id()
+        frame = {
+            "id": rid,
+            "kind": "req",
+            "svc": service,
+            "ep": endpoint,
+            "body": body,
+            "headers": headers or {},
+        }
+        try:
+            encoded = self._encode(frame)
+        except Exception as e:
+            raise CallError(f"encode request for {peer}: {type(e).__name__}: {e}") from e
+        done = threading.Event()
+        slot = [None, None]  # [result_body, error]
+
+        def on_reply(payload, lane="tcp"):
+            # reader thread (tcp) or shm-lane reader thread: decode and
+            # fulfil right here — the waiter wakes on a futex, not a loop
+            if isinstance(payload, BaseException):
+                err = payload if isinstance(payload, CallError) else (
+                    PeerUnreachableError(str(payload)))
+                if err is not payload and err.__cause__ is None:
+                    err.__cause__ = payload
+                slot[1] = err
+                done.set()
+                return
+            res = _decode_frame_body(payload)
+            if res is None:
+                slot[1] = PeerUnreachableError(
+                    f"undecodable response frame from {peer}")
+                done.set()
+                raise FabricError("rpc response undecodable — dropping the connection")
+            if res.get("ok"):
+                slot[0] = res.get("body") or {}
+            else:
+                slot[1] = RemoteError(res.get("err", "remote error"))
+            self.ledger.add("rpc", lane=lane, inline_completions=1)
+            done.set()
+
+        link.request(rid, encoded, on_reply, urgent=urgent)
+        self._count_sent(len(encoded))
+        if not done.wait(timeout):
+            link.forget(rid)
+            raise CallTimeoutError(f"call {peer} {endpoint} timed out after {timeout}s")
+        if slot[1] is not None:
+            raise slot[1]
+        return slot[0] if slot[0] is not None else {}
 
 
 # ---------------------------------------------------------------------------

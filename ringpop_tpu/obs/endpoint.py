@@ -301,10 +301,36 @@ class LiveOps:
 
     # -- views ----------------------------------------------------------------
 
+    def _mirror_ledger(self) -> None:
+        """r23: mirror the shared TransportLedger's per-class / per-LANE
+        rows into gauges so ``/metrics`` exposes the lane split (tcp vs
+        shm bytes/frames, ``inline_completions``, ``coalesced_frames``)
+        without a second scrape surface.  Gauge names:
+        ``ringpop.transport.<class>.<lane>.<field>``."""
+        led = self.ledger
+        if led is None or not hasattr(led, "stats"):
+            return
+        try:
+            st = led.stats()
+        except Exception:
+            return  # the ops plane never takes the run down
+        for klass, row in st.get("classes", {}).items():
+            for lane, lrow in (row.get("lanes") or {}).items():
+                for field, v in lrow.items():
+                    self.stats.gauge(
+                        f"ringpop.transport.{klass}.{lane}."
+                        f"{field.replace('_', '-')}",
+                        v,
+                    )
+        self.stats.gauge(
+            "ringpop.transport.copy-bytes", st.get("copy_bytes", 0)
+        )
+
     def snapshots(self) -> dict[int, dict]:
         """{rank: stats snapshot} — self fresh, peers as last collected."""
         if self.rank == 0 and self.fabric is not None:
             self._harvest()
+        self._mirror_ledger()
         out = {self.rank: self.stats.snapshot()}
         with self._lock:
             for peer, entry in self._peers.items():

@@ -70,9 +70,12 @@ from __future__ import annotations
 
 import base64
 import functools
+import json
+import os
 import queue
 import socket
 import struct
+import tempfile
 import threading
 import time
 from typing import NamedTuple, Optional, Sequence, Union
@@ -445,32 +448,56 @@ class TransportLedger:
     counters (``Fabric.wire_stats``, the channel's ``wire_stats``, the
     shm server's slot accounting) on identical traffic — the r21
     migration contract pinned by test and by ``make transport-smoke``.
+
+    r23 adds a per-LANE dimension under every class: the RPC plane can
+    carry a frame over TCP or over the same-host shm frame lane, and the
+    serve ring is a shm lane by construction.  ``add(..., lane=...)``
+    attributes each delta to one lane; a class row in ``stats()`` is the
+    field-wise SUM of its lanes (so every pre-r23 reconciliation holds
+    unchanged) plus a ``"lanes"`` sub-dict with the split.  Two latency-
+    tier liveness counters ride along: ``inline_completions`` (replies
+    fulfilled directly on a reader thread for a blocked sync caller —
+    zero event-loop hops) and ``coalesced_frames`` (frames that shared
+    one ``sendmsg`` with at least one other frame).
     """
 
     FIELDS = (
         "bytes_sent", "bytes_recv", "raw_bytes_sent", "raw_bytes_recv",
         "frames_sent", "frames_recv", "copy_bytes",
+        "inline_completions", "coalesced_frames",
     )
+    LANES = ("tcp", "shm")
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._classes: dict[str, dict[str, int]] = {}
+        # class -> lane -> field row
+        self._classes: dict[str, dict[str, dict[str, int]]] = {}
 
-    def add(self, klass: str, **deltas: int) -> None:
+    def add(self, klass: str, lane: str = "tcp", **deltas: int) -> None:
         with self._lock:
-            row = self._classes.setdefault(
-                klass, {f: 0 for f in self.FIELDS}
-            )
+            lanes = self._classes.setdefault(klass, {})
+            row = lanes.setdefault(lane, {f: 0 for f in self.FIELDS})
             for k, v in deltas.items():
                 row[k] += int(v)
 
     def stats(self) -> dict:
-        """Snapshot: ``{"classes": {class: {field: n}}, "total": {field:
-        n}, "copy_bytes": n}`` — ``copy_bytes`` is lifted to the top
-        level because it is the zero-copy certificate, not a traffic
-        counter."""
+        """Snapshot: ``{"classes": {class: {field: n, "lanes": {lane:
+        {field: n}}}}, "total": {field: n}, "copy_bytes": n}`` — a class
+        row's fields are the sums of its lanes; ``copy_bytes`` is lifted
+        to the top level because it is the zero-copy certificate, not a
+        traffic counter."""
         with self._lock:
-            classes = {k: dict(v) for k, v in sorted(self._classes.items())}
+            snap = {
+                k: {ln: dict(r) for ln, r in sorted(lanes.items())}
+                for k, lanes in sorted(self._classes.items())
+            }
+        classes: dict[str, dict] = {}
+        for k, lanes in snap.items():
+            row: dict = {
+                f: sum(r[f] for r in lanes.values()) for f in self.FIELDS
+            }
+            row["lanes"] = lanes
+            classes[k] = row
         total = {f: sum(v[f] for v in classes.values()) for f in self.FIELDS}
         return {
             "classes": classes,
@@ -1406,6 +1433,7 @@ class Fabric:
 
 TAG_RPC_REQ = 0x51 << 24  # | (id & _RPC_ID_MASK)
 TAG_RPC_RES = 0x52 << 24
+TAG_RPC_CTL = 0x53 << 24  # control: shm-lane negotiation (offer/ack/nak)
 _RPC_KIND_MASK = 0xFF000000
 _RPC_ID_MASK = 0x00FFFFFF
 
@@ -1413,6 +1441,304 @@ _RPC_ID_MASK = 0x00FFFFFF
 # the channel's MAX_FRAME_BYTES: caps what a desynced or malicious peer
 # can make the reader arena hold
 MAX_RPC_BODY_BYTES = 64 * 1024 * 1024
+
+# -- r23 latency tiers --------------------------------------------------------
+#
+# Reader spin window: after link activity the reader busy-polls (non-
+# blocking recv attempts, each releasing the GIL at the syscall) for this
+# long before parking in blocking recv — the serve shm ring's post-
+# activity burst discipline applied to the TCP readers.  On request/
+# response ping-pong the next frame lands inside the window, so the
+# steady-state round trip never pays a kernel thread wakeup.  Small by
+# design: an idle link burns at most one window per received frame.
+#
+# The DEFAULT is core-count-aware: a spinning reader only wins when the
+# thread that will produce the next frame has its own core to run on.
+# On 1-2 core containers the spinner STEALS the producer's core (and its
+# GIL slice) and measurably inflates RTT — measured on the 1-core CI
+# box: 66 µs p50 at spin=0 vs 195 µs at spin=60.  Explicit ``spin_us``
+# or the env var always wins over the heuristic.
+def _default_spin_us() -> float:
+    env = os.environ.get("RINGPOP_TPU_RPC_SPIN_US")
+    if env is not None:
+        return float(env)
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    return 60.0 if cores >= 4 else 0.0
+
+# frames at or under this (header included) are "small": they may wait up
+# to the endpoint's ``flush_us`` for company and flush as ONE sendmsg
+_COALESCE_MAX_FRAME = 4096 + _HDR.size
+# bound one coalesced sendmsg batch (stays under the _IOV_CHUNK split)
+_COALESCE_MAX_FRAMES = 128
+
+# sender-queue sentinel: cut any open coalescing window NOW (the
+# explicit-flush escape hatch for latency-critical probes)
+_FLUSH = object()
+
+
+class _RpcShmLane:
+    """Same-host frame lane for one :class:`RpcLink` (r23).
+
+    One shared segment holds 8 control words plus two SPSC frame rings
+    (creator→attacher and attacher→creator), each ``slots`` slots of 4
+    uint32 header words (``seq``, ``ack``, ``len``, reserved) and
+    ``slot_bytes`` of payload.  The slot protocol is ``serve/shm.py``'s
+    seq-word discipline generalized to opaque fabric frames: the writer
+    fills the payload, then publishes ``seq = w + 1`` (x86-TSO-ordered
+    numpy stores, payload strictly before seq); the reader dispatches a
+    READ-ONLY view of the slot (zero copy — the frame is consumed before
+    the ack, exactly like the serve ring's slot-lifetime contract) and
+    only then publishes ``ack = seq``; a slot is writable iff
+    ``seq == ack``.  Wakeups reuse the serve doorbell shape: the reader
+    spins a post-activity burst window, then sets its parked word and
+    blocks on a unix datagram socket; the writer pokes the bell only when
+    the parked word is set (the set-parked → re-check / publish → read-
+    parked orderings make the missed-wake race impossible under TSO).
+
+    TCP stays the negotiation and fallback path: frames larger than a
+    slot, or arriving while the ring is full, ride the socket — the
+    demux is by tag, so cross-lane ordering is free to differ.
+    """
+
+    _MAGIC = 0x52504C31  # "RPL1"
+    _CTRL_WORDS = 8  # [magic, slots, slot_bytes, parked0, parked1, 0, 0, 0]
+    _SLOT_HDR_WORDS = 4
+    _SEQ, _ACK, _LEN = 0, 1, 2
+
+    def __init__(self, shm, slots: int, slot_bytes: int, side: int,
+                 created: bool):
+        self.shm = shm
+        self.name = shm.name
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        self.side = side  # 0 = creator (offerer), 1 = attacher
+        self._created = created
+        self.tx_ready = False  # set once the peer confirmed the lane
+        self.peer_bell: Optional[str] = None
+        self._closing = False
+        self._w = 0  # frames written to the tx ring
+        words = np.frombuffer(shm.buf, dtype=np.uint32)
+        byts = np.frombuffer(shm.buf, dtype=np.uint8)
+        self._ctrl = words[: self._CTRL_WORDS]
+        per_slot_words = self._SLOT_HDR_WORDS + slot_bytes // 4
+        ring_words = slots * per_slot_words
+
+        def ring(idx: int):
+            base = self._CTRL_WORDS + idx * ring_words
+            hdrs, pays = [], []
+            for s in range(slots):
+                w0 = base + s * per_slot_words
+                hdrs.append(words[w0 : w0 + self._SLOT_HDR_WORDS])
+                b0 = (w0 + self._SLOT_HDR_WORDS) * 4
+                pays.append(byts[b0 : b0 + slot_bytes])
+            return hdrs, pays
+
+        tx, rx = (0, 1) if side == 0 else (1, 0)
+        self._tx_hdrs, self._tx_pays = ring(tx)
+        self._rx_hdrs, self._rx_pays = ring(rx)
+        self._park_idx = 3 + side  # my reader's parked word
+        self._peer_park_idx = 3 + (1 - side)
+        self._bell = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        self.my_bell_path = os.path.join(
+            tempfile.gettempdir(),
+            f"rp-rpc-{os.getpid()}-{self.name.lstrip('/')}-{side}.sock",
+        )
+        try:
+            os.unlink(self.my_bell_path)
+        except FileNotFoundError:
+            pass
+        self._bell.bind(self.my_bell_path)
+        # park with a timeout: a lost doorbell datagram must degrade to a
+        # periodic re-check, never a wedge
+        self._bell.settimeout(0.1)
+        self._bell_tx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        self._bell_tx.setblocking(False)
+        self._reader: Optional[threading.Thread] = None
+
+    @classmethod
+    def create(cls, slots: int = 32, slot_bytes: int = 16384) -> "_RpcShmLane":
+        from multiprocessing import shared_memory
+
+        slot_bytes = (slot_bytes + 3) & ~3  # header words need 4-alignment
+        per_slot = cls._SLOT_HDR_WORDS * 4 + slot_bytes
+        size = cls._CTRL_WORDS * 4 + 2 * slots * per_slot
+        shm = shared_memory.SharedMemory(create=True, size=size)
+        np.frombuffer(shm.buf, dtype=np.uint8)[:] = 0
+        lane = cls(shm, slots, slot_bytes, side=0, created=True)
+        lane._ctrl[0] = np.uint32(cls._MAGIC)
+        lane._ctrl[1] = np.uint32(slots)
+        lane._ctrl[2] = np.uint32(slot_bytes)
+        return lane
+
+    @classmethod
+    def attach(cls, name: str, slots: int, slot_bytes: int,
+               peer_bell: Optional[str] = None) -> "_RpcShmLane":
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=name)
+        lane = cls(shm, slots, slot_bytes, side=1, created=False)
+        if (
+            int(lane._ctrl[0]) != cls._MAGIC
+            or int(lane._ctrl[1]) != slots
+            or int(lane._ctrl[2]) != slot_bytes
+        ):
+            lane.close()
+            raise FabricError("rpc shm lane segment mismatch")
+        lane.peer_bell = peer_bell
+        return lane
+
+    # -- writer side (single producer: callers hold the link's send lock) -----
+
+    def try_send(self, parts: Sequence, nbytes: int) -> bool:
+        """Write one frame (concatenated ``parts``, ``nbytes`` total)
+        into the next tx slot; False = does not fit / ring full / lane
+        closing — the caller falls back to TCP."""
+        if self._closing or nbytes > self.slot_bytes:
+            return False
+        try:
+            s = self._w % self.slots
+            hdr = self._tx_hdrs[s]
+            if int(hdr[self._SEQ]) != int(hdr[self._ACK]):
+                return False  # reader is a full ring behind
+            pay = self._tx_pays[s]
+            off = 0
+            for p in parts:
+                m = memoryview(p)
+                n = len(m)
+                if n:
+                    pay[off : off + n] = np.frombuffer(m, dtype=np.uint8)
+                    off += n
+            hdr[self._LEN] = np.uint32(nbytes)
+            # payload strictly before the seq publish (the serve slot
+            # contract)
+            hdr[self._SEQ] = np.uint32((self._w + 1) & 0xFFFFFFFF)
+            self._w += 1
+            if int(self._ctrl[self._peer_park_idx]) and self.peer_bell:
+                try:
+                    self._bell_tx.sendto(b"\x01", self.peer_bell)
+                except OSError:
+                    pass  # the parked reader re-checks on its own timeout
+        except (TypeError, AttributeError):
+            return False  # lane torn down under us: the TCP fallback owns it
+        return True
+
+    # -- reader side ----------------------------------------------------------
+
+    def start_reader(self, link: "RpcLink") -> None:
+        self._reader = threading.Thread(
+            target=self._recv_loop, args=(link,), daemon=True,
+            name=f"rpc-shm-recv-{self.name}")
+        self._reader.start()
+
+    def _recv_loop(self, link: "RpcLink") -> None:
+        spin_s = max(link._spin_s, 20e-6)
+        r = 0
+        ctrl = self._ctrl
+        deadline = time.perf_counter() + spin_s
+        while not self._closing:
+            hdr = self._rx_hdrs[r % self.slots]
+            want = np.uint32((r + 1) & 0xFFFFFFFF)
+            if hdr[self._SEQ] == want and hdr[self._ACK] != want:
+                ok = self._consume(link, self._rx_pays[r % self.slots],
+                                   int(hdr[self._LEN]))
+                # republish the slot only AFTER dispatch consumed the view
+                hdr[self._ACK] = want
+                r += 1
+                if not ok:
+                    return
+                deadline = time.perf_counter() + spin_s
+                continue
+            if time.perf_counter() < deadline:
+                time.sleep(0)  # yield the GIL inside the burst window
+                continue
+            ctrl[self._park_idx] = 1
+            # missed-wake guard: re-check AFTER publishing parked — a
+            # writer that saw parked==0 published its seq before we set
+            # the word, so this re-check observes the frame
+            if hdr[self._SEQ] == want and hdr[self._ACK] != want:
+                ctrl[self._park_idx] = 0
+                continue
+            try:
+                self._bell.recv(64)
+            except socket.timeout:
+                pass
+            except OSError:
+                return  # bell closed: lane teardown
+            ctrl[self._park_idx] = 0
+            deadline = time.perf_counter() + spin_s
+
+    def _consume(self, link: "RpcLink", pay, ln: int) -> bool:
+        if not _HDR.size <= ln <= self.slot_bytes:
+            link._fail(FabricError(
+                f"rpc shm frame malformed ({ln} bytes) — dropping the link"))
+            return False
+        tag, n_blobs, total = _HDR.unpack(pay[: _HDR.size].tobytes())
+        kind = tag & _RPC_KIND_MASK
+        if (
+            n_blobs != 1
+            or total != ln - _HDR.size
+            or kind not in (TAG_RPC_REQ, TAG_RPC_RES)
+        ):
+            link._fail(FabricError(
+                f"rpc shm frame malformed (tag {tag:#x}, {n_blobs} blobs, "
+                f"{total} bytes) — dropping the link"))
+            return False
+        link.ep.ledger.add(
+            link.ep.ledger_class, lane="shm",
+            bytes_recv=ln, frames_recv=1,
+        )
+        # read-only zero-copy view of the slot payload, valid until the
+        # ack below — same lifetime contract as the TCP arena views
+        view = pay[_HDR.size : ln].view()
+        view.flags.writeable = False
+        try:
+            link._dispatch_frame(tag, memoryview(view), "shm")
+        except BaseException as e:
+            if not isinstance(e, FabricError):
+                e = FabricError(
+                    f"rpc frame from {link.peer or 'peer'} undecodable: "
+                    f"{type(e).__name__}: {e}")
+            link._fail(e, e.__cause__)
+            return False
+        return True
+
+    def close(self) -> None:
+        self._closing = True
+        for s in (self._bell, self._bell_tx):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.my_bell_path)
+        except OSError:
+            pass
+        if (
+            self._reader is not None
+            and threading.current_thread() is not self._reader
+        ):
+            self._reader.join(timeout=2.0)
+        self._ctrl = None
+        self._tx_hdrs = self._tx_pays = None
+        self._rx_hdrs = self._rx_pays = None
+        try:
+            self.shm.close()
+        except BufferError:
+            import gc
+
+            gc.collect()
+            try:
+                self.shm.close()
+            except BufferError:
+                pass  # a live dispatch view defers the unmap to exit
+        if self._created:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
 
 
 class RpcLink:
@@ -1440,6 +1766,9 @@ class RpcLink:
         self._send_lock = threading.Lock()  # serializes wire writes
         self._hdr_buf = bytearray(_HDR.size)
         self._arena = bytearray(1 << 16)
+        self._spin_s = ep.spin_us / 1e6
+        self._flush_s = ep.flush_us / 1e6
+        self._shm: Optional[_RpcShmLane] = None
         name = peer or "accepted"
         self._sender = threading.Thread(
             target=self._send_loop, daemon=True, name=f"rpc-send-{name}")
@@ -1459,10 +1788,14 @@ class RpcLink:
                 if self._next_id not in self._pending:
                     return self._next_id
 
-    def request(self, rid: int, body: bytes, on_reply) -> None:
-        """Send ``body`` as request ``rid``; ``on_reply`` is invoked on
-        the reader thread with the response payload memoryview, or with
-        a BaseException (link failure / endpoint close)."""
+    def request(self, rid: int, body: bytes, on_reply,
+                urgent: bool = False) -> None:
+        """Send ``body`` as request ``rid``; ``on_reply(payload, lane)``
+        is invoked on a reader thread with the response payload
+        memoryview (and the lane that carried it), or with a
+        BaseException (link failure / endpoint close).  ``urgent=True``
+        bypasses any coalescing hold — the latency-critical probe
+        escape hatch."""
         with self._lock:
             if self.err is not None:
                 err = self.err
@@ -1470,9 +1803,9 @@ class RpcLink:
                 self._pending[rid] = on_reply
                 err = None
         if err is not None:
-            on_reply(err)
+            on_reply(err, "tcp")
             return
-        self._enqueue(TAG_RPC_REQ | (rid & _RPC_ID_MASK), body)
+        self._enqueue(TAG_RPC_REQ | (rid & _RPC_ID_MASK), body, urgent=urgent)
 
     def forget(self, rid: int) -> None:
         """Drop a pending request (caller-side timeout): a late response
@@ -1496,11 +1829,16 @@ class RpcLink:
     # only ever stall the dedicated sender, not the caller's loop
     _INLINE_SEND_MAX = 256 * 1024
 
-    def _enqueue(self, tag: int, body: bytes) -> None:
+    def _enqueue(self, tag: int, body: bytes, urgent: bool = False) -> None:
+        nbytes = _HDR.size + len(body)
         parts = [_HDR.pack(tag, 1, len(body)), body]
-        self.ep.ledger.add(
-            self.ep.ledger_class,
-            bytes_sent=_HDR.size + len(body), frames_sent=1,
+        # coalescing hold (r23): with a flush window configured, small
+        # frames go through the sender thread so company can share their
+        # sendmsg; ``urgent`` frames (probes, explicit flush) never wait
+        hold = (
+            self._flush_s > 0.0
+            and not urgent
+            and nbytes <= _COALESCE_MAX_FRAME
         )
         # opportunistic inline send: when nothing is queued and no other
         # thread is mid-write, push the frame from THIS thread — saves a
@@ -1508,13 +1846,14 @@ class RpcLink:
         # RPC frames are independent (tagged demux), so a frame slipping
         # ahead of one the sender thread just dequeued is harmless.
         if (
-            len(body) <= self._INLINE_SEND_MAX
+            not hold
+            and len(body) <= self._INLINE_SEND_MAX
             and self.sendq.empty()
             and self._send_lock.acquire(blocking=False)
         ):
             try:
                 if self.err is None:
-                    _send_parts(self.sock, parts)
+                    self._write_batch([(parts, nbytes)])
                 return
             except (OSError, ValueError) as e:
                 self._fail(FabricPeerLost(
@@ -1522,32 +1861,137 @@ class RpcLink:
                 return
             finally:
                 self._send_lock.release()
-        self.sendq.put(parts)
+        self.sendq.put((parts, nbytes))
+        if urgent:
+            # could not ride inline (sender busy / frame large): cut any
+            # open coalescing window so the sender flushes immediately
+            self.sendq.put(_FLUSH)
+
+    def flush(self) -> None:
+        """Explicit flush: cut any open coalescing window — queued small
+        frames stop waiting for company and go to the wire now."""
+        self.sendq.put(_FLUSH)
+
+    def _write_batch(self, batch: list) -> None:
+        """Write ``[(parts, nbytes), ...]`` to the wire (caller holds the
+        send lock).  Each frame tries the same-host shm lane first; the
+        TCP leftovers go as ONE vectored sendmsg — ``coalesced_frames``
+        counts frames that shared it with at least one other."""
+        led, klass = self.ep.ledger, self.ep.ledger_class
+        shm = self._shm
+        tcp_parts: list = []
+        tcp_frames = 0
+        tcp_bytes = 0
+        for parts, nbytes in batch:
+            # control frames (shm negotiation itself) are TCP-only —
+            # the lane never carries its own handshake
+            if (
+                shm is not None
+                and shm.tx_ready
+                and parts[0][0] != (TAG_RPC_CTL >> 24)
+                and shm.try_send(parts, nbytes)
+            ):
+                led.add(klass, lane="shm", bytes_sent=nbytes, frames_sent=1)
+                continue
+            tcp_parts.extend(parts)
+            tcp_frames += 1
+            tcp_bytes += nbytes
+        if tcp_frames:
+            _send_parts(self.sock, tcp_parts)
+            led.add(
+                klass, lane="tcp",
+                bytes_sent=tcp_bytes, frames_sent=tcp_frames,
+                coalesced_frames=tcp_frames if tcp_frames > 1 else 0,
+            )
 
     def _send_loop(self) -> None:
-        while True:
-            parts = self.sendq.get()
-            if parts is None:
+        stop = False
+        while not stop:
+            job = self.sendq.get()
+            if job is None:
                 return
+            if job is _FLUSH:
+                continue
+            batch = [job]
+            # gather company: drain whatever is already queued, and —
+            # with a flush window configured and only small frames in
+            # hand — wait up to flush_us for more (bounded added latency,
+            # one sendmsg instead of N)
+            deadline = None
+            if self._flush_s > 0.0 and job[1] <= _COALESCE_MAX_FRAME:
+                deadline = time.perf_counter() + self._flush_s
+            while len(batch) < _COALESCE_MAX_FRAMES:
+                try:
+                    nxt = self.sendq.get_nowait()
+                except queue.Empty:
+                    if deadline is None:
+                        break
+                    left = deadline - time.perf_counter()
+                    if left <= 0.0:
+                        break
+                    try:
+                        nxt = self.sendq.get(timeout=left)
+                    except queue.Empty:
+                        break
+                if nxt is None:
+                    stop = True
+                    break
+                if nxt is _FLUSH:
+                    break
+                batch.append(nxt)
+                if nxt[1] > _COALESCE_MAX_FRAME:
+                    deadline = None  # a big frame closes the wait window
             if self.err is not None:
                 continue
             try:
                 with self._send_lock:
-                    _send_parts(self.sock, parts)
+                    self._write_batch(batch)
             except (OSError, ValueError) as e:
                 self._fail(FabricPeerLost(
                     f"rpc send to {self.peer or 'peer'} failed ({e})"), e)
 
+    def _recv_hdr(self) -> bytearray:
+        """Read the 16-byte frame header, spin-then-park (r23): busy-poll
+        non-blocking recv attempts for the spin window (each attempt
+        releases the GIL at the syscall), then park in blocking recv —
+        the serve shm ring's post-activity burst discipline applied to
+        the TCP reader.  On ping-pong traffic the next frame lands
+        inside the window, so steady state never pays a kernel thread
+        wakeup."""
+        buf = self._hdr_buf
+        view = memoryview(buf)
+        need = _HDR.size
+        got = 0
+        if self._spin_s > 0.0:
+            end = time.perf_counter() + self._spin_s
+            while True:
+                try:
+                    r = self.sock.recv_into(view, need, socket.MSG_DONTWAIT)
+                except (BlockingIOError, InterruptedError):
+                    if time.perf_counter() >= end:
+                        break
+                    continue
+                if r == 0:
+                    raise FabricPeerLost("fabric peer closed the connection")
+                got = r
+                break
+        while got < need:
+            r = self.sock.recv_into(view[got:], need - got)
+            if r == 0:
+                raise FabricPeerLost("fabric peer closed the connection")
+            got += r
+        return buf
+
     def _recv_loop(self) -> None:
         while True:
             try:
-                hdr = _recv_exact(self.sock, _HDR.size, self._hdr_buf)
+                hdr = self._recv_hdr()
                 tag, n_blobs, total = _HDR.unpack(hdr)
                 kind = tag & _RPC_KIND_MASK
                 if (
                     n_blobs != 1
                     or total > self.ep.max_body_bytes
-                    or kind not in (TAG_RPC_REQ, TAG_RPC_RES)
+                    or kind not in (TAG_RPC_REQ, TAG_RPC_RES, TAG_RPC_CTL)
                 ):
                     raise FabricError(
                         f"rpc frame from {self.peer or 'peer'} malformed "
@@ -1565,18 +2009,11 @@ class RpcLink:
                 self._fail(e, e.__cause__)
                 return
             self.ep.ledger.add(
-                self.ep.ledger_class,
+                self.ep.ledger_class, lane="tcp",
                 bytes_recv=_HDR.size + total, frames_recv=1,
             )
-            rid = tag & _RPC_ID_MASK
             try:
-                if kind == TAG_RPC_RES:
-                    with self._lock:
-                        cb = self._pending.pop(rid, None)
-                    if cb is not None:
-                        cb(payload)
-                else:
-                    self.ep._handle_request(self, rid, payload)
+                self._dispatch_frame(tag, payload, "tcp")
             except BaseException as e:
                 # an undecodable frame is a broken peer (the pre-r21
                 # reader dropped the connection on garbage; same here)
@@ -1587,6 +2024,80 @@ class RpcLink:
                 self._fail(e, e.__cause__)
                 return
 
+    def _dispatch_frame(self, tag: int, payload, lane: str) -> None:
+        """Demux one inbound frame (either lane) on the reading thread."""
+        kind = tag & _RPC_KIND_MASK
+        rid = tag & _RPC_ID_MASK
+        if kind == TAG_RPC_RES:
+            with self._lock:
+                cb = self._pending.pop(rid, None)
+            if cb is not None:
+                cb(payload, lane)
+        elif kind == TAG_RPC_REQ:
+            self.ep._handle_request(self, rid, payload)
+        else:  # TAG_RPC_CTL: shm-lane negotiation (TCP only)
+            self._handle_ctl(payload)
+
+    # -- shm lane negotiation (r23) -------------------------------------------
+    #
+    # TCP carries the control frames: the dialer creates the segment and
+    # OFFERs (name, geometry, its doorbell path); the acceptor attaches —
+    # which succeeds exactly when the hosts share the segment namespace —
+    # and ACKs with its own doorbell path, or NAKs (lane disabled /
+    # attach failed / cross-host).  Frames ride TCP until the ack lands;
+    # oversized frames and full-ring moments ride TCP forever after.
+
+    def _offer_shm(self) -> None:
+        try:
+            lane = _RpcShmLane.create(
+                slots=self.ep.shm_slots, slot_bytes=self.ep.shm_slot_bytes)
+        except Exception:
+            return  # no usable shm on this host — stay on TCP
+        self._shm = lane
+        lane.start_reader(self)
+        body = json.dumps({
+            "op": "offer", "name": lane.name, "slots": lane.slots,
+            "slot_bytes": lane.slot_bytes, "bell": lane.my_bell_path,
+        }).encode()
+        self._enqueue(TAG_RPC_CTL, body, urgent=True)
+
+    def _handle_ctl(self, payload) -> None:
+        try:
+            msg = json.loads(bytes(payload))
+        except ValueError as e:
+            raise FabricError(
+                f"rpc control frame from {self.peer or 'peer'} undecodable"
+            ) from e
+        op = msg.get("op")
+        if op == "offer":
+            if not self.ep.shm_lane or self._shm is not None:
+                self._enqueue(TAG_RPC_CTL, b'{"op":"nak"}', urgent=True)
+                return
+            try:
+                lane = _RpcShmLane.attach(
+                    msg["name"], int(msg["slots"]), int(msg["slot_bytes"]),
+                    peer_bell=msg.get("bell"))
+            except Exception:
+                self._enqueue(TAG_RPC_CTL, b'{"op":"nak"}', urgent=True)
+                return
+            self._shm = lane
+            lane.start_reader(self)
+            lane.tx_ready = True
+            self._enqueue(TAG_RPC_CTL, json.dumps(
+                {"op": "ack", "bell": lane.my_bell_path}).encode(),
+                urgent=True)
+        elif op == "ack":
+            lane = self._shm
+            if lane is not None:
+                if msg.get("bell"):
+                    lane.peer_bell = msg["bell"]
+                lane.tx_ready = True
+        elif op == "nak":
+            lane, self._shm = self._shm, None
+            if lane is not None:
+                lane.close()
+        # unknown ops are ignored: forward-compatible control plane
+
     def _fail(self, err: BaseException, cause=None) -> None:
         if cause is not None and err.__cause__ is None:
             err.__cause__ = cause
@@ -1595,6 +2106,7 @@ class RpcLink:
                 self.err = err
             pending = list(self._pending.values())
             self._pending.clear()
+            lane, self._shm = self._shm, None
         self.ep._unregister(self)
         # shutdown BEFORE close: a reader blocked in recv holds the
         # kernel file reference, so a bare close() would neither wake it
@@ -1607,9 +2119,14 @@ class RpcLink:
             self.sock.close()
         except OSError:
             pass
+        if lane is not None:
+            lane.close()
+        # sticky-failure contract: EVERY pending waiter — loop-bridged or
+        # inline/sync — observes the same typed error, exactly once (the
+        # pending table pop above makes a late response frame a no-op)
         for cb in pending:
             try:
-                cb(err)
+                cb(err, "tcp")
             except Exception:  # pragma: no cover - reply sinks must not throw
                 pass
 
@@ -1628,7 +2145,15 @@ class RpcEndpoint:
     implement on its own asyncio loop, now on the fabric core's
     persistent links.  ``handler(link, rid, payload)`` runs on reader
     threads for inbound requests; answer via ``link.respond(rid, body)``
-    from any thread."""
+    from any thread.
+
+    r23 latency tiers: ``spin_us`` is the reader spin-then-park window
+    (0 disables; default from ``RINGPOP_TPU_RPC_SPIN_US``); ``flush_us``
+    > 0 coalesces small frames — they wait up to the window for company
+    and flush as one ``sendmsg`` (``urgent`` sends and ``link.flush()``
+    cut the window); ``shm_lane=True`` negotiates a same-host shm frame
+    ring per dialed loopback link (``RINGPOP_TPU_RPC_SHM=1`` flips the
+    default), TCP staying the negotiation and fallback path."""
 
     def __init__(
         self,
@@ -1637,11 +2162,25 @@ class RpcEndpoint:
         ledger: Optional[TransportLedger] = None,
         ledger_class: str = "rpc",
         max_body_bytes: int = MAX_RPC_BODY_BYTES,
+        spin_us: Optional[float] = None,
+        flush_us: float = 0.0,
+        shm_lane: Optional[bool] = None,
+        shm_slots: int = 32,
+        shm_slot_bytes: int = 16384,
     ):
         self.handler = handler
         self.ledger = ledger if ledger is not None else TransportLedger()
         self.ledger_class = ledger_class
         self.max_body_bytes = max_body_bytes
+        self.spin_us = (
+            _default_spin_us() if spin_us is None else float(spin_us))
+        self.flush_us = float(flush_us)
+        if shm_lane is None:
+            shm_lane = os.environ.get("RINGPOP_TPU_RPC_SHM", "") in (
+                "1", "true", "yes")
+        self.shm_lane = bool(shm_lane)
+        self.shm_slots = int(shm_slots)
+        self.shm_slot_bytes = int(shm_slot_bytes)
         self.hostport = ""
         self._srv: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -1713,9 +2252,17 @@ class RpcEndpoint:
         link = RpcLink(self, s, peer)
         with self._lock:
             cur = self._links.get(peer)
-            if cur is None or cur.err is not None:
+            won = cur is None or cur.err is not None
+            if won:
                 self._links[peer] = link
-                return link
+        if won:
+            # same-host shm lane (r23): offer on loopback dials only —
+            # attach succeeding at the acceptor IS the same-host proof,
+            # but a loopback gate keeps cross-host dials from paying a
+            # wasted segment + round trip
+            if self.shm_lane and host in ("127.0.0.1", "::1", "localhost"):
+                link._offer_shm()
+            return link
         # lost a dial race; keep the established one.  close() OUTSIDE
         # the lock — it unregisters, which takes the lock again
         link.close()

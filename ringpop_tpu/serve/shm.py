@@ -239,14 +239,14 @@ class ShmServer:
                 # — this is the B=1 latency path
                 s, req, count, n = picked[0]
                 self._inflight.add(s)
-                self.ledger.add("shm", bytes_recv=count * 4, frames_recv=1)
+                self.ledger.add("shm", lane="shm", bytes_recv=count * 4, frames_recv=1)
                 svc.dispatch_direct(
                     self._slot_view(s, count), n, self._responder(s, req)
                 )
                 return found
             for s, req, count, n in picked:
                 self._inflight.add(s)
-                self.ledger.add("shm", bytes_recv=count * 4, frames_recv=1)
+                self.ledger.add("shm", lane="shm", bytes_recv=count * 4, frames_recv=1)
                 # r21 zero-copy: hand the collector a READ-ONLY VIEW of
                 # the slot — no copy out of the segment.  Lifetime is
                 # explicit: the slot stays in ``_inflight`` (and
@@ -291,7 +291,7 @@ class ShmServer:
                 ring._owners[slot][: flat.shape[0]] = flat
                 hdr[_GEN] = np.uint32(gen)
                 hdr[_STATUS] = STATUS_OK
-                self.ledger.add("shm", bytes_sent=int(flat.shape[0]) * 4,
+                self.ledger.add("shm", lane="shm", bytes_sent=int(flat.shape[0]) * 4,
                                 frames_sent=1)
             self._inflight.discard(slot)
             hdr[_RESP_SEQ] = np.uint32(req)

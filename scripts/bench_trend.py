@@ -88,13 +88,15 @@ def fresh_quick() -> dict:
     CPU containers; the min measures the channel's floor, which is what
     actually trends when the RPC plane grows a thread hop.  The
     committed BENCH_r22 row was taken the same way (best-of-3); the
-    fresh side takes 5 for extra margin against a one-sided gate."""
+    fresh side takes 5 for extra margin against a one-sided gate.  r23:
+    the probe returns ``{"p50_us", "p99_us"}`` (trimmed median-of-
+    batches p50) — the tracked row is the p50."""
     import bench
 
     return {
         "metric": "transport_rtt_quick",
         "transport_rtt_us": round(
-            min(bench._transport_rtt_us(400) for _ in range(5)), 1
+            min(bench._transport_rtt_us(400)["p50_us"] for _ in range(5)), 1
         ),
     }
 

@@ -15,13 +15,20 @@ refactor's contracts:
   migration mapping), and the ledger total is the sum of its classes;
 * zero-copy: ``copy_bytes`` reads 0 for the shm→dispatch path (and
   everywhere else — no transport in the plane takes an intermediate
-  copy it has to confess).
+  copy it has to confess);
+* r23 latency tiers: every lane combination (tcp / tcp+coalescing /
+  shm / shm+coalescing) echoes bit-identical bodies, the new lane
+  counters are LIVE (``inline_completions`` on the sync echo leg,
+  shm-lane frames on a same-host pair, ``coalesced_frames`` under
+  threaded burst load), and per-lane ledger sums reconcile exactly
+  with the per-class totals.
 """
 
 import hashlib
 import os
 import sys
 import threading
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -208,6 +215,90 @@ def main() -> int:
         if st["total"][k] != sum(row[k] for row in classes.values()):
             failures.append(f"ledger total[{k}] != sum of class rows")
 
+    # -- r23 latency tiers: lane combinations, live counters, lane sums ---
+    # each combination gets its OWN ledger (the legacy-vs-ledger equality
+    # above is pinned on default channels; sync legs + shm control frames
+    # are the r23 additions it deliberately excludes)
+    blob = np.random.default_rng(23).integers(
+        0, 256, size=2048, dtype=np.uint8
+    ).tobytes()
+    payload = {"blob": blob, "k": 23}
+    lane_digests: dict[str, str] = {}
+    lane_stats: dict[str, dict] = {}
+
+    def tier_leg(tag: str, burst: bool = False, **kw) -> None:
+        led = TransportLedger()
+        server = TCPChannel(app=f"tier-{tag}", codec="msgpack",
+                            ledger=led, **kw)
+        server.register("tier", "/echo", lambda b, h: b)
+        client = TCPChannel(app=f"tier-{tag}-cli", codec="msgpack",
+                            ledger=led, **kw)
+        try:
+            addr = server.listen_sync("127.0.0.1", 0)
+            if kw.get("shm_lane"):
+                # negotiation is async: echo until a frame rides the ring
+                deadline = time.time() + 10
+                while not (led.stats()["classes"].get("rpc", {})
+                           .get("lanes", {}).get("shm", {})
+                           .get("frames_sent", 0)):
+                    if time.time() > deadline:
+                        failures.append(f"tier {tag}: shm lane never engaged")
+                        return
+                    client.call_sync(addr, "tier", "/echo", {"w": 1},
+                                     timeout=10)
+            if burst:
+                def caller():
+                    for _ in range(20):
+                        r = client.call_sync(addr, "tier", "/echo", payload,
+                                             timeout=10)
+                        if r["blob"] != blob:
+                            failures.append(f"tier {tag}: burst echo corrupt")
+                ts = [threading.Thread(target=caller) for _ in range(6)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(60)
+            res = client.call_sync(addr, "tier", "/echo", payload, timeout=10)
+            lane_digests[tag] = hashlib.sha256(res["blob"]).hexdigest()
+            lane_stats[tag] = led.stats()
+        finally:
+            client.close_sync()
+            server.close_sync()
+
+    tier_leg("tcp")
+    tier_leg("tcp+coalesce", burst=True, flush_us=1500.0)
+    tier_leg("shm", shm_lane=True)
+    tier_leg("shm+coalesce", burst=True, shm_lane=True, flush_us=1500.0)
+
+    want = hashlib.sha256(blob).hexdigest()
+    for tag, dig in lane_digests.items():
+        if dig != want:
+            failures.append(f"tier {tag}: echoed bytes diverged (digest)")
+    if len(set(lane_digests.values())) > 1:
+        failures.append("lane combinations answered non-identical bytes")
+
+    def rpc_row(tag):
+        return lane_stats.get(tag, {}).get("classes", {}).get("rpc", {})
+
+    if rpc_row("tcp").get("inline_completions", 0) < 1:
+        failures.append("inline_completions == 0 on the sync echo leg")
+    if (rpc_row("shm").get("lanes", {}).get("shm", {})
+            .get("frames_sent", 0)) < 1:
+        failures.append("shm-lane frames == 0 on a same-host pair")
+    if rpc_row("tcp+coalesce").get("coalesced_frames", 0) < 1:
+        failures.append("coalesced_frames == 0 under burst load")
+    for tag, stl in lane_stats.items():
+        for klass, row in stl["classes"].items():
+            for field in TransportLedger.FIELDS:
+                if row[field] != sum(
+                    r[field] for r in row["lanes"].values()
+                ):
+                    failures.append(
+                        f"tier {tag}: class {klass!r} {field} != lane sum"
+                    )
+        if stl["copy_bytes"] != 0:
+            failures.append(f"tier {tag}: copy_bytes {stl['copy_bytes']} != 0")
+
     if failures:
         print("transport-smoke FAILED:")
         for f in failures:
@@ -218,7 +309,12 @@ def main() -> int:
         f"oracle; ledger classes {sorted(classes)} reconcile with legacy "
         f"counters; copy_bytes=0 "
         f"(total {st['total']['bytes_sent']}B sent / "
-        f"{st['total']['bytes_recv']}B recv)"
+        f"{st['total']['bytes_recv']}B recv); "
+        f"r23 tiers: {sorted(lane_digests)} bit-identical, "
+        f"inline_completions={rpc_row('tcp')['inline_completions']}, "
+        f"shm_frames={rpc_row('shm')['lanes']['shm']['frames_sent']}, "
+        f"coalesced={rpc_row('tcp+coalesce')['coalesced_frames']}; "
+        "per-lane sums reconcile"
     )
     return 0
 
