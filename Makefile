@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke transport-smoke gameday-smoke bench-trend bench-trend-report
+.PHONY: all test test-fast test-slow test-integration test-accel bench simbench native lint lint-json clean profile-mesh telemetry-smoke chaos-smoke aot-smoke mc-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke topo-smoke fleet-smoke live-smoke trace-smoke transport-smoke gameday-smoke race-smoke bench-trend bench-trend-report
 
 all: native test
 
@@ -20,7 +20,7 @@ all: native test
 # program invariants; ANALYSIS.md) — the static gate in front of the
 # dynamic certificates, mirroring the reference Makefile's test/lint
 # split.
-test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke transport-smoke gameday-smoke bench-trend-report lint
+test: profile-mesh telemetry-smoke chaos-smoke topo-smoke mc-smoke fleet-smoke aot-smoke serve-smoke serve-fanin-smoke multihost-smoke dcn-smoke live-smoke trace-smoke transport-smoke gameday-smoke race-smoke bench-trend-report lint
 	$(PY) -m pytest tests/ -q --durations=15
 
 # live-operations-plane gate (r20, obs/): a P=2 in-process fleet sweep
@@ -70,6 +70,15 @@ bench-trend-report:
 # transport's legacy counters, and copy_bytes reads 0.
 transport-smoke:
 	$(PY) scripts/transport_smoke.py
+
+# the race gate (analysis plane 3, dynamic half — the rebuild's
+# test-race): transport/serve/dcn/gameday smokes rerun under
+# racecheck's instrumented locks + seeded schedule perturbation (3
+# seeds), failing on smoke breakage or a dynamic lock-order cycle;
+# plus the non-vacuity pair — the r22 count-after-respond mutant is
+# deliberately reintroduced and MUST be caught (exit 3 if missed).
+race-smoke:
+	$(PY) scripts/race_harness.py
 
 # tiny-config telemetry gate: lifecycle run with telemetry on must emit a
 # parseable JSONL journal AND end digest-equal to a telemetry-off run;

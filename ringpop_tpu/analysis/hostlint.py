@@ -1,0 +1,977 @@
+"""Plane 3 of jaxlint: host-concurrency AST rules (the racelint plane).
+
+Since r13 the host layer has grown to ~8.3k LoC of lock-and-thread code
+(persistent sender/reader threads, sticky link failure, inline-completion
+futures, shm seq-word rings) with zero static coverage — the repo paid
+for that gap twice (the r22 ``TCPChannel._handle`` count-after-respond
+flake, the r21 honest-cost rework).  This plane is the rebuild's analog
+of the reference's ``make test-race`` (ringpop-go runs its whole suite
+under Go's race detector): source-level hazards caught before a single
+thread runs, cross-checked dynamically by ``analysis/racecheck.py``
+(``make race-smoke``).
+
+Rules (catalog with the full story: ANALYSIS.md):
+
+* **RPH301 lock-order-inversion** — the per-module lock-acquisition
+  graph (``with self._lock`` nesting + blocking ``.acquire()`` spans,
+  closed over same-module calls) contains a cycle.  Two threads taking
+  the same two locks in opposite orders is the canonical deadlock; the
+  graph makes the order a checkable invariant instead of a convention.
+* **RPH302 blocking-under-lock** — a blocking call (socket
+  ``recv``/``sendmsg``/``connect``, ``Condition.wait``, ``Event.wait``,
+  ``future.result()``, ``Thread.join``, ``time.sleep``, jax dispatch)
+  while a lock is held.  A blocked holder extends its critical section
+  by an unbounded wait — every other thread needing the lock stalls
+  behind a peer's socket.  ``Condition.wait`` on the condition whose
+  OWN lock is held is the one legal shape (wait releases it) and is
+  allowlisted.  Deliberate designs (e.g. a lock whose purpose IS to
+  serialize a wire write) are waivable with justification.
+* **RPH303 thread-leak** — a non-daemon ``threading.Thread`` whose
+  creating scope never joins anything.  A leaked non-daemon thread
+  keeps the process alive past main-exit; the blessed shapes are
+  ``daemon=True`` (+ bounded join on the shutdown path) or an explicit
+  join in the creating scope.
+* **RPH304 unlocked-shared-attr** — an attribute written from ≥ 2
+  distinct thread roots (``threading.Thread(target=...)``,
+  ``submit(...)``, loop-callback registrations) where at least one
+  write site is outside any lock region.  Heuristic by design —
+  single-writer hand-offs and seq-word protocols are legal — so
+  findings are waivable via waivers.toml with mandatory justification.
+* **RPH305 journal-schema** — a ``{"kind": "<k>", ...}`` record emit
+  site whose literal keys are not documented in OBSERVABILITY.md's
+  "Journal record schema index" table (or whose kind is absent from it
+  entirely).  The r22 flake class: docs and emitters drifting silently.
+
+Thread-root closure: the same per-module machinery as RPA103's jit-root
+closure (``astlint._Module``), but rooted at thread-spawn sites instead
+of ``jax.jit`` — a function is "on a thread root" when it is the target
+of ``threading.Thread(target=...)`` / ``executor.submit(...)`` /
+``loop.call_soon*``/``run_in_executor``/``add_reader`` or reachable
+from one through same-module calls (``self.m()`` resolves through the
+enclosing class, bare names through the module function table).
+
+File-local by design, like plane 1: cross-module lock graphs are the
+dynamic harness's job (``racecheck`` records the real process-wide
+order).  Fixture corpus convention matches plane 1: a file under
+``tests/analysis_fixtures/<slug>/`` is linted by exactly the rule whose
+slug names its directory.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ringpop_tpu.analysis.findings import Finding
+
+FIXTURE_DIR = "analysis_fixtures"
+
+RULES = {
+    "RPH301": "lock-order-inversion",
+    "RPH302": "blocking-under-lock",
+    "RPH303": "thread-leak",
+    "RPH304": "unlocked-shared-attr",
+    "RPH305": "journal-schema",
+}
+
+# lock-constructing callables (resolved through the import-alias map)
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+}
+
+# thread-spawn / callback-registration attribute names whose callable
+# argument becomes a thread root (RPH304's closure roots)
+_ROOT_REGISTRARS = {
+    "submit", "call_soon", "call_soon_threadsafe", "call_later",
+    "call_at", "run_in_executor", "add_reader", "add_writer",
+    "add_done_callback",
+}
+# registrars whose callbacks all run serialized on ONE event-loop
+# thread: they share a single root label (two loop callbacks never
+# preempt each other, so they are not "distinct threads" for RPH304)
+_LOOP_SERIALIZED = {
+    "call_soon", "call_soon_threadsafe", "call_later", "call_at",
+    "add_reader", "add_writer", "add_done_callback",
+}
+
+# method names that block the calling thread (RPH302).  Socket family +
+# synchronization waits + future/thread joins.  ``acquire`` is handled
+# separately (it IS the lock-order edge, RPH301's subject).
+_BLOCKING_METHODS = {
+    "recv", "recv_into", "recvmsg", "recvmsg_into", "recvfrom",
+    "sendall", "sendmsg", "connect", "accept",
+    "wait", "wait_for", "result", "block_until_ready",
+}
+# ``.join()`` blocks only on thread-like receivers — ``", ".join(parts)``
+# is the most common method call in Python; gate on the receiver's name
+_THREADISH = re.compile(r"(thread|sender|reader|writer|worker|proc)", re.I)
+# dotted-name calls that block (through the alias map)
+_BLOCKING_DOTTED = {
+    "time.sleep", "jax.device_get", "jax.device_put",
+    "jax.block_until_ready", "select.select",
+}
+# receivers whose ``.send`` is a socket write.  Bare ``.send`` is too
+# generic to flag (generators, queues); the repo's sockets live on
+# attributes matching this pattern.
+_SOCKISH_ATTRS = re.compile(r"(^|_)(sock|socket|conn)\b")
+
+_SCHEMA_HEADING = "journal record schema index"
+
+
+def _fixture_slug(relpath: str) -> str | None:
+    parts = relpath.replace(os.sep, "/").split("/")
+    if FIXTURE_DIR in parts:
+        i = parts.index(FIXTURE_DIR)
+        if len(parts) > i + 2:
+            return parts[i + 1]
+    return None
+
+
+def _rule_applies(rule: str, relpath: str) -> bool:
+    slug = _fixture_slug(relpath)
+    if slug is not None:
+        return RULES[rule] == slug
+    if rule == "RPH305":
+        # journal records are emitted by the package only; scripts print
+        return relpath.startswith("ringpop_tpu/")
+    return relpath.startswith(("ringpop_tpu/", "scripts/"))
+
+
+# -- OBSERVABILITY.md schema index (RPH305) ----------------------------------
+
+
+def load_schema_index(md_path: str) -> dict[str, set[str]] | None:
+    """Parse the "Journal record schema index" table out of
+    OBSERVABILITY.md: ``| `kind` | `key`, `key`, ... |`` rows.  Returns
+    {kind: allowed key set} or None when the doc/section is missing
+    (RPH305 then reports nothing — explicit paths outside the repo)."""
+    try:
+        text = open(md_path).read()
+    except OSError:
+        return None
+    lines = text.splitlines()
+    idx: dict[str, set[str]] = {}
+    in_section = False
+    for line in lines:
+        if line.startswith("#"):
+            in_section = _SCHEMA_HEADING in line.lower()
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 2 or set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        kind = cells[0].strip("`")
+        if kind == "kind":  # the header row
+            continue
+        keys = {k for k in re.findall(r"`([^`]+)`", cells[1])}
+        idx[kind] = keys | {"kind"}
+    return idx or None
+
+
+# -- the per-module model -----------------------------------------------------
+
+
+class _HostModule:
+    """One parsed file: alias map, class/function tables, the lock
+    attribute table, and the thread-root closure."""
+
+    def __init__(self, tree: ast.Module, relpath: str):
+        self.tree = tree
+        self.relpath = relpath
+        self.aliases: dict[str, str] = {}
+        # (class_name or None, simple name) -> function node
+        self.functions: dict[tuple[str | None, str], ast.AST] = {}
+        self.qualname_of: dict[ast.AST, str] = {}
+        self.class_of: dict[ast.AST, str | None] = {}
+        # class -> {attr: lineno} for self.attr = threading.Lock()/...
+        self.class_locks: dict[str, dict[str, int]] = {}
+        # module-level lock names
+        self.module_locks: dict[str, int] = {}
+        self._collect()
+        # lock attr name -> owning classes (for self.<obj>.<attr> guesses)
+        self.lock_attr_owners: dict[str, list[str]] = {}
+        for cls, attrs in self.class_locks.items():
+            for a in attrs:
+                self.lock_attr_owners.setdefault(a, []).append(cls)
+        self.thread_roots = self._thread_roots()
+        self.root_reach = self._close_roots(self.thread_roots)
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+        def visit(node, prefix, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}.{child.name}" if prefix else child.name
+                    self.functions[(cls, child.name)] = child
+                    self.qualname_of[child] = qn
+                    self.class_of[child] = cls
+                    visit(child, qn, cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}.{child.name}" if prefix else child.name,
+                          child.name)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(self.tree, "", None)
+
+        # lock construction sites
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            ctor = self.resolve(node.value.func)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    cls = self._enclosing_class(node.lineno)
+                    if cls is not None:
+                        self.class_locks.setdefault(cls, {})[tgt.attr] = node.lineno
+                elif isinstance(tgt, ast.Name):
+                    self.module_locks[tgt.id] = node.lineno
+
+    def _enclosing_class(self, lineno: int) -> str | None:
+        best, best_span = None, None
+        for (cls, _), node in self.functions.items():
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = cls, span
+        return best
+
+    def resolve(self, node) -> str | None:
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def enclosing(self, lineno: int) -> str:
+        best, best_span = "<module>", None
+        for node, qn in ((n, self.qualname_of[n]) for n in self.qualname_of):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = qn, span
+        return best
+
+    # -- lock expression resolution ------------------------------------------
+
+    def lock_node(self, expr, cls: str | None) -> str | None:
+        """The graph-node name of a lock expression, or None when the
+        expression is not a known lock.  ``self._x`` resolves through the
+        enclosing class's lock table; a deeper receiver (``self.ep._x``)
+        resolves when exactly one class in the module declares a lock
+        named ``_x`` (else an anonymous per-attr node that still counts
+        as held for RPH302 but never aggregates into RPH301 edges)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return f"<module>.{expr.id}"
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and cls is not None:
+            if attr in self.class_locks.get(cls, {}):
+                return f"{cls}.{attr}"
+            # self._x in a subclass-ish shape: unique owner in the module
+            owners = self.lock_attr_owners.get(attr, [])
+            if len(owners) == 1:
+                return f"{owners[0]}.{attr}"
+            return None
+        owners = self.lock_attr_owners.get(attr, [])
+        if len(owners) == 1:
+            return f"{owners[0]}.{attr}"
+        if owners:
+            # ambiguous owner: held (RPH302) but edge-inert (RPH301)
+            return f"?anon:{attr}:{getattr(expr, 'lineno', 0)}"
+        return None
+
+    # -- thread roots and their closure --------------------------------------
+
+    def _callable_key(self, expr) -> tuple[str | None, str] | None:
+        """(class, simple-name) key of a callable expression when it
+        names a same-module function: bare name, ``self.m``, or a
+        ``functools.partial(f, ...)`` wrapper."""
+        if isinstance(expr, ast.Name):
+            for (cls, name) in self.functions:
+                if name == expr.id and cls is None:
+                    return (None, expr.id)
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            cls = self._enclosing_class(expr.lineno)
+            if cls is not None and (cls, expr.attr) in self.functions:
+                return (cls, expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            fn = self.resolve(expr.func)
+            if fn in ("functools.partial", "partial") and expr.args:
+                return self._callable_key(expr.args[0])
+        return None
+
+    def _thread_roots(self) -> dict[tuple[str | None, str], set[str]]:
+        """{function key: root labels} for every thread-spawn /
+        callback-registration site in the module.  Loop-serialized
+        registrations (``call_soon``/``add_reader``/...) all share ONE
+        label — their callbacks run serialized on the event-loop thread,
+        so they are never concurrent with each other."""
+        roots: dict[tuple[str | None, str], set[str]] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self.resolve(node.func)
+            cand, serialized = None, False
+            if target == "threading.Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        cand = kw.value
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ROOT_REGISTRARS
+            ):
+                # submit(f, ...) / call_soon(f, ...) / add_reader(fd, f)
+                # / run_in_executor(executor_or_None, f, ...)
+                serialized = node.func.attr in _LOOP_SERIALIZED
+                args = list(node.args)
+                if node.func.attr in ("add_reader", "add_writer"):
+                    args = args[1:]
+                elif node.func.attr == "run_in_executor":
+                    args = args[1:]
+                if args:
+                    cand = args[0]
+            if cand is None:
+                continue
+            key = self._callable_key(cand)
+            if key is not None:
+                if serialized:
+                    label = "event-loop"
+                else:
+                    name = f"{key[0]}.{key[1]}" if key[0] else key[1]
+                    label = f"thread:{name}@{node.lineno}"
+                roots.setdefault(key, set()).add(label)
+        return roots
+
+    def _call_keys(self, fn_node, cls: str | None, include_refs: bool = True):
+        """Same-module function keys this function's body calls.  With
+        ``include_refs`` (the thread-root closure), bare references to
+        module functions count too — a callback handed onward still runs
+        on the root's thread; the acquire/blocking fixpoints use actual
+        calls only."""
+        out = set()
+        for sub in ast.walk(fn_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            key = None
+            if isinstance(sub.func, ast.Name):
+                if (None, sub.func.id) in self.functions:
+                    key = (None, sub.func.id)
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "self"
+                and cls is not None
+                and (cls, sub.func.attr) in self.functions
+            ):
+                key = (cls, sub.func.attr)
+            if key is not None:
+                out.add(key)
+        if include_refs:
+            # bare references (callbacks handed onward) count as reachable
+            for sub in ast.walk(fn_node):
+                if isinstance(sub, ast.Name) and (None, sub.id) in self.functions:
+                    out.add((None, sub.id))
+        return out
+
+    def _close_roots(self, roots) -> dict[tuple[str | None, str], set[str]]:
+        """{function key: set of root labels reaching it} — the
+        thread-root analog of astlint's jit closure."""
+        reach: dict[tuple[str | None, str], set[str]] = {}
+        calls: dict[tuple[str | None, str], set] = {}
+        for key, node in self.functions.items():
+            calls[key] = self._call_keys(node, key[0])
+        for key, labels in roots.items():
+            frontier = [key]
+            seen = set()
+            while frontier:
+                k = frontier.pop()
+                if k in seen:
+                    continue
+                seen.add(k)
+                reach.setdefault(k, set()).update(labels)
+                frontier.extend(calls.get(k, ()))
+        return reach
+
+
+# -- the lock-region walker ---------------------------------------------------
+
+
+class _RegionWalker:
+    """Walks one function's statements tracking held locks; feeds the
+    acquisition graph (RPH301), blocking-call findings (RPH302), and the
+    per-write lock context (RPH304)."""
+
+    def __init__(self, mod: _HostModule, cls: str | None):
+        self.mod = mod
+        self.cls = cls
+        # (held_node, acquired_node) -> first site lineno
+        self.edges: dict[tuple[str, str], int] = {}
+        # lock nodes this function acquires anywhere (for closure edges)
+        self.acquired: set[str] = set()
+        # (lineno, call_repr, held_nodes, receiver_node) blocking sites
+        self.blocking: list[tuple[int, str, tuple[str, ...], str | None]] = []
+        # (attr_target_repr, lineno, under_lock)
+        self.writes: list[tuple[str, int, bool]] = []
+        # same-module callee keys invoked while holding locks:
+        # (callee_key, held_nodes, lineno)
+        self.held_calls: list[tuple[tuple, tuple[str, ...], int]] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _acquire(self, node_name: str, held: list[str], lineno: int) -> None:
+        for h in held:
+            if h != node_name and not h.startswith("?anon:") \
+                    and not node_name.startswith("?anon:"):
+                self.edges.setdefault((h, node_name), lineno)
+        self.acquired.add(node_name)
+
+    def _is_nonblocking_acquire(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+                return kw.value.value is False
+        if call.args and isinstance(call.args[0], ast.Constant):
+            return call.args[0].value is False
+        return False
+
+    def _lock_of_call_recv(self, call: ast.Call) -> str | None:
+        if isinstance(call.func, ast.Attribute):
+            return self.mod.lock_node(call.func.value, self.cls)
+        return None
+
+    # -- expression scan (calls + writes inside one statement) ---------------
+
+    def _scan_expr(self, expr, held: list[str]) -> None:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            # blocking acquire of another lock mid-expression
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+                and not self._is_nonblocking_acquire(sub)
+            ):
+                ln = self._lock_of_call_recv(sub)
+                if ln is not None and held:
+                    self._acquire(ln, held, sub.lineno)
+                continue
+            target = self.mod.resolve(sub.func)
+            blocked, recv_node = None, None
+            if target in _BLOCKING_DOTTED:
+                blocked = target
+            elif isinstance(sub.func, ast.Attribute):
+                attr = sub.func.attr
+                if attr in _BLOCKING_METHODS:
+                    blocked = f".{attr}()"
+                    recv_node = self.mod.lock_node(sub.func.value, self.cls)
+                elif attr == "join":
+                    recv_txt = ast.unparse(sub.func.value) if hasattr(
+                        ast, "unparse") else ""
+                    if _THREADISH.search(recv_txt.split(".")[-1]):
+                        blocked = ".join()"
+                elif attr in ("send", "sendto"):
+                    recv_txt = ast.unparse(sub.func.value) if hasattr(
+                        ast, "unparse") else ""
+                    if _SOCKISH_ATTRS.search(recv_txt.split(".")[-1]):
+                        blocked = f".{attr}()"
+            if blocked is not None:
+                self.blocking.append(
+                    (sub.lineno, blocked, tuple(held), recv_node)
+                )
+
+    def _scan_writes(self, stmt, held: list[str]) -> None:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            tgts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for t in tgts:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    self.writes.append((t.attr, t.lineno, bool(held)))
+
+    def _scan_calls_out(self, stmt, held: list[str]) -> None:
+        if not held:
+            return
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            key = None
+            if isinstance(sub.func, ast.Name):
+                if (None, sub.func.id) in self.mod.functions:
+                    key = (None, sub.func.id)
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "self"
+                and self.cls is not None
+                and (self.cls, sub.func.attr) in self.mod.functions
+            ):
+                key = (self.cls, sub.func.attr)
+            if key is not None:
+                self.held_calls.append((key, tuple(held), sub.lineno))
+
+    # -- statement walk ------------------------------------------------------
+
+    def walk(self, stmts, held: list[str]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested defs are their own functions
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                entered = []
+                for item in stmt.items:
+                    ln = None
+                    ce = item.context_expr
+                    ln = self.mod.lock_node(ce, self.cls)
+                    if ln is None and isinstance(ce, ast.Call):
+                        # with lock.acquire_timeout()-style helpers: skip
+                        ln = None
+                    if ln is not None:
+                        self._acquire(ln, held, stmt.lineno)
+                        entered.append(ln)
+                        held.append(ln)
+                    elif item.context_expr is not None:
+                        self._scan_expr(item.context_expr, held)
+                self.walk(stmt.body, held)
+                for ln in entered:
+                    held.remove(ln)
+                continue
+            if isinstance(stmt, ast.If):
+                # `if lock.acquire(blocking=False):` / `if X and
+                # lock.acquire(False):` — the body runs lock-held
+                acq = self._cond_acquires(stmt.test)
+                self._scan_expr(stmt.test, held)
+                self._scan_writes(stmt, held)
+                self.walk(stmt.body, held + acq)
+                self.walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter, held)
+                self.walk(stmt.body, held)
+                self.walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, held)
+                self.walk(stmt.body, held)
+                self.walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Try):
+                self.walk(stmt.body, held)
+                for h in stmt.handlers:
+                    self.walk(h.body, held)
+                self.walk(stmt.orelse, held)
+                self.walk(stmt.finalbody, held)
+                continue
+            # bare acquire/release statements (the try/finally idiom)
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute):
+                    recv = self._lock_of_call_recv(call)
+                    if call.func.attr == "acquire" and recv is not None:
+                        if not self._is_nonblocking_acquire(call):
+                            self._acquire(recv, held, call.lineno)
+                        held.append(recv)
+                        continue
+                    if call.func.attr == "release" and recv is not None:
+                        if recv in held:
+                            held.remove(recv)
+                        continue
+            self._scan_expr(stmt, held)
+            self._scan_writes(stmt, held)
+            self._scan_calls_out(stmt, held)
+
+    def _cond_acquires(self, test) -> list[str]:
+        out = []
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+            ):
+                ln = self._lock_of_call_recv(sub)
+                if ln is not None:
+                    out.append(ln)
+        return out
+
+
+# -- graph utilities ----------------------------------------------------------
+
+
+def _find_cycles(edges: dict[tuple[str, str], int]) -> list[list[str]]:
+    """Elementary cycles in the lock graph (DFS; the graphs are tiny).
+    Each cycle is reported once, rotated to its lexicographic minimum."""
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    seen: set[tuple[str, ...]] = set()
+    cycles: list[list[str]] = []
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                i = cyc.index(min(cyc))
+                key = tuple(cyc[i:] + cyc[:i])
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(list(key))
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes >= start: each cycle found from its
+                # smallest node exactly once
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return cycles
+
+
+# -- the linter ---------------------------------------------------------------
+
+
+def lint_source(
+    src: str,
+    relpath: str,
+    schema_index: dict[str, set[str]] | None = None,
+) -> list[Finding]:
+    """Lint one file's source with every applicable RPH rule.
+    ``schema_index`` is the OBSERVABILITY.md kind→keys table for RPH305
+    (None disables that rule — e.g. linting outside the repo)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [
+            Finding("RPH000", relpath, e.lineno or 0, "<module>",
+                    f"syntax error: {e.msg}")
+        ]
+    mod = _HostModule(tree, relpath)
+    findings: list[Finding] = []
+
+    def add(rule, lineno, msg):
+        findings.append(Finding(rule, relpath, lineno, mod.enclosing(lineno), msg))
+
+    # one walker per function; module-level statements get their own
+    walkers: dict[tuple[str | None, str], _RegionWalker] = {}
+    for key, node in mod.functions.items():
+        w = _RegionWalker(mod, key[0])
+        w.walk(node.body, [])
+        walkers[key] = w
+    top = _RegionWalker(mod, None)
+    top.walk(
+        [s for s in tree.body
+         if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))],
+        [],
+    )
+    walkers[(None, "<module>")] = top
+
+    # -- RPH301: per-module lock graph + same-module call closure ------------
+    if _rule_applies("RPH301", relpath) or _rule_applies("RPH302", relpath):
+        # transitive acquire-sets per function (fixpoint over held_calls
+        # and plain calls: callee acquisitions happen under the caller's
+        # held set)
+        acq: dict[tuple, set[str]] = {
+            k: set(w.acquired) for k, w in walkers.items()
+        }
+        calls_of: dict[tuple, set[tuple]] = {}
+        for key, node in mod.functions.items():
+            calls_of[key] = {
+                k for k in mod._call_keys(node, key[0], include_refs=False)
+                if k in walkers
+            }
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in calls_of.items():
+                for c in callees:
+                    new = acq[c] - acq[key]
+                    if new:
+                        acq[key] |= new
+                        changed = True
+
+        edges: dict[tuple[str, str], int] = {}
+        for w in walkers.values():
+            for e, ln in w.edges.items():
+                edges.setdefault(e, ln)
+            # closure edges: calling f() while holding H implies H ->
+            # every lock f (transitively) acquires
+            for callee, held, ln in w.held_calls:
+                for h in held:
+                    if h.startswith("?anon:"):
+                        continue
+                    for a in acq.get(callee, ()):
+                        if a != h and not a.startswith("?anon:"):
+                            edges.setdefault((h, a), ln)
+
+        if _rule_applies("RPH301", relpath):
+            for cyc in _find_cycles(edges):
+                lns = sorted(
+                    edges[(cyc[i], cyc[(i + 1) % len(cyc)])]
+                    for i in range(len(cyc))
+                    if (cyc[i], cyc[(i + 1) % len(cyc)]) in edges
+                )
+                add(
+                    "RPH301", lns[0] if lns else 1,
+                    "lock-order inversion: acquisition cycle "
+                    + " -> ".join(cyc + [cyc[0]])
+                    + f" (edge sites: {', '.join(map(str, lns))}) — two "
+                    "threads walking this cycle from different entries "
+                    "deadlock; impose one global order (document it at "
+                    "the lock's construction site) or collapse the locks",
+                )
+
+    # -- RPH302: blocking call while a lock is held --------------------------
+    if _rule_applies("RPH302", relpath):
+        for key, w in walkers.items():
+            for lineno, what, held, recv_node in w.blocking:
+                if not held:
+                    continue
+                if what == ".wait()" or what == ".wait_for()":
+                    # Condition.wait on its own (held) lock releases it —
+                    # the one legal blocking shape under a lock
+                    if recv_node is not None and recv_node in held:
+                        others = [h for h in held if h != recv_node]
+                        if not others:
+                            continue
+                        held = tuple(others)
+                if what == ".join()" and not any(
+                    not h.startswith("?anon:") for h in held
+                ):
+                    continue
+                add(
+                    "RPH302", lineno,
+                    f"blocking call {what} while holding "
+                    f"{', '.join(sorted(set(held)))} — the critical "
+                    "section now spans an unbounded wait; move the "
+                    "blocking call outside the lock (snapshot state "
+                    "under the lock, act after releasing), or waive "
+                    "with the design justification",
+                )
+
+        # interprocedural half: a same-module call made under a lock
+        # whose callee (transitively) blocks is the same hazard one
+        # frame removed — fabric's ``with self._send_lock:
+        # self._write_batch(...)`` shape, where the sendmsg lives in the
+        # callee.  One representative blocking chain per callee.
+        blocker_of: dict[tuple, str] = {}
+        for key in sorted(walkers, key=str):
+            w = walkers[key]
+            descs = set()
+            for _, what, held, recv_node in w.blocking:
+                if what in (".wait()", ".wait_for()") and recv_node is not None \
+                        and recv_node in held:
+                    # releases its own lock, but a CALLER's lock stays
+                    # held across the wait — still blocking one frame up
+                    descs.add(f"{what} [own-lock wait]")
+                else:
+                    descs.add(what)
+            if descs:
+                blocker_of[key] = sorted(descs)[0]
+        changed = True
+        while changed:
+            changed = False
+            for key in sorted(calls_of, key=str):
+                if key in blocker_of:
+                    continue
+                for c in sorted(calls_of[key], key=str):
+                    if c in blocker_of:
+                        blocker_of[key] = f"{c[1]}() -> {blocker_of[c]}"
+                        changed = True
+                        break
+        for key, w in walkers.items():
+            for callee, held, lineno in w.held_calls:
+                if callee not in blocker_of:
+                    continue
+                add(
+                    "RPH302", lineno,
+                    f"call to {callee[1]}() while holding "
+                    f"{', '.join(sorted(set(held)))} blocks "
+                    f"({blocker_of[callee]}) — the critical section "
+                    "spans the callee's unbounded wait; hoist the call "
+                    "out of the lock or waive with the design "
+                    "justification",
+                )
+
+    # -- RPH303: non-daemon thread with no join in scope ---------------------
+    if _rule_applies("RPH303", relpath):
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and mod.resolve(node.func) == "threading.Thread"
+            ):
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                    daemon = kw.value.value
+            if daemon is True:
+                continue
+            # find the enclosing function; a `.join(` anywhere in it (or
+            # in its class when the thread lands on self.<attr>) clears
+            scope_node = None
+            for fn_node in mod.qualname_of:
+                end = getattr(fn_node, "end_lineno", fn_node.lineno)
+                if fn_node.lineno <= node.lineno <= end:
+                    if scope_node is None or (
+                        end - fn_node.lineno
+                        < getattr(scope_node, "end_lineno", 0) - scope_node.lineno
+                    ):
+                        scope_node = fn_node
+            search_nodes = [scope_node] if scope_node is not None else [tree]
+            cls = mod._enclosing_class(node.lineno)
+            if cls is not None:
+                search_nodes += [
+                    f for (c, _), f in mod.functions.items() if c == cls
+                ]
+            joined = False
+            for sn in search_nodes:
+                for sub in ast.walk(sn):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "join"
+                    ):
+                        joined = True
+                        break
+                if joined:
+                    break
+            if not joined:
+                add(
+                    "RPH303", node.lineno,
+                    "non-daemon Thread never joined in its creating scope "
+                    "— leaks past main-exit and holds the process open; "
+                    "pass daemon=True (with a bounded join on the "
+                    "shutdown path) or join it where it was spawned",
+                )
+
+    # -- RPH304: attr written from >=2 thread roots, >=1 site unlocked -------
+    if _rule_applies("RPH304", relpath):
+        # attr -> {root labels} and the write sites
+        by_attr: dict[tuple[str | None, str], dict] = {}
+        for key, w in walkers.items():
+            roots = mod.root_reach.get(key, set())
+            if not roots:
+                continue
+            cls = key[0]
+            for attr, lineno, locked in w.writes:
+                ent = by_attr.setdefault((cls, attr), {"roots": set(), "sites": []})
+                ent["roots"] |= roots
+                ent["sites"].append((lineno, locked))
+        for (cls, attr), ent in sorted(by_attr.items(), key=lambda e: str(e[0])):
+            if len(ent["roots"]) < 2:
+                continue
+            unlocked = [ln for ln, locked in ent["sites"] if not locked]
+            if not unlocked:
+                continue
+            add(
+                "RPH304", min(unlocked),
+                f"attribute self.{attr} written from "
+                f"{len(ent['roots'])} distinct thread roots with an "
+                "unlocked write site — torn/stale reads under free-"
+                "running threads; guard every write with one lock, or "
+                "waive with the hand-off protocol that makes it safe",
+            )
+
+    # -- RPH305: journal record emit sites vs the schema index ---------------
+    if _rule_applies("RPH305", relpath) and schema_index:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            kind = None
+            literal_keys: list[str] = []
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    literal_keys.append(k.value)
+                    if k.value == "kind" and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        kind = v.value
+            if kind is None:
+                continue
+            if kind not in schema_index:
+                add(
+                    "RPH305", node.lineno,
+                    f'journal record kind "{kind}" is not documented in '
+                    "OBSERVABILITY.md's journal record schema index — "
+                    "add its row (kind + key set) so readers and "
+                    "emitters cannot drift",
+                )
+                continue
+            allowed = schema_index[kind]
+            extra = [k for k in literal_keys if k not in allowed]
+            if extra:
+                add(
+                    "RPH305", node.lineno,
+                    f'journal record kind "{kind}" emits undocumented '
+                    f"key(s) {sorted(extra)} — OBSERVABILITY.md's schema "
+                    "index doesn't list them (the r22 drift class); "
+                    "document the keys or drop them",
+                )
+
+    return findings
+
+
+def lint_paths(paths, repo_root: str) -> list[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)
+    with the plane-3 rules; the RPH305 schema index loads once from
+    ``<repo_root>/OBSERVABILITY.md``."""
+    schema = load_schema_index(os.path.join(repo_root, "OBSERVABILITY.md"))
+    findings: list[Finding] = []
+    files: list[str] = []
+    for p in paths:
+        ap = os.path.join(repo_root, p) if not os.path.isabs(p) else p
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        elif ap.endswith(".py"):
+            files.append(ap)
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, repo_root).replace(os.sep, "/")
+        try:
+            src = open(f).read()
+        except OSError as e:
+            findings.append(Finding("RPH000", rel, 0, "<module>", f"unreadable: {e}"))
+            continue
+        findings.extend(lint_source(src, rel, schema))
+    return findings

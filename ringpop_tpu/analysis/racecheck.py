@@ -1,0 +1,359 @@
+"""racecheck — the dynamic half of analysis plane 3 (``make race-smoke``).
+
+hostlint (RPH301/302) reasons about lock order and blocking-under-lock
+*statically*, one module at a time.  This module checks the same two
+invariants against REAL executions: it monkeypatches ``threading.Lock``
+/ ``RLock`` / ``Condition`` (and ``time.sleep``) with thin instrumented
+wrappers that record, process-wide:
+
+* the **dynamic lock-order graph** — an edge A→B whenever a thread
+  acquires the lock allocated at site B while holding the one allocated
+  at site A.  A cycle in this graph is a deadlock schedule some pair of
+  threads can realize — the dynamic cross-check of RPH301, and it sees
+  across modules where hostlint deliberately stops at file boundaries.
+* **held-while-blocking events** — ``Condition.wait`` or ``time.sleep``
+  entered while OTHER instrumented locks are held (the wait's own
+  condition lock is excluded: wait releases it) — RPH302's cross-check.
+
+Plus a **schedule-perturbation mode**: seeded, bounded random preemption
+(a sub-millisecond sleep) injected at instrumentation points — before
+lock acquisition and before condition waits — so the smokes rerun under
+adversarial interleavings instead of the cooperative schedules a lightly
+loaded box produces.  This is the rebuild's stand-in for Go's race
+detector runs in the reference repo (``make test-race``): same suite,
+hostile scheduler.  The decision stream is drawn from one seeded
+``random.Random`` under the recorder's own (uninstrumented) lock, so a
+seed names a reproducible perturbation sequence.
+
+``scripts/race_harness.py`` installs this around the transport / serve /
+dcn / gameday smokes and fails on dynamic cycles; its non-vacuity leg
+reintroduces the r22 count-after-respond mutant and MUST see it caught.
+
+Everything here is stdlib-only and jax-free.  Locks created BEFORE
+``install()`` are untouched (module-import-time locks in third-party
+code keep their exact stdlib behavior); wrappers orphaned by
+``uninstall()`` keep working — they own a private real lock.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import random
+import sys
+import threading
+import time
+import _thread
+
+from ringpop_tpu.analysis.hostlint import _find_cycles
+
+_ORIG_LOCK_ALLOC = _thread.allocate_lock
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_CONDITION = threading.Condition
+_ORIG_SLEEP = time.sleep
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SELF = os.path.abspath(__file__)
+
+
+def _call_site() -> str:
+    """`path:lineno` of the first frame outside racecheck + threading —
+    the lock's allocation site, which names its node in the graph (all
+    instances born at one site share a node, matching hostlint's
+    per-attribute granularity)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _SELF and not fn.endswith(("threading.py", "_threading_local.py")):
+            path = fn
+            if path.startswith(_REPO + os.sep):
+                path = os.path.relpath(path, _REPO).replace(os.sep, "/")
+            return f"{path}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class Recorder:
+    """Process-wide event sink.  Internals use REAL locks (allocated
+    before patching) — the recorder must never route through its own
+    instrumentation."""
+
+    def __init__(self, seed=None, perturb=False, p=0.02,
+                 sleep_range_us=(300, 3000)):
+        self.seed = seed
+        self.perturb = perturb
+        self.p = p
+        self.sleep_range_us = sleep_range_us
+        self._rng = random.Random(seed)
+        self._mx = _ORIG_LOCK_ALLOC()
+        self._tls = threading.local()  # .held: list[(site, lock_id)]
+        self.edges: dict[tuple[str, str], int] = {}
+        self.block_events: list[dict] = []
+        self.sites: dict[str, int] = {}  # site -> locks allocated there
+        self.perturb_count = 0
+        self.acquire_count = 0
+
+    # -- held-stack bookkeeping (called from the wrappers) -------------------
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def on_alloc(self, site: str) -> None:
+        with self._mx:
+            self.sites[site] = self.sites.get(site, 0) + 1
+
+    def maybe_perturb(self) -> None:
+        """One seeded preemption decision.  Drawn under the recorder
+        lock so the decision STREAM is a pure function of the seed; the
+        draw is cheap (two rng calls) and the sleep happens outside."""
+        if not self.perturb:
+            return
+        lo, hi = self.sleep_range_us
+        with self._mx:
+            hit = self._rng.random() < self.p
+            dt = self._rng.uniform(lo, hi) * 1e-6 if hit else 0.0
+            if hit:
+                self.perturb_count += 1
+        if hit:
+            _ORIG_SLEEP(dt)
+
+    def on_acquired(self, site: str, lock_id: int) -> None:
+        held = self._held()
+        with self._mx:
+            self.acquire_count += 1
+            for h_site, h_id in held:
+                if h_site != site:  # same-site edges are lock reentry
+                    # across instances, not an order: excluded like
+                    # hostlint's self-edges
+                    self.edges.setdefault((h_site, site), 0)
+                    self.edges[(h_site, site)] += 1
+        held.append((site, lock_id))
+
+    def on_released(self, site: str, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == lock_id:
+                del held[i]
+                return
+
+    def on_blocking(self, op: str, own_lock_id=None) -> None:
+        held = [
+            (s, i) for (s, i) in self._held() if i != own_lock_id
+        ]
+        if not held:
+            return
+        site = _call_site()
+        with self._mx:
+            self.block_events.append({
+                "op": op, "site": site,
+                "held": sorted({s for s, _ in held}),
+                "thread": threading.current_thread().name,
+            })
+
+    # -- results -------------------------------------------------------------
+
+    def cycles(self) -> list:
+        with self._mx:
+            edges = dict(self.edges)
+        return _find_cycles(edges)
+
+    def report(self) -> dict:
+        with self._mx:
+            edges = sorted(self.edges.items())
+            blocks = list(self.block_events)
+            sites = dict(self.sites)
+            nper, nacq = self.perturb_count, self.acquire_count
+        return {
+            "seed": self.seed,
+            "perturb": self.perturb,
+            "p": self.p,
+            "lock_sites": sites,
+            "edges": [[a, b, n] for (a, b), n in edges],
+            "cycles": self.cycles(),
+            "block_events": blocks,
+            "perturb_count": nper,
+            "acquire_count": nacq,
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.report(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+
+# -- the instrumented primitives ----------------------------------------------
+
+
+class _InstrumentedLock:
+    """Drop-in ``threading.Lock`` riding a private real lock.  Survives
+    every stdlib use (Condition's acquire/release protocol included) and
+    keeps working after uninstall."""
+
+    def __init__(self, recorder: Recorder, site: str):
+        self._rec = recorder
+        self._inner = _ORIG_LOCK_ALLOC()
+        self._site = site
+        recorder.on_alloc(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._rec.maybe_perturb()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._rec.on_acquired(self._site, id(self))
+        return got
+
+    def release(self) -> None:
+        self._rec.on_released(self._site, id(self))
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib os.register_at_fork consumers (concurrent.futures,
+        # threading internals) call this on forked children
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<racecheck.Lock @{self._site} {self._inner!r}>"
+
+
+class _InstrumentedRLock:
+    """Drop-in ``threading.RLock``: forwards the private Condition
+    protocol (``_is_owned``/``_release_save``/``_acquire_restore``) to
+    the real RLock so ``Condition(RLock())`` keeps its exact stdlib
+    semantics, with held-stack bookkeeping on each transition."""
+
+    def __init__(self, recorder: Recorder, site: str):
+        self._rec = recorder
+        self._inner = _ORIG_RLOCK()
+        self._site = site
+        self._depth = 0  # owner-side only; guarded by holding _inner
+        recorder.on_alloc(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._rec.maybe_perturb()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._depth += 1
+            if self._depth == 1:
+                self._rec.on_acquired(self._site, id(self))
+        return got
+
+    __enter__ = acquire
+
+    def release(self) -> None:
+        if self._depth == 1:
+            self._rec.on_released(self._site, id(self))
+        self._depth -= 1
+        self._inner.release()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition protocol ------------------------------------------------
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        self._rec.on_released(self._site, id(self))
+        depth, self._depth = self._depth, 0
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        self._depth = depth
+        self._rec.on_acquired(self._site, id(self))
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+        self._depth = 0
+
+    def __repr__(self) -> str:
+        return f"<racecheck.RLock @{self._site} {self._inner!r}>"
+
+
+def _make_condition_class(recorder: Recorder):
+    class _InstrumentedCondition(_ORIG_CONDITION):
+        # the default lock comes from threading's *global* ``RLock`` name,
+        # which install() has already patched — a bare Condition() is
+        # instrumented end to end with no code here
+
+        def wait(self, timeout=None):
+            recorder.on_blocking(
+                "Condition.wait", own_lock_id=id(self._lock))
+            recorder.maybe_perturb()
+            return super().wait(timeout)
+
+    return _InstrumentedCondition
+
+
+_STATE: dict = {"recorder": None}
+
+
+def current() -> Recorder | None:
+    """The installed recorder, or None."""
+    return _STATE["recorder"]
+
+
+def install(seed=None, perturb: bool = False, p: float = 0.02,
+            sleep_range_us=(300, 3000)) -> Recorder:
+    """Patch ``threading.Lock``/``RLock``/``Condition`` and
+    ``time.sleep``; every lock allocated from here on is recorded.
+    ``Event``/``Barrier``/``Semaphore``/``queue.Queue`` pick the patched
+    primitives up automatically — their constructors resolve
+    ``Lock``/``Condition`` through threading's module globals at call
+    time.  Idempotent per process: a second install raises."""
+    if _STATE["recorder"] is not None:
+        raise RuntimeError("racecheck already installed")
+    rec = Recorder(seed=seed, perturb=perturb, p=p,
+                   sleep_range_us=sleep_range_us)
+    _STATE["recorder"] = rec
+
+    def make_lock():
+        return _InstrumentedLock(rec, _call_site())
+
+    def make_rlock():
+        return _InstrumentedRLock(rec, _call_site())
+
+    def patched_sleep(secs):
+        rec.on_blocking("time.sleep")
+        _ORIG_SLEEP(secs)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = _make_condition_class(rec)
+    time.sleep = patched_sleep
+
+    report_path = os.environ.get("RINGPOP_RACE_REPORT")
+    if report_path:
+        atexit.register(lambda: rec.dump(report_path))
+    return rec
+
+
+def uninstall() -> Recorder | None:
+    """Restore the stdlib primitives.  Wrappers already handed out keep
+    functioning (each owns a private real lock); they just stop feeding
+    new edges once their recorder is detached here."""
+    rec = _STATE["recorder"]
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    threading.Condition = _ORIG_CONDITION
+    time.sleep = _ORIG_SLEEP
+    _STATE["recorder"] = None
+    return rec

@@ -92,33 +92,49 @@ class UDPStatsd(StatsReporter):
 
     def _emit(self, line: str) -> None:
         data = line.encode()
+        out: list = []
         with self._lock:
-            if self._sock is None:
+            sock = self._sock
+            if sock is None:
                 return  # post-close emits are dropped
             if self._buf and (
                 self._buf_bytes + 1 + len(data) > self.max_datagram
             ):
-                self._flush_locked()
+                out.append(self._swap_locked())
             self._buf.append(data)
             self._buf_bytes += len(data) + (1 if len(self._buf) > 1 else 0)
             if time.time() - self._last_flush >= self.flush_s:
-                self._flush_locked()
+                out.append(self._swap_locked())
+        self._send(out, sock)
 
-    def _flush_locked(self) -> None:
+    def _swap_locked(self) -> Optional[bytes]:
+        """Detach the pending datagram (caller holds the lock).  The
+        sendto happens AFTER the lock is released — a kernel send under
+        the emit lock would stall every other emitting thread behind
+        socket-buffer backpressure (RPH302)."""
         self._last_flush = time.time()
-        if not self._buf or self._sock is None:
-            self._buf, self._buf_bytes = [], 0
-            return
+        if not self._buf:
+            self._buf_bytes = 0
+            return None
         payload = b"\n".join(self._buf)
         self._buf, self._buf_bytes = [], 0
-        try:
-            self._sock.sendto(payload, self._addr)
-        except (OSError, ValueError):
-            pass  # stats must never take the node down (dead socket incl.)
+        return payload
+
+    def _send(self, payloads, sock) -> None:
+        for payload in payloads:
+            if payload is None:
+                continue
+            try:
+                sock.sendto(payload, self._addr)
+            except (OSError, ValueError):
+                pass  # stats must never take the node down (dead socket incl.)
 
     def flush(self) -> None:
         with self._lock:
-            self._flush_locked()
+            sock = self._sock
+            payload = self._swap_locked() if sock is not None else None
+        if sock is not None:
+            self._send([payload], sock)
 
     def incr(self, key: str, value: int = 1) -> None:
         self._emit(f"{key}:{value}|c")
@@ -131,13 +147,14 @@ class UDPStatsd(StatsReporter):
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                self._flush_locked()
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            sock, self._sock = self._sock, None
+            payload = self._swap_locked() if sock is not None else None
+        if sock is not None:
+            self._send([payload], sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "UDPStatsd":
         return self

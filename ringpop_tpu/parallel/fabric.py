@@ -2053,7 +2053,15 @@ class RpcLink:
                 slots=self.ep.shm_slots, slot_bytes=self.ep.shm_slot_bytes)
         except Exception:
             return  # no usable shm on this host — stay on TCP
-        self._shm = lane
+        with self._lock:
+            if self.err is not None:
+                installed = False
+            else:
+                self._shm = lane
+                installed = True
+        if not installed:
+            lane.close()
+            return
         lane.start_reader(self)
         body = json.dumps({
             "op": "offer", "name": lane.name, "slots": lane.slots,
@@ -2080,7 +2088,18 @@ class RpcLink:
             except Exception:
                 self._enqueue(TAG_RPC_CTL, b'{"op":"nak"}', urgent=True)
                 return
-            self._shm = lane
+            with self._lock:
+                # recheck under the link state lock: _fail may have won
+                # the race and torn the link down mid-attach
+                if self.err is not None or self._shm is not None:
+                    installed = False
+                else:
+                    self._shm = lane
+                    installed = True
+            if not installed:
+                lane.close()
+                self._enqueue(TAG_RPC_CTL, b'{"op":"nak"}', urgent=True)
+                return
             lane.start_reader(self)
             lane.tx_ready = True
             self._enqueue(TAG_RPC_CTL, json.dumps(
@@ -2093,7 +2112,9 @@ class RpcLink:
                     lane.peer_bell = msg["bell"]
                 lane.tx_ready = True
         elif op == "nak":
-            lane, self._shm = self._shm, None
+            with self._lock:
+                # atomic swap vs. _fail: exactly one path closes the lane
+                lane, self._shm = self._shm, None
             if lane is not None:
                 lane.close()
         # unknown ops are ignored: forward-compatible control plane

@@ -1,10 +1,13 @@
-"""jaxlint — drive both static-analysis planes (``make lint``).
+"""jaxlint — drive the static-analysis planes (``make lint``).
 
 Plane 1 (``ringpop_tpu/analysis/astlint``) lints the package source for
 codebase-specific hazards; plane 2 (``ringpop_tpu/analysis/trace_checks``)
 traces the public jitted entry points dense + under the 8-way virtual
-mesh and checks the invariants of the traced programs themselves.  Rule
-catalog and the story behind each rule: ANALYSIS.md.
+mesh and checks the invariants of the traced programs themselves; plane 3
+(``ringpop_tpu/analysis/hostlint``) lints the host concurrency layer —
+lock-order inversions, blocking-under-lock, thread leaks, unlocked
+shared attributes, journal-schema drift.  Rule catalog and the story
+behind each rule: ANALYSIS.md.
 
 Usage:
     python scripts/jaxlint.py                      # full repo, both planes
@@ -103,9 +106,10 @@ def main() -> int:
     ap.add_argument("paths", nargs="*", help="explicit files/dirs (default: repo sweep)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument(
-        "--plane", choices=("1", "2", "all"), default="all",
+        "--plane", choices=("1", "2", "3", "all"), default="all",
         help="1 = AST lint only (no jax import), 2 = trace checks only, "
-        "all = both (default)",
+        "3 = host-concurrency lint only (no jax import), all = every "
+        "plane (default)",
     )
     ap.add_argument(
         "--waivers", default=os.path.join(_REPO, WAIVERS_PATH),
@@ -121,6 +125,11 @@ def main() -> int:
 
     if args.plane in ("1", "all"):
         all_findings += astlint.lint_paths(paths, _REPO)
+
+    if args.plane in ("3", "all"):
+        from ringpop_tpu.analysis import hostlint
+
+        all_findings += hostlint.lint_paths(paths, _REPO)
 
     if args.plane in ("2", "all"):
         if explicit:
@@ -152,9 +161,10 @@ def main() -> int:
     except waivers.WaiverError as e:
         print(f"jaxlint: waiver config error: {e}", file=sys.stderr)
         return 2
-    if explicit:
-        # a scoped run only lints a subset — a waiver for an un-linted
-        # file is not stale, so the unused report would mislead (and its
+    if explicit or args.plane != "all":
+        # a scoped run (explicit paths, or a single plane) only lints a
+        # subset — a waiver for an un-linted file or another plane's rule
+        # is not stale, so the unused report would mislead (and its
         # "delete it" advice would break the full sweep)
         unused = []
 

@@ -1,0 +1,291 @@
+"""race_harness — drive the smokes under racecheck's hostile scheduler
+(``make race-smoke``; the dynamic half of analysis plane 3, ANALYSIS.md).
+
+The reference repo runs its whole suite under Go's race detector
+(``make test-race``); this is that gate for the rebuild's host layer.
+Each leg launches a smoke in a subprocess with
+``ringpop_tpu.analysis.racecheck`` installed — every ``threading.Lock``
+/ ``RLock`` / ``Condition`` allocated by the smoke is instrumented, a
+seeded perturbation stream injects sub-millisecond preemptions at lock
+acquisition and wait points, and the process dumps its dynamic
+lock-order graph + held-while-blocking events on exit.  A leg fails if
+the smoke itself fails under the adversarial schedule OR its dynamic
+lock graph contains a cycle (a realizable deadlock order).
+
+Legs (default):
+  * transport_smoke under EVERY seed (the concurrency-heavy surface:
+    persistent links, inline completion, coalescing, shm lane)
+  * serve / dcn / gameday smokes one seed each, round-robin
+    (dcn/gameday child OS processes run uninstrumented — the harness
+    covers the parent; cross-process order is the smokes' own job)
+  * the **non-vacuity pair**: an in-process TCPChannel echo probe whose
+    client reads the server's ``wire_stats()`` immediately after each
+    reply and asserts ``frames_sent >= replies_observed`` — the exact
+    invariant the r22 count-after-respond flake broke.  Run once clean
+    (must hold) and once with the r22 mutant deliberately reintroduced
+    (``_respond`` flipped to write-then-count): the perturbed schedule
+    MUST catch it, proving the harness can see the bug class it exists
+    for.  A harness that can't catch its own seeded bug is vacuous.
+
+Usage:
+    python scripts/race_harness.py                    # full gate
+    python scripts/race_harness.py --seeds 7,8,9
+    python scripts/race_harness.py --smokes transport --skip-mutant
+    python scripts/race_harness.py --report /tmp/race.json
+
+Exit codes: 0 green; 1 a smoke failed or a dynamic cycle was found;
+3 the seeded mutant was NOT caught (vacuity); 4 the clean probe
+violated (a real count-after-respond regression at HEAD).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SMOKES = {
+    "transport": "scripts/transport_smoke.py",
+    "serve": "scripts/serve_smoke.py",
+    "dcn": "scripts/dcn_smoke.py",
+    "gameday": "scripts/gameday_smoke.py",
+}
+LEG_TIMEOUT_S = 600
+
+_BOOT = (
+    "import sys, runpy;"
+    "sys.path.insert(0, {repo!r});"
+    "from ringpop_tpu.analysis import racecheck;"
+    "racecheck.install(seed={seed}, perturb=True, p={p}, "
+    "sleep_range_us=(200, 1500));"
+    "runpy.run_path({script!r}, run_name='__main__')"
+)
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return env
+
+
+def _run_smoke_leg(name: str, seed: int, p: float) -> dict:
+    """One smoke under one perturbation seed; returns the leg record."""
+    script = os.path.join(_REPO, SMOKES[name])
+    fd, report_path = tempfile.mkstemp(prefix=f"race_{name}_", suffix=".json")
+    os.close(fd)
+    env = _env()
+    env["RINGPOP_RACE_REPORT"] = report_path
+    boot = _BOOT.format(repo=_REPO, seed=seed, p=p, script=script)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-c", boot], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=LEG_TIMEOUT_S,
+    )
+    leg = {
+        "leg": name, "seed": seed, "rc": proc.returncode,
+        "wall_s": round(time.time() - t0, 2),
+        "cycles": [], "edges": 0, "block_events": 0, "perturb_count": 0,
+    }
+    try:
+        with open(report_path) as fh:
+            rep = json.load(fh)
+        leg["cycles"] = rep.get("cycles", [])
+        leg["edges"] = len(rep.get("edges", []))
+        leg["block_events"] = len(rep.get("block_events", []))
+        leg["perturb_count"] = rep.get("perturb_count", 0)
+        leg["acquire_count"] = rep.get("acquire_count", 0)
+    except (OSError, ValueError):
+        leg["report_missing"] = True
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+    if proc.returncode != 0:
+        leg["tail"] = (proc.stdout + proc.stderr)[-2000:]
+    return leg
+
+
+# -- the count-after-respond probe (non-vacuity pair) -------------------------
+
+
+def _probe(mutant: bool, seed: int, calls: int = 150) -> int:
+    """Echo-RPC loop over a real TCPChannel pair; after every reply the
+    client immediately reads the SERVER's legacy counters and checks the
+    r22 invariant: a reply on the wire implies its frame was already
+    counted (``frames_sent >= replies_observed``).  With ``mutant``,
+    ``_respond`` is flipped back to the r22 write-then-count ordering —
+    under perturbation (a seeded sleep lands between the socket write
+    and the count's lock acquisition) the stale read becomes near-
+    certain within a few dozen calls."""
+    from ringpop_tpu.analysis import racecheck
+
+    racecheck.install(
+        seed=seed, perturb=True, p=0.35, sleep_range_us=(500, 3000))
+    from ringpop_tpu.net.channel import TCPChannel
+
+    if mutant:
+        def buggy_respond(self, link, rid, res):
+            # the r22 bug, verbatim ordering: socket write first, count
+            # after — wire_stats() readers woken by the reply race it
+            payload = self._encode(res)
+            link.respond(rid, payload)
+            self._count_sent(len(payload))
+        TCPChannel._respond = buggy_respond
+
+    server = TCPChannel(app="race-probe", codec="msgpack")
+    server.register("probe", "/echo", lambda body, headers: body)
+    addr = server.listen_sync("127.0.0.1", 0)
+    client = TCPChannel(app="race-probe-cli", codec="msgpack")
+    violations = 0
+    replies = 0
+    try:
+        for i in range(calls):
+            client.call_sync(addr, "probe", "/echo", {"i": i}, timeout=10)
+            replies += 1
+            if server.wire_stats()["frames_sent"] < replies:
+                violations += 1
+    finally:
+        client.close_sync()
+        server.close_sync()
+    out = {
+        "probe": "mutant" if mutant else "clean",
+        "seed": seed, "calls": replies, "violations": violations,
+    }
+    print(json.dumps(out))
+    if mutant:
+        # caught == good: exit 0 when the harness SAW the seeded bug
+        return 0 if violations > 0 else 3
+    return 0 if violations == 0 else 4
+
+
+def _run_probe_leg(mutant: bool, seed: int) -> dict:
+    mode = "mutant" if mutant else "clean"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--probe", mode,
+         "--seeds", str(seed)],
+        env=_env(), cwd=_REPO, capture_output=True, text=True,
+        timeout=LEG_TIMEOUT_S,
+    )
+    leg = {"leg": f"probe-{mode}", "seed": seed, "rc": proc.returncode}
+    for line in proc.stdout.splitlines():
+        try:
+            leg.update(json.loads(line))
+            break
+        except ValueError:
+            continue
+    if proc.returncode != 0:
+        leg["tail"] = (proc.stdout + proc.stderr)[-2000:]
+    return leg
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--seeds", default="1,2,3",
+                    help="comma-separated perturbation seeds (default 1,2,3)")
+    ap.add_argument("--smokes", default="transport,serve,dcn,gameday",
+                    help="comma-separated smoke legs (subset of %s)"
+                    % ",".join(SMOKES))
+    ap.add_argument("--p", type=float, default=0.03,
+                    help="perturbation probability per instrumentation point")
+    ap.add_argument("--skip-mutant", action="store_true",
+                    help="skip the non-vacuity probe pair")
+    ap.add_argument("--report", default=None,
+                    help="write the aggregate leg report as JSON here")
+    ap.add_argument("--probe", choices=("clean", "mutant"), default=None,
+                    help=argparse.SUPPRESS)  # internal: probe child mode
+    args = ap.parse_args()
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+
+    if args.probe is not None:
+        return _probe(args.probe == "mutant", seeds[0])
+
+    smokes = [s for s in args.smokes.split(",") if s]
+    unknown = [s for s in smokes if s not in SMOKES]
+    if unknown:
+        print(f"race-harness: unknown smoke leg(s) {unknown}", file=sys.stderr)
+        return 2
+
+    legs = []
+    # transport rides every seed; the jax-heavy smokes rotate one each
+    plan: list[tuple[str, int]] = []
+    if "transport" in smokes:
+        plan += [("transport", s) for s in seeds]
+    others = [s for s in smokes if s != "transport"]
+    for i, name in enumerate(others):
+        plan.append((name, seeds[i % len(seeds)]))
+
+    failed = False
+    for name, seed in plan:
+        leg = _run_smoke_leg(name, seed, args.p)
+        legs.append(leg)
+        ok = leg["rc"] == 0 and not leg["cycles"]
+        failed |= not ok
+        print(
+            f"race-harness: {name} seed={seed} "
+            f"{'OK' if ok else 'FAIL'} rc={leg['rc']} "
+            f"edges={leg['edges']} cycles={len(leg['cycles'])} "
+            f"blocked={leg['block_events']} "
+            f"perturbs={leg['perturb_count']} ({leg['wall_s']}s)"
+        )
+        for cyc in leg["cycles"]:
+            print(f"race-harness:   DYNAMIC LOCK CYCLE: {' -> '.join(cyc)}")
+        if leg["rc"] != 0 and "tail" in leg:
+            print(leg["tail"], file=sys.stderr)
+
+    vacuous = clean_broken = False
+    if not args.skip_mutant:
+        clean = _run_probe_leg(mutant=False, seed=seeds[0])
+        mut = _run_probe_leg(mutant=True, seed=seeds[0])
+        legs += [clean, mut]
+        clean_broken = clean["rc"] != 0
+        vacuous = mut["rc"] != 0
+        print(
+            f"race-harness: probe-clean seed={seeds[0]} "
+            f"{'OK' if not clean_broken else 'FAIL'} "
+            f"violations={clean.get('violations')}"
+        )
+        print(
+            f"race-harness: probe-mutant seed={seeds[0]} "
+            f"{'CAUGHT' if not vacuous else 'MISSED (vacuous!)'} "
+            f"violations={mut.get('violations')}"
+        )
+
+    if args.report:
+        with open(args.report, "w") as fh:
+            json.dump({"seeds": seeds, "p": args.p, "legs": legs},
+                      fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+    if clean_broken:
+        print("race-harness: FAIL — clean probe violated the count-before-"
+              "respond invariant at HEAD", file=sys.stderr)
+        return 4
+    if vacuous:
+        print("race-harness: FAIL — seeded count-after-respond mutant was "
+              "NOT caught; the harness is vacuous", file=sys.stderr)
+        return 3
+    if failed:
+        return 1
+    tail = ("mutant probe skipped" if args.skip_mutant
+            else "seeded r22 mutant caught")
+    print(f"race-harness OK: {len(legs)} legs green under seeds {seeds}; "
+          f"no dynamic lock cycles; {tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""RPH302 clean: Condition.wait on its OWN lock (wait releases it — the
+one legal blocking shape under a lock), blocking work outside the
+critical section, and a snapshot-then-act send."""
+import threading
+import time
+
+
+class Box:
+    def __init__(self, sock):
+        self._cond = threading.Condition()
+        self.sock = sock
+        self.v = 0
+
+    def waiter(self):
+        with self._cond:
+            self._cond.wait()
+        time.sleep(0.01)
+
+    def push(self):
+        with self._cond:
+            v = self.v
+        self.sock.sendall(str(v).encode())

@@ -1,0 +1,23 @@
+"""RPH302 trip: a sleep inside the critical section, and a socket write
+reached through a same-module call while the lock is held."""
+import threading
+import time
+
+
+class Box:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+        self.v = 0
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.1)
+            self.v += 1
+
+    def indirect(self):
+        with self._lock:
+            self._push()
+
+    def _push(self):
+        self.sock.sendall(b"x")

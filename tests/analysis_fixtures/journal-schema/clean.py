@@ -1,0 +1,7 @@
+"""RPH305 clean: a documented kind carrying exactly its indexed keys
+(plus a dynamic spread, which only the literal-key contract covers)."""
+
+
+def emit(journal, extra):
+    journal.write({"kind": "heal", "tick": 1})
+    journal.write({"kind": "crash", "tick": 2, "nodes": [1, 2], **extra})

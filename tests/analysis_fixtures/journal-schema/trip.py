@@ -1,0 +1,8 @@
+"""RPH305 trip: one record whose kind is absent from OBSERVABILITY.md's
+journal record schema index, and one documented kind emitting a key its
+row doesn't list — both halves of the r22 drift class."""
+
+
+def emit(journal):
+    journal.write({"kind": "zz_undocumented_kind", "tick": 1})
+    journal.write({"kind": "heal", "tick": 1, "zz_bogus_key": 2})

@@ -1,0 +1,23 @@
+"""RPH301 clean: both paths honor one global order (a before b) — the
+acquisition graph is acyclic, including through the helper call."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def rev(self):
+        with self._a:
+            self._under_a()
+
+    def _under_a(self):
+        with self._b:
+            self.n -= 1

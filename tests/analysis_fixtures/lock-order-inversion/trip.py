@@ -1,0 +1,20 @@
+"""RPH301 trip: the same two locks nest in opposite orders — two
+threads entering fwd() and rev() concurrently deadlock."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                self.n -= 1

@@ -1,0 +1,18 @@
+"""RPH303 clean: the two blessed shapes — joined in the creating scope,
+or daemonized (with the bounded join living on the shutdown path)."""
+import threading
+
+
+def run_joined(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class Worker:
+    def __init__(self, fn):
+        self._t = threading.Thread(target=fn, daemon=True)
+        self._t.start()
+
+    def close(self):
+        self._t.join(timeout=5)

@@ -1,0 +1,8 @@
+"""RPH303 trip: a non-daemon thread started and dropped — it outlives
+main and holds the process open."""
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)
+    t.start()

@@ -1,0 +1,21 @@
+"""RPH304 clean: same two thread roots, but every write to the shared
+attribute happens under the one lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def start(self, pool):
+        threading.Thread(target=self._worker, daemon=True).start()
+        pool.submit(self._bump)
+
+    def _worker(self):
+        with self._lock:
+            self.total = self.total + 1
+
+    def _bump(self):
+        with self._lock:
+            self.total += 1

@@ -1,0 +1,21 @@
+"""RPH304 trip: ``total`` is written from two distinct thread roots (a
+spawned Thread and an executor submit) and the worker's write takes no
+lock — torn read-modify-write under free-running threads."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def start(self, pool):
+        threading.Thread(target=self._worker, daemon=True).start()
+        pool.submit(self._bump)
+
+    def _worker(self):
+        self.total = self.total + 1
+
+    def _bump(self):
+        with self._lock:
+            self.total += 1

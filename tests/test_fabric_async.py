@@ -517,3 +517,60 @@ def test_wire_stats_race_free_and_monotone_under_concurrent_senders():
     # two ranks' totals agree (same deterministic payload schedule)
     assert sum(out[0]["codec_counts"].values()) == rounds * 2
     assert out[0]["codec_counts"] == out[1]["codec_counts"]
+
+
+def test_shm_lane_closed_exactly_once_under_nak_fail_race():
+    """Pins the RPH304 fix: ``RpcLink._shm`` is installed and detached
+    only under ``_lock``, so a peer NAK racing ``_fail`` swaps the lane
+    out atomically — exactly one path observes it and closes it.  A
+    double close would tear down a recycled shm fd; a missed close leaks
+    the segment."""
+    from ringpop_tpu.parallel.fabric import RpcLink
+
+    class Lane:
+        def __init__(self):
+            self.closes = 0
+            self._mx = threading.Lock()
+
+        def close(self):
+            with self._mx:
+                self.closes += 1
+
+    class Sock:
+        def shutdown(self, how):
+            pass
+
+        def close(self):
+            pass
+
+    class Ep:
+        def _unregister(self, link):
+            pass
+
+    for trial in range(50):
+        link = RpcLink.__new__(RpcLink)
+        link._lock = threading.Lock()
+        link.err = None
+        link._pending = {}
+        link.ep = Ep()
+        link.sock = Sock()
+        link.peer = None
+        lane = Lane()
+        link._shm = lane
+        start = threading.Barrier(2)
+
+        def nak():
+            start.wait()
+            link._handle_ctl(b'{"op":"nak"}')
+
+        def fail():
+            start.wait()
+            link._fail(FabricError("race trial"))
+
+        ts = [threading.Thread(target=nak), threading.Thread(target=fail)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert lane.closes == 1, f"trial {trial}: closed {lane.closes}x"
+        assert link._shm is None

@@ -1,0 +1,193 @@
+"""Plane-3 (host concurrency) lint tests.
+
+Mirrors test_jaxlint.py's structure: every RPH rule has a trip/clean
+fixture pair under tests/analysis_fixtures/<slug>/, the repo at HEAD is
+clean (modulo the committed waivers), and the CLI exit codes hold.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ringpop_tpu.analysis import hostlint, waivers
+from ringpop_tpu.analysis.findings import Finding
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIX = os.path.join(_REPO, "tests", "analysis_fixtures")
+_JAXLINT = os.path.join(_REPO, "scripts", "jaxlint.py")
+_DEFAULT_PATHS = ("ringpop_tpu", "scripts", "examples", "bench.py",
+                  "__graft_entry__.py")
+
+_SCHEMA = hostlint.load_schema_index(os.path.join(_REPO, "OBSERVABILITY.md"))
+
+# rule -> expected (line, scope) list for the trip fixture.  Pinning
+# lines keeps a refactor of the walker from silently shifting which
+# statement gets blamed.
+_TRIP_EXPECT = {
+    "RPH301": [(14, "Pair.fwd")],
+    "RPH302": [(15, "Box.slow"), (20, "Box.indirect")],
+    "RPH303": [(7, "fire_and_forget")],
+    "RPH304": [(17, "Counter._worker")],
+    "RPH305": [(7, "emit"), (8, "emit")],
+}
+
+
+def _lint_fixture(slug: str, name: str):
+    path = os.path.join(_FIX, slug, name + ".py")
+    rel = os.path.relpath(path, _REPO)
+    with open(path) as f:
+        return hostlint.lint_source(f.read(), rel, _SCHEMA)
+
+
+@pytest.mark.parametrize("rule", sorted(hostlint.RULES))
+def test_rule_trips(rule):
+    slug = hostlint.RULES[rule]
+    findings = _lint_fixture(slug, "trip")
+    assert findings, f"{slug}/trip.py produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    assert [(f.line, f.scope) for f in findings] == _TRIP_EXPECT[rule]
+
+
+@pytest.mark.parametrize("rule", sorted(hostlint.RULES))
+def test_rule_clean(rule):
+    slug = hostlint.RULES[rule]
+    findings = _lint_fixture(slug, "clean")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_fixture_routing_isolates_rules():
+    # A fixture directory is linted by exactly the rule whose slug it
+    # carries: the thread-leak trip spawns a thread from a function, but
+    # only RPH303 may fire there.
+    assert hostlint._rule_applies("RPH303", "tests/analysis_fixtures/thread-leak/trip.py")
+    assert not hostlint._rule_applies("RPH301", "tests/analysis_fixtures/thread-leak/trip.py")
+    # outside fixtures: RPH305 is package-only, the rest cover scripts too
+    assert hostlint._rule_applies("RPH305", "ringpop_tpu/cli/journal.py")
+    assert not hostlint._rule_applies("RPH305", "scripts/gameday_smoke.py")
+    assert hostlint._rule_applies("RPH302", "scripts/gameday_smoke.py")
+    assert not hostlint._rule_applies("RPH302", "examples/demo.py")
+
+
+def test_rph301_message_names_the_cycle():
+    (f,) = _lint_fixture("lock-order-inversion", "trip")
+    assert "Pair._a" in f.message and "Pair._b" in f.message
+    assert "cycle" in f.message
+
+
+def test_rph302_interprocedural_chain():
+    # the L20 finding is purely interprocedural: indirect() holds the
+    # lock and calls _push(), whose body does the sendall
+    findings = _lint_fixture("blocking-under-lock", "trip")
+    chain = [f for f in findings if f.line == 20]
+    assert len(chain) == 1
+    assert "_push()" in chain[0].message
+    assert "sendall" in chain[0].message
+
+
+# -- RPH305 schema index ------------------------------------------------------
+
+
+def test_schema_index_loads_from_repo_doc():
+    assert _SCHEMA is not None
+    # spot-check kinds the package emits today
+    for kind in ("header", "heal", "crash", "serve", "alert", "req", "res"):
+        assert kind in _SCHEMA, kind
+        assert "kind" in _SCHEMA[kind]
+    assert "tick" in _SCHEMA["heal"]
+
+
+def test_schema_index_missing_doc_or_section(tmp_path):
+    assert hostlint.load_schema_index(str(tmp_path / "nope.md")) is None
+    other = tmp_path / "plain.md"
+    other.write_text("# Nothing here\n\n| a | b |\n|---|---|\n| x | `y` |\n")
+    assert hostlint.load_schema_index(str(other)) is None
+
+
+def test_rph305_with_custom_index_and_spread():
+    src = (
+        "def emit(j, extra):\n"
+        "    j.write({'kind': 'heal', 'tick': 1})\n"
+        "    j.write({'kind': 'heal', 'tick': 1, **extra})\n"
+        "    j.write({'kind': 'mystery'})\n"
+    )
+    idx = {"heal": {"kind", "tick"}}
+    findings = hostlint.lint_source(src, "ringpop_tpu/zz_fake.py", idx)
+    rph305 = [f for f in findings if f.rule == "RPH305"]
+    assert [f.line for f in rph305] == [4]
+    assert "mystery" in rph305[0].message
+
+
+def test_rph305_disabled_without_index():
+    src = "def emit(j):\n    j.write({'kind': 'mystery'})\n"
+    findings = hostlint.lint_source(src, "ringpop_tpu/zz_fake.py", None)
+    assert [f for f in findings if f.rule == "RPH305"] == []
+
+
+# -- waivers over RPH findings ------------------------------------------------
+
+
+def test_waiver_matches_rph_scope(tmp_path):
+    wpath = tmp_path / "w.toml"
+    wpath.write_text(
+        '[[waiver]]\n'
+        'rule = "RPH302"\n'
+        'path = "ringpop_tpu/parallel/fabric.py"\n'
+        'scope = "RpcLink._send_loop"\n'
+        'justification = "leaf lock whose purpose is wire-write serialization"\n'
+    )
+    wl = waivers.load_waivers(str(wpath))
+    hit = Finding("RPH302", "ringpop_tpu/parallel/fabric.py", 10,
+                  "RpcLink._send_loop", "blocking call sendmsg ...")
+    miss = Finding("RPH302", "ringpop_tpu/parallel/fabric.py", 11,
+                   "RpcLink._enqueue", "blocking call sendmsg ...")
+    unused = waivers.apply_waivers([hit, miss], wl)
+    assert hit.waived and not miss.waived
+    assert unused == []
+
+
+def test_repo_plane3_clean_at_head():
+    findings = hostlint.lint_paths(list(_DEFAULT_PATHS), _REPO)
+    wl = waivers.load_waivers(
+        os.path.join(_REPO, "ringpop_tpu", "analysis", "waivers.toml"))
+    waivers.apply_waivers(findings, wl)
+    unwaived = [f for f in findings if not f.waived]
+    assert unwaived == [], "\n".join(f.render() for f in unwaived)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _run_cli(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, _JAXLINT, *argv],
+        capture_output=True, text=True, cwd=_REPO, timeout=timeout,
+    )
+
+
+def test_cli_plane3_trip_exits_1():
+    p = _run_cli("--plane", "3",
+                 "tests/analysis_fixtures/lock-order-inversion/trip.py")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "RPH301" in p.stdout
+
+
+def test_cli_plane3_clean_exits_0():
+    p = _run_cli("--plane", "3",
+                 "tests/analysis_fixtures/lock-order-inversion/clean.py")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_plane3_repo_sweep_clean_and_json():
+    p = _run_cli("--plane", "3", "--format", "json")
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["unwaived_count"] == 0
+    assert doc["unused_waivers"] == []
+    # the two fabric wire-write waivers show up as waived findings
+    waived_rules = {f["rule"] for f in doc["findings"] if f["waived"]}
+    assert "RPH302" in waived_rules

@@ -28,7 +28,7 @@ import sys
 
 import pytest
 
-from ringpop_tpu.analysis import astlint, trace_checks, waivers
+from ringpop_tpu.analysis import astlint, hostlint, trace_checks, waivers
 from ringpop_tpu.analysis.findings import Finding
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -172,6 +172,7 @@ def test_checked_in_waivers_all_load_and_none_unused():
     )
     assert wl, "committed waiver file disappeared or parses empty"
     findings = astlint.lint_paths(list(_DEFAULT_PATHS), _REPO)
+    findings += hostlint.lint_paths(list(_DEFAULT_PATHS), _REPO)
     unused = waivers.apply_waivers(findings, wl)
     assert not unused, [dict(w) for w in unused]
 

@@ -312,6 +312,35 @@ def test_udp_statsd_context_manager_closes_socket(tmp_path):
     recv.close()
 
 
+def test_udp_statsd_sends_outside_the_emit_lock():
+    """Pins the RPH302 fix: the datagram is detached under ``_lock`` but
+    the kernel send happens after release — a sendto under the emit lock
+    would stall every other emitting thread behind socket-buffer
+    backpressure."""
+    from ringpop_tpu.cli.stats import UDPStatsd
+
+    udp = UDPStatsd("127.0.0.1:9")
+    sent = []
+
+    class Probe:
+        def sendto(self, payload, addr):
+            assert not udp._lock.locked(), "sendto under the emit lock"
+            sent.append(payload)
+
+        def close(self):
+            pass
+
+    udp._sock.close()
+    udp._sock = Probe()
+    udp.incr("a", 1)  # epoch-0 last_flush: the first emit flushes at once
+    udp.flush()  # explicit-flush path (empty buffer: no datagram)
+    udp.gauge("b", 2.0)  # buffered inside the flush window
+    udp.close()  # close drains the tail outside the lock too
+    assert sent == [b"a:1|c", b"b:2.0|g"]
+    udp.incr("late", 1)  # post-close: dropped, not sent
+    assert len(sent) == 2
+
+
 def test_simbench_telemetry_flag_writes_parseable_journal(tmp_path):
     """The CLI seam end to end: `simbench --telemetry` produces a journal
     with a header per scenario and parseable block records."""
